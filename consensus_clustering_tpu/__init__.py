@@ -1,0 +1,46 @@
+"""TPU-native consensus clustering framework.
+
+A from-scratch JAX/XLA implementation of Monti-style consensus clustering
+(subsample-and-cluster stability analysis) with the sklearn-shaped
+``ConsensusClustering(...).fit(X)`` API of the CPU reference
+(trioxane/consensus_clustering, ``consensus_clustering_parallelised.py:11``),
+re-designed TPU-first:
+
+- the bootstrap-resample loop is one compiled XLA program (resamples batched
+  with ``vmap``, the K sweep as a ``lax.scan`` over a padded-K clusterer),
+- resamples are sharded across chips over ICI via ``shard_map`` and the
+  N x N co-association matrix is accumulated on-device as psum-reduced
+  one-hot GEMMs on the MXU,
+- CDF / PAC / Delta(K) analysis runs on-device so a full k-sweep never
+  leaves HBM.
+"""
+
+import importlib
+
+__version__ = "0.1.0"
+
+# Lazy exports (PEP 562): keep `import consensus_clustering_tpu` light and let
+# subpackages load on first use.
+_EXPORTS = {
+    "ConsensusClustering": "consensus_clustering_tpu.api",
+    "SweepConfig": "consensus_clustering_tpu.config",
+    "KMeans": "consensus_clustering_tpu.models.kmeans",
+    "GaussianMixture": "consensus_clustering_tpu.models.gmm",
+    "AgglomerativeClustering": "consensus_clustering_tpu.models.agglomerative",
+    "SpectralClustering": "consensus_clustering_tpu.models.spectral",
+    "SklearnClusterer": "consensus_clustering_tpu.models.sklearn_adapter",
+    "load_corr": "consensus_clustering_tpu.data",
+}
+
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        module = importlib.import_module(_EXPORTS[name])
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__

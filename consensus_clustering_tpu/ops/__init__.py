@@ -1,0 +1,27 @@
+"""Pure-function JAX ops: resampling plan, co-association counts, analysis."""
+
+from consensus_clustering_tpu.ops.resample import (
+    resample_indices,
+    indicator_matrix,
+    cosample_counts,
+)
+from consensus_clustering_tpu.ops.coassoc import coassociation_counts
+from consensus_clustering_tpu.ops.analysis import (
+    consensus_matrix,
+    cdf_pac,
+    area_under_cdf,
+    delta_k,
+    pac_indices,
+)
+
+__all__ = [
+    "resample_indices",
+    "indicator_matrix",
+    "cosample_counts",
+    "coassociation_counts",
+    "consensus_matrix",
+    "cdf_pac",
+    "area_under_cdf",
+    "delta_k",
+    "pac_indices",
+]

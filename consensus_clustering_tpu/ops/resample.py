@@ -1,0 +1,97 @@
+"""Resampling plan: per-resample subsample indices and the co-sampling matrix.
+
+Reference semantics (consensus_clustering_parallelised.py:216-267): resample
+``i`` draws ``n_sub = int(subsampling * N)`` indices from ``range(N)`` without
+replacement using an RNG seeded ``random_state + i``; the co-sampling matrix is
+``Iij = R^T R`` where ``R`` is the (H, N) 0/1 indicator of which samples each
+resample contains.
+
+TPU-first design: the per-resample seed becomes ``jax.random.fold_in(key, i)``
+(same "independent stream per resample" structure, different bits — bitwise
+index parity with NumPy's MT19937 is impossible and not a goal, see SURVEY.md
+§7.3). The no-replacement draw is a fixed-size slice of an on-device
+permutation so it vmaps over H with static shapes, and ``Iij`` is a single
+(N, H) x (H, N) GEMM on the MXU with f32 accumulation (exact for counts up to
+2^24).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def subsample_size(n_samples: int, subsampling: float) -> int:
+    """Number of rows per resample: ``int(subsampling * N)``.
+
+    Mirrors consensus_clustering_parallelised.py:236 (floor via int()).
+    """
+    return int(subsampling * n_samples)
+
+
+def resample_indices(
+    key: jax.Array,
+    n_samples: int,
+    n_iterations: int,
+    n_sub: int,
+) -> jax.Array:
+    """Draw the (H, n_sub) no-replacement subsample index plan on device.
+
+    Resample ``i`` uses the independent stream ``fold_in(key, i)`` — the
+    analogue of the reference's ``random_state + i`` per-resample seeding
+    (consensus_clustering_parallelised.py:231-238), so the plan is a pure
+    function of ``(key, N, H, subsampling)`` and is identical for every K
+    (quirk Q8: the plan is drawn once, shared by the whole K sweep).
+
+    Returns int32 (H, n_sub).
+    """
+    if not 0 < n_sub <= n_samples:
+        raise ValueError(
+            f"subsample size {n_sub} must be in (0, {n_samples}]"
+        )
+
+    def draw_one(k: jax.Array) -> jax.Array:
+        # Fixed-size no-replacement draw: take the first n_sub entries of a
+        # full permutation.  O(N) per resample, static shapes, vmappable.
+        return jax.random.permutation(k, n_samples)[:n_sub].astype(jnp.int32)
+
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, jnp.arange(n_iterations, dtype=jnp.uint32)
+    )
+    return jax.vmap(draw_one)(keys)
+
+
+def indicator_matrix(
+    indices: jax.Array, n_samples: int, dtype: jnp.dtype = jnp.bfloat16
+) -> jax.Array:
+    """(H, N) 0/1 indicator R with R[h, indices[h, :]] = 1.
+
+    bfloat16 by default so the Iij GEMM runs on the MXU; the values are
+    exactly representable and the contraction accumulates in f32.
+
+    Negative indices (padding sentinels) are dropped, not wrapped: JAX wraps
+    negative indices Python-style before ``mode="drop"`` applies, so they are
+    first redirected to the out-of-bounds column N.
+    """
+    n_iterations = indices.shape[0]
+    indices = jnp.where(indices >= 0, indices, n_samples)
+    r = jnp.zeros((n_iterations, n_samples), dtype=dtype)
+    rows = jnp.arange(n_iterations, dtype=jnp.int32)[:, None]
+    return r.at[rows, indices].set(1, mode="drop")
+
+
+def cosample_counts(indices: jax.Array, n_samples: int) -> jax.Array:
+    """Co-sampling count matrix ``Iij[i, j] = #{resamples containing both}``.
+
+    Reference: ``Iij = R^T @ R`` (consensus_clustering_parallelised.py:260-264).
+    Here: one (N, H) x (H, N) MXU GEMM with f32 accumulation — exact for
+    H < 2^24 — returned as int32.
+    """
+    r = indicator_matrix(indices, n_samples)
+    iij = jax.lax.dot_general(
+        r,
+        r,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return iij.astype(jnp.int32)

@@ -1,0 +1,47 @@
+"""Test env: force an 8-device virtual CPU backend before JAX initialises.
+
+Multi-chip sharding is tested on a fake 8-device CPU mesh per SURVEY.md §4;
+real-TPU runs come from bench.py / the driver, not the unit suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def blobs():
+    """Well-separated 3-cluster blobs, (120, 5)."""
+    from sklearn.datasets import make_blobs
+
+    x, y = make_blobs(
+        n_samples=120, n_features=5, centers=3, cluster_std=0.5, random_state=7
+    )
+    return x.astype(np.float32), y
+
+
+@pytest.fixture(scope="session")
+def corr_data():
+    """The bundled 29x29 correlation dataset, PowerTransformed like the
+    reference notebook (consensus clustering.ipynb cells 2-3)."""
+    import pandas as pd
+    from sklearn.preprocessing import PowerTransformer
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "consensus_clustering_tpu", "data", "corr.csv"
+    )
+    df = pd.read_csv(path, index_col=0)
+    return PowerTransformer().fit_transform(df.values).astype(np.float32)

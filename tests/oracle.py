@@ -1,0 +1,52 @@
+"""Serial NumPy oracle implementing the reference's *math* for parity tests.
+
+This is a fresh, minimal implementation of the consensus-clustering formulas
+documented in SURVEY.md §0/§3 (co-clustering counts, co-sampling counts,
+Cij = Mij/(Iij+1e-6) with unit diagonal, zero-inflated 20-bin CDF, PAC) so the
+JAX ops can be checked bit-for-bit given the *same* labels and indices.  It is
+deliberately label-source-agnostic: pass in any (H, n_sub) labels/indices.
+"""
+
+import numpy as np
+
+
+def oracle_iij(indices: np.ndarray, n: int) -> np.ndarray:
+    h = indices.shape[0]
+    r = np.zeros((h, n), dtype=np.int64)
+    r[np.arange(h)[:, None], indices] = 1
+    return r.T @ r
+
+
+def oracle_mij(labels: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
+    mij = np.zeros((n, n), dtype=np.int64)
+    for lab, idx in zip(labels, indices):
+        k = int(lab.max()) + 1
+        c = np.zeros((k, n), dtype=np.int64)
+        c[lab, idx] = 1
+        mij += c.T @ c
+    return mij
+
+
+def oracle_cij(mij: np.ndarray, iij: np.ndarray) -> np.ndarray:
+    cij = np.divide(mij, iij + 1e-6, dtype=np.float32)
+    np.fill_diagonal(cij, 1.0)
+    return cij
+
+
+def oracle_cdf_pac(
+    cij: np.ndarray,
+    pac_interval=(0.1, 0.9),
+    bins: int = 20,
+    parity_zeros: bool = True,
+):
+    """Reference-style histogram/CDF/PAC (quirks Q6/Q7)."""
+    if parity_zeros:
+        values = np.triu(cij, k=1).ravel()
+    else:
+        values = cij[np.triu_indices_from(cij, k=1)]
+    hist, edges = np.histogram(values, bins=bins, range=(0, 1), density=True)
+    dbin = edges[1] - edges[0]
+    cdf = np.cumsum(hist) * dbin
+    u1, u2 = pac_interval
+    pac = cdf[int(u2 / dbin) - 1] - cdf[int(u1 / dbin)]
+    return hist, cdf, edges, pac

@@ -1,0 +1,197 @@
+"""Unit tests: resample plan, co-association counts, analysis vs NumPy oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.ops import (
+    cdf_pac,
+    coassociation_counts,
+    consensus_matrix,
+    cosample_counts,
+    delta_k,
+    area_under_cdf,
+    indicator_matrix,
+    pac_indices,
+    resample_indices,
+)
+from consensus_clustering_tpu.ops.resample import subsample_size
+
+from oracle import oracle_cdf_pac, oracle_cij, oracle_iij, oracle_mij
+
+
+class TestResamplePlan:
+    def test_shapes_and_range(self):
+        idx = resample_indices(jax.random.PRNGKey(0), 50, 12, 40)
+        assert idx.shape == (12, 40)
+        assert idx.dtype == jnp.int32
+        assert int(idx.min()) >= 0 and int(idx.max()) < 50
+
+    def test_no_replacement(self):
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(3), 64, 20, 51))
+        for row in idx:
+            assert len(np.unique(row)) == len(row)
+
+    def test_deterministic_and_seed_sensitive(self):
+        a = resample_indices(jax.random.PRNGKey(1), 30, 8, 24)
+        b = resample_indices(jax.random.PRNGKey(1), 30, 8, 24)
+        c = resample_indices(jax.random.PRNGKey(2), 30, 8, 24)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_rows_are_independent_streams(self):
+        # fold_in(key, i) per resample: rows must differ from each other.
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(5), 100, 6, 80))
+        assert len({tuple(np.sort(r)) for r in idx}) == 6
+
+    def test_subsample_size_floor(self):
+        # int(0.8 * 29) = 23, the corr.csv case.
+        assert subsample_size(29, 0.8) == 23
+        assert subsample_size(10, 0.75) == 7
+
+    def test_full_subsampling(self):
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(0), 16, 4, 16))
+        for row in idx:
+            np.testing.assert_array_equal(np.sort(row), np.arange(16))
+
+
+class TestCosampleCounts:
+    def test_matches_oracle(self):
+        n, h, n_sub = 37, 15, 29
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(9), n, h, n_sub))
+        iij = np.asarray(cosample_counts(jnp.asarray(idx), n))
+        np.testing.assert_array_equal(iij, oracle_iij(idx, n))
+
+    def test_diag_is_inclusion_count(self):
+        n, h, n_sub = 20, 10, 15
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(2), n, h, n_sub))
+        iij = np.asarray(cosample_counts(jnp.asarray(idx), n))
+        counts = np.zeros(n, dtype=np.int64)
+        for row in idx:
+            counts[row] += 1
+        np.testing.assert_array_equal(np.diag(iij), counts)
+        assert iij.sum() == h * n_sub * n_sub  # each resample adds n_sub^2
+
+    def test_indicator_dtype(self):
+        idx = resample_indices(jax.random.PRNGKey(0), 10, 3, 8)
+        r = indicator_matrix(idx, 10)
+        assert r.dtype == jnp.bfloat16
+        assert float(r.sum()) == 3 * 8
+
+
+class TestCoassociationCounts:
+    def _random_labels(self, rng, h, n_sub, k):
+        return rng.integers(0, k, size=(h, n_sub)).astype(np.int32)
+
+    @pytest.mark.parametrize("chunk_size", [1, 4, 7, 64])
+    def test_matches_oracle_any_chunking(self, rng, chunk_size):
+        n, h, n_sub, k = 31, 13, 24, 4
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(4), n, h, n_sub))
+        labels = self._random_labels(rng, h, n_sub, k)
+        mij = np.asarray(
+            coassociation_counts(
+                jnp.asarray(labels), jnp.asarray(idx), n, k_max=6,
+                chunk_size=chunk_size,
+            )
+        )
+        np.testing.assert_array_equal(mij, oracle_mij(labels, idx, n))
+
+    def test_symmetric_and_bounded(self, rng):
+        n, h, n_sub, k = 25, 20, 20, 3
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(6), n, h, n_sub))
+        labels = self._random_labels(rng, h, n_sub, k)
+        mij = np.asarray(
+            coassociation_counts(jnp.asarray(labels), jnp.asarray(idx), n, 3)
+        )
+        np.testing.assert_array_equal(mij, mij.T)
+        iij = np.asarray(cosample_counts(jnp.asarray(idx), n))
+        assert (mij <= iij).all()  # co-clustered only if co-sampled
+        np.testing.assert_array_equal(np.diag(mij), np.diag(iij))
+
+    def test_negative_labels_ignored(self):
+        n = 10
+        idx = jnp.asarray([[0, 1, 2], [3, 4, 5]], dtype=jnp.int32)
+        labels = jnp.asarray([[0, 0, 1], [-1, -1, -1]], dtype=jnp.int32)
+        mij = np.asarray(coassociation_counts(labels, idx, n, 2))
+        assert mij.sum() == 5  # only the first resample contributes (2^2 + 1)
+
+    def test_single_cluster_all_ones_block(self):
+        n = 6
+        idx = jnp.asarray([[0, 1, 2, 3]], dtype=jnp.int32)
+        labels = jnp.zeros((1, 4), dtype=jnp.int32)
+        mij = np.asarray(coassociation_counts(labels, idx, n, 1))
+        expected = np.zeros((n, n), dtype=np.int64)
+        expected[:4, :4] = 1
+        np.testing.assert_array_equal(mij, expected)
+
+
+class TestAnalysis:
+    def _setup(self, rng, n=29, h=30, k=4):
+        n_sub = subsample_size(n, 0.8)
+        idx = np.asarray(resample_indices(jax.random.PRNGKey(8), n, h, n_sub))
+        labels = rng.integers(0, k, size=(h, n_sub)).astype(np.int32)
+        mij = oracle_mij(labels, idx, n)
+        iij = oracle_iij(idx, n)
+        return mij, iij
+
+    def test_consensus_matrix_matches_oracle(self, rng):
+        mij, iij = self._setup(rng)
+        cij = np.asarray(consensus_matrix(jnp.asarray(mij), jnp.asarray(iij)))
+        # 1-ulp f32 tolerance: NumPy adds the 1e-6 regulariser in f64 before
+        # dividing in f32; on TPU (no f64) the add happens in f32.
+        np.testing.assert_allclose(cij, oracle_cij(mij, iij), rtol=2e-7)
+
+    def test_consensus_matrix_never_cosampled_is_zero_not_nan(self):
+        mij = jnp.zeros((3, 3), jnp.int32)
+        iij = jnp.zeros((3, 3), jnp.int32)
+        cij = np.asarray(consensus_matrix(mij, iij))
+        assert np.isfinite(cij).all()
+        np.testing.assert_array_equal(np.diag(cij), 1.0)
+        assert cij[0, 1] == 0.0
+
+    @pytest.mark.parametrize("parity_zeros", [True, False])
+    def test_cdf_pac_matches_oracle(self, rng, parity_zeros):
+        mij, iij = self._setup(rng)
+        cij = oracle_cij(mij, iij)
+        lo, hi = pac_indices((0.1, 0.9))
+        hist, cdf, pac = cdf_pac(
+            jnp.asarray(cij), lo, hi, parity_zeros=parity_zeros
+        )
+        o_hist, o_cdf, _, o_pac = oracle_cdf_pac(
+            cij, parity_zeros=parity_zeros
+        )
+        np.testing.assert_allclose(np.asarray(hist), o_hist, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(cdf), o_cdf, rtol=1e-6)
+        np.testing.assert_allclose(float(pac), o_pac, rtol=1e-6)
+
+    def test_pac_indices_reference_expression(self):
+        # dbin=0.05, (0.1, 0.9) -> pac = cdf[17] - cdf[2] (quirk Q7).
+        assert pac_indices((0.1, 0.9)) == (2, 18)
+        # 0.95/0.05 = 18.999999999999996 in f64, truncating to 18 — the
+        # reference's int() truncation quirk (Q7) must be reproduced.
+        assert pac_indices((0.05, 0.95)) == (1, 18)
+
+    def test_perfect_consensus_pac_zero(self):
+        # All-ones consensus: everything in the top bin, PAC = 0.
+        cij = jnp.ones((10, 10), jnp.float32)
+        lo, hi = pac_indices((0.1, 0.9))
+        _, cdf, pac = cdf_pac(cij, lo, hi, parity_zeros=False)
+        assert float(pac) == 0.0
+        assert float(cdf[-1]) == pytest.approx(1.0)
+
+    def test_ambiguous_consensus_pac_one(self):
+        # All 0.5: every pair ambiguous, PAC = 1 in corrected mode.
+        cij = jnp.full((10, 10), 0.5, jnp.float32)
+        lo, hi = pac_indices((0.1, 0.9))
+        _, _, pac = cdf_pac(cij, lo, hi, parity_zeros=False)
+        assert float(pac) == pytest.approx(1.0)
+
+    def test_delta_k_monotone_areas(self):
+        areas = np.array([0.2, 0.3, 0.36])
+        dk = delta_k(areas)
+        np.testing.assert_allclose(dk, [0.2, 0.5, 0.2])
+
+    def test_area_under_cdf(self):
+        cdf = jnp.ones((20,), jnp.float32)
+        assert float(area_under_cdf(cdf)) == pytest.approx(1.0)
