@@ -1,0 +1,91 @@
+"""Benchmark: full consensus k-sweep throughput vs the CPU-joblib reference.
+
+Headline config is BASELINE.json #2: make_blobs N=5000 d=50, KMeans(n_init=3)
+inner clusterer, H=500 resamples, K in [2, 20] — run as ONE compiled XLA
+program on the available device(s).  The CPU baseline
+(benchmarks/baseline_cpu.json) was measured by running the actual reference
+implementation on this machine (serially: single-core box, and n_jobs=1 is
+the reference's only race-free mode), steady-state resamples/sec per K,
+extrapolated linearly in H (per-resample work is H-independent).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": <resamples/sec>, "unit": "resamples/sec",
+   "vs_baseline": <speedup>, ...}
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_accelerator = backend not in ("cpu",)
+
+    import numpy as np
+    from sklearn.datasets import make_blobs
+
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+    if on_accelerator and "--small" not in sys.argv:
+        n, d, h, k_hi = 5000, 50, 500, 20
+    else:
+        # CPU smoke config: same code path, toy shapes.
+        n, d, h, k_hi = 500, 20, 50, 10
+
+    x, _ = make_blobs(
+        n_samples=n, n_features=d, centers=8, cluster_std=3.0, random_state=0
+    )
+    x = x.astype(np.float32)
+
+    config = SweepConfig(
+        n_samples=n,
+        n_features=d,
+        k_values=tuple(range(2, k_hi + 1)),
+        n_iterations=h,
+        subsampling=0.8,
+        store_matrices=False,
+        chunk_size=16,
+    )
+    # KMeans(n_init=3) mirrors the reference's default clusterer_options.
+    out = run_sweep(KMeans(n_init=3), config, x, seed=23)
+
+    total_resamples = h * len(config.k_values)
+    rate = out["timing"]["resamples_per_second"]
+    wall = out["timing"]["run_seconds"]
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "baseline_cpu.json",
+    )
+    vs_baseline = None
+    is_baseline_config = (n, d, h, k_hi) == (5000, 50, 500, 20)
+    if is_baseline_config and os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f)
+        base_total = 500 * len(range(2, 21))
+        base_rate = base_total / base["sweep_wall_seconds_extrapolated_H500"]
+        vs_baseline = rate / base_rate
+
+    record = {
+        "metric": "consensus k-sweep throughput "
+                  f"(N={n} d={d} H={h} K=2..{k_hi}, KMeans n_init=3)",
+        "value": round(rate, 2),
+        "unit": "resamples/sec",
+        "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+        "backend": backend,
+        "sweep_wall_seconds": round(wall, 4),
+        "compile_seconds": round(out["timing"]["compile_seconds"], 2),
+        "total_resamples": total_resamples,
+        "pac_head": [round(float(p), 5) for p in out["pac_area"][:3]],
+    }
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from consensus_clustering_tpu.cli import main
+
+main()

@@ -1,0 +1,429 @@
+"""The sklearn-shaped user API: ``ConsensusClustering(...).fit(X)``.
+
+Drop-in surface for the reference's class (consensus_clustering_parallelised
+.py:21-136): same 13 constructor kwargs, same ``fit(X)`` entry point, same
+``cdf_at_K_data`` result dict with keys ``consensus_labels, hist, cdf,
+bin_edges, pac_area, mij, iij, cij`` (:378-387) — but executed as one
+compiled XLA program on a TPU mesh instead of joblib workers on shared
+memory.
+
+Deliberate divergences from the reference (each per SURVEY.md §7.4):
+
+- Q1: ``random_state=None`` (the reference default) raises a clear
+  ValueError at fit time instead of crashing with TypeError deep in the
+  resample loop; pass an integer seed.
+- Q2/Q3: ``n_jobs`` / ``parallelization_method`` / ``memmap_folder`` are
+  accepted for compatibility but ignored (with a log message): parallelism
+  comes from the device mesh, accumulation is an exact psum, and there is no
+  shared mutable state to race on.
+- Q4: on-device accumulators are int32; the result dict's ``mij``/``iij``
+  are cast to the reference's uint8/uint16 dtype rule for H < 2^16, and kept
+  uint32 beyond it instead of silently overflowing.
+- Q5: consensus labels are an opt-in feature (``compute_consensus_labels=
+  True``) using agglomerative clustering on 1 - Cij; the default returns
+  ``[]`` exactly like the reference's disabled code path.
+- Q10: construction has no filesystem side effects.
+- Q11: ``clusterer_options`` defaults to None (meaning ``{'n_init': 3}``),
+  not a shared mutable dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.models.protocol import HostClusterer, JaxClusterer
+from consensus_clustering_tpu.models.sklearn_adapter import SklearnClusterer
+from consensus_clustering_tpu.ops.analysis import bin_edges as _bin_edges
+from consensus_clustering_tpu.ops.analysis import area_under_cdf, delta_k
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_CLUSTERER_OPTIONS = {"n_init": 3}
+
+
+def _apply_options(clusterer: Any, options: Dict[str, Any]) -> Any:
+    """Apply reference-style ``clusterer_options`` to a JAX clusterer.
+
+    The reference pushes options through sklearn's ``set_params``
+    (consensus_clustering_parallelised.py:212-214); for frozen dataclass
+    clusterers the equivalent is ``dataclasses.replace``, erroring on unknown
+    keys the same way set_params would.
+    """
+    if not options:
+        return clusterer
+    if dataclasses.is_dataclass(clusterer):
+        fields = {f.name for f in dataclasses.fields(clusterer)}
+        unknown = set(options) - fields
+        if unknown:
+            raise ValueError(
+                f"invalid clusterer option(s) {sorted(unknown)} for "
+                f"{type(clusterer).__name__}; valid: {sorted(fields)}"
+            )
+        return dataclasses.replace(clusterer, **options)
+    raise TypeError(
+        f"cannot apply clusterer_options to {type(clusterer).__name__}"
+    )
+
+
+class ConsensusClustering:
+    """Monti-style consensus clustering with a TPU execution backend.
+
+    Parameters mirror the reference's constructor
+    (consensus_clustering_parallelised.py:21-68); TPU-specific extras are
+    keyword-only and documented below.
+
+    Parameters
+    ----------
+    clusterer : optional
+        A JAX-native clusterer (``KMeans()``, ``GaussianMixture()``, ...), a
+        host clusterer, or any sklearn estimator with ``fit_predict`` and an
+        ``n_clusters``/``n_components`` attribute (runs via the host
+        backend).  None selects the JAX-native KMeans.
+    clusterer_options : dict, optional
+        Options applied to the clusterer (default ``{'n_init': 3}`` like the
+        reference, without the shared-mutable-default quirk Q11).
+    K_range, n_iterations, subsampling, random_state,
+    consensus_matrix_analysis, PAC_interval, plot_cdf,
+    agg_clustering_linkage : as the reference.
+    n_jobs, parallelization_method, memmap_folder :
+        accepted for API compatibility; ignored (see module docstring).
+    mesh : jax.sharding.Mesh, keyword-only, optional
+        Device mesh to shard resamples over; default is single-device.
+    store_matrices : bool or 'auto', keyword-only
+        Keep per-K ``mij``/``cij`` in the result dict (reference behaviour).
+        'auto' disables them when the stacked matrices would exceed ~2 GB.
+    parity_zeros : bool, keyword-only
+        Reproduce the reference's zero-inflated histogram (quirk Q6,
+        default True); False gives the corrected pairs-only density.
+    bins : int, keyword-only
+        Histogram bins (reference hard-codes 20).
+    chunk_size : int, keyword-only
+        Resamples per accumulation GEMM.
+    compute_consensus_labels : bool, keyword-only
+        Opt-in consensus labels via agglomerative clustering on 1 - Cij
+        (the reference's dead code path Q5, done properly).
+    reseed_clusterer_per_resample : bool, keyword-only
+        False (default) mirrors the reference: the inner clusterer re-seeds
+        identically for every resample fit.  True gives each resample an
+        independent init stream (see SweepConfig docs).
+    progress : bool, keyword-only
+        Per-K host progress bars for the host backend.
+
+    Attributes
+    ----------
+    cdf_at_K_data : dict
+        K -> result dict with the reference's exact keys.
+    areas_ : np.ndarray
+        Per-K area under the consensus CDF (Monti's A(K)).
+    delta_k_ : np.ndarray
+        Monti's Delta(K) curve over ``K_range``.
+    best_k_ : int
+        argmin PAC over the sweep — the K the PAC criterion selects.
+    metrics_ : dict
+        Structured timings (compile/run seconds, resamples/sec).
+    """
+
+    def __init__(
+        self,
+        clusterer=None,
+        clusterer_options: Optional[Dict[str, Any]] = None,
+        K_range=(2, 3),
+        n_iterations: int = 25,
+        subsampling: float = 0.8,
+        random_state: Optional[int] = None,
+        consensus_matrix_analysis: str = "PAC",
+        PAC_interval=(0.1, 0.9),
+        plot_cdf: bool = True,
+        agg_clustering_linkage: str = "average",
+        n_jobs: int = 1,
+        parallelization_method: str = "multithreading",
+        memmap_folder=None,
+        *,
+        mesh=None,
+        store_matrices="auto",
+        parity_zeros: bool = True,
+        bins: int = 20,
+        chunk_size: int = 8,
+        compute_consensus_labels: bool = False,
+        reseed_clusterer_per_resample: bool = False,
+        checkpoint_dir: Optional[str] = None,
+        progress: bool = True,
+    ):
+        self.K_range = K_range
+        self.n_iterations = n_iterations
+        self.subsampling = subsampling
+        self.clusterer = clusterer
+        self._options_defaulted = clusterer_options is None
+        self.clusterer_options = (
+            dict(_DEFAULT_CLUSTERER_OPTIONS)
+            if clusterer_options is None
+            else dict(clusterer_options)
+        )
+        self.consensus_matrix_analysis = consensus_matrix_analysis
+        self.PAC_interval = tuple(PAC_interval)
+        self.plot_cdf = plot_cdf
+        self.agg_clustering_linkage = agg_clustering_linkage
+        self.random_state = random_state
+
+        if n_jobs != 1 or parallelization_method != "multithreading":
+            logger.info(
+                "n_jobs/parallelization_method are ignored: parallelism "
+                "comes from the device mesh (got n_jobs=%s, method=%r)",
+                n_jobs, parallelization_method,
+            )
+        if memmap_folder is not None:
+            logger.info(
+                "memmap_folder is ignored: accumulation stays in HBM"
+            )
+        self.n_jobs = n_jobs
+        self.parallelization_method = parallelization_method
+        self.memmap_folder = memmap_folder
+
+        self.mesh = mesh
+        self.store_matrices = store_matrices
+        self.parity_zeros = parity_zeros
+        self.bins = bins
+        self.chunk_size = chunk_size
+        self.compute_consensus_labels = compute_consensus_labels
+        self.reseed_clusterer_per_resample = reseed_clusterer_per_resample
+        self.checkpoint_dir = checkpoint_dir
+        self.progress = progress
+
+    # -- clusterer resolution -------------------------------------------
+
+    def _resolve_clusterer(self):
+        """Returns (clusterer, is_host)."""
+        c = self.clusterer
+        if c is None:
+            logger.info("KMeans is set as default clusterer")
+            c = KMeans()
+        options = self._effective_options(c)
+        if isinstance(c, HostClusterer):
+            if isinstance(c, SklearnClusterer) and options:
+                c = SklearnClusterer(
+                    c.estimator, {**c.options, **options}
+                )
+            return c, True
+        # sklearn estimators must be sniffed *before* the JaxClusterer
+        # protocol: runtime_checkable only checks method names, and sklearn
+        # also spells its entry point fit_predict.  get_params is the
+        # BaseEstimator fingerprint.
+        if hasattr(c, "fit_predict") and hasattr(c, "get_params"):
+            return SklearnClusterer(c, options), True
+        if isinstance(c, JaxClusterer):
+            return _apply_options(c, options), False
+        raise TypeError(
+            f"clusterer {type(c).__name__} is neither a JaxClusterer, a "
+            "HostClusterer, nor an sklearn-style estimator with fit_predict"
+        )
+
+    def _effective_options(self, c) -> Dict[str, Any]:
+        """The options to apply, dropping the *defaulted* {'n_init': 3} for
+        clusterers that have no n_init knob (e.g. AgglomerativeClustering) —
+        a default must never make a valid clusterer choice crash.
+        Explicitly passed options are applied verbatim and may still error.
+        """
+        options = dict(self.clusterer_options)
+        if self._options_defaulted and "n_init" in options:
+            if dataclasses.is_dataclass(c):
+                accepts = any(
+                    f.name == "n_init" for f in dataclasses.fields(c)
+                )
+            elif hasattr(c, "get_params"):
+                accepts = "n_init" in c.get_params()
+            elif isinstance(c, SklearnClusterer):
+                accepts = "n_init" in c.estimator.get_params()
+            else:
+                accepts = False
+            if not accepts:
+                options.pop("n_init")
+        return options
+
+    # -- fit -------------------------------------------------------------
+
+    def _accumulator_dtype(self):
+        """Reference dtype rule Q4, without the silent uint16 overflow."""
+        if self.n_iterations < 2**8:
+            return np.uint8
+        if self.n_iterations < 2**16:
+            return np.uint16
+        return np.uint32
+
+    def _resolve_store_matrices(self, n: int) -> bool:
+        if self.store_matrices == "auto":
+            n_k = len(tuple(self.K_range))
+            # stacked mij (int32) + cij (f32) on host
+            approx_bytes = 2 * n_k * n * n * 4
+            return approx_bytes < 2 * 2**30
+        return bool(self.store_matrices)
+
+    def fit(self, X):
+        """Run the consensus sweep; populates ``cdf_at_K_data`` and returns
+        self (reference contract, consensus_clustering_parallelised.py:92)."""
+        if self.random_state is None:
+            raise ValueError(
+                "random_state must be an integer seed: the resample plan is "
+                "a pure function of it (the reference's None default crashes "
+                "too, just less politely — SURVEY.md Q1)"
+            )
+        X = np.asarray(X)
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D, got shape {X.shape}")
+        n, d = X.shape
+
+        if self.compute_consensus_labels and not self._resolve_store_matrices(n):
+            raise ValueError(
+                "compute_consensus_labels=True needs the consensus matrices "
+                "(store_matrices is False, or 'auto' disabled them for this "
+                "N); pass store_matrices=True explicitly"
+            )
+
+        config = SweepConfig(
+            n_samples=n,
+            n_features=d,
+            k_values=tuple(self.K_range),
+            n_iterations=self.n_iterations,
+            subsampling=self.subsampling,
+            bins=self.bins,
+            pac_interval=self.PAC_interval,
+            parity_zeros=self.parity_zeros,
+            store_matrices=self._resolve_store_matrices(n),
+            chunk_size=self.chunk_size,
+            reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
+        )
+
+        ckpt = None
+        loaded = {}
+        missing = list(config.k_values)
+        if self.checkpoint_dir is not None:
+            from consensus_clustering_tpu.utils.checkpoint import (
+                SweepCheckpoint,
+            )
+
+            ckpt = SweepCheckpoint(
+                self.checkpoint_dir, config, self.random_state
+            )
+            for k in config.k_values:
+                entry = ckpt.load_k(k)
+                if entry is not None:
+                    loaded[k] = entry
+            missing = [k for k in config.k_values if k not in loaded]
+
+        out = None
+        if missing:
+            run_config = dataclasses.replace(
+                config, k_values=tuple(missing)
+            )
+            clusterer, is_host = self._resolve_clusterer()
+            if is_host:
+                from consensus_clustering_tpu.parallel.host import (
+                    run_host_sweep,
+                )
+
+                out = run_host_sweep(
+                    clusterer, run_config, X, self.random_state,
+                    progress=self.progress,
+                )
+            else:
+                from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+                out = run_sweep(
+                    clusterer, run_config, X, self.random_state,
+                    mesh=self.mesh,
+                )
+
+        self._build_results(out, config, missing, loaded, ckpt)
+
+        if self.plot_cdf:
+            from consensus_clustering_tpu.utils.plotting import plot_cdf
+
+            plot_cdf(self.cdf_at_K_data, self.PAC_interval)
+        return self
+
+    def _build_results(
+        self,
+        out: Optional[Dict[str, Any]],
+        config: SweepConfig,
+        fresh_ks: list,
+        loaded: Dict[int, Dict[str, np.ndarray]],
+        ckpt,
+    ):
+        acc_dtype = self._accumulator_dtype()
+        edges = _bin_edges(config.bins)
+
+        entries: Dict[int, dict] = {}
+        if out is not None:
+            iij = out["iij"].astype(acc_dtype)
+            for i, k in enumerate(fresh_ks):
+                entry = {
+                    "consensus_labels": [],
+                    "hist": out["hist"][i].astype(np.float64),
+                    "cdf": out["cdf"][i].astype(np.float64),
+                    "bin_edges": edges,
+                    "pac_area": float(out["pac_area"][i]),
+                }
+                if config.store_matrices:
+                    entry["mij"] = out["mij"][i].astype(acc_dtype)
+                    entry["iij"] = iij
+                    entry["cij"] = out["cij"][i]
+                else:
+                    entry["mij"] = entry["cij"] = entry["iij"] = None
+                entries[k] = entry
+                if ckpt is not None:
+                    ckpt.save_k(k, entry)
+        for k, saved in loaded.items():
+            entries[k] = {
+                "consensus_labels": [],
+                "hist": saved["hist"].astype(np.float64),
+                "cdf": saved["cdf"].astype(np.float64),
+                "bin_edges": edges,
+                "pac_area": float(saved["pac_area"]),
+                "mij": saved.get("mij"),
+                "iij": saved.get("iij"),
+                "cij": saved.get("cij"),
+            }
+
+        if self.compute_consensus_labels:
+            from consensus_clustering_tpu.models.agglomerative import (
+                consensus_labels_from_cij,
+            )
+
+            for k, entry in entries.items():
+                if entry["cij"] is not None:
+                    entry["consensus_labels"] = consensus_labels_from_cij(
+                        entry["cij"], k, linkage=self.agg_clustering_linkage
+                    )
+
+        self.cdf_at_K_data = {k: entries[k] for k in config.k_values}
+
+        self.areas_ = np.asarray(
+            [
+                area_under_cdf(self.cdf_at_K_data[k]["cdf"])
+                for k in config.k_values
+            ],
+            dtype=np.float64,
+        )
+        self.delta_k_ = delta_k(self.areas_)
+        pac = np.asarray(
+            [self.cdf_at_K_data[k]["pac_area"] for k in config.k_values]
+        )
+        # argmin PAC, breaking near-ties (several Ks perfectly stable, e.g.
+        # clean blobs where both K=2 and K=3 give PAC ~ 0) toward the largest
+        # such K: the finest partition that is still stable.
+        near_min = pac <= pac.min() + 1e-3
+        self.best_k_ = int(max(
+            k for k, hit in zip(config.k_values, near_min) if hit
+        ))
+        self.metrics_ = (
+            dict(out["timing"])
+            if out is not None
+            else {"compile_seconds": 0.0, "run_seconds": 0.0,
+                  "resamples_per_second": float("inf"),
+                  "resumed_from_checkpoint": True}
+        )

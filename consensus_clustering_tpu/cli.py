@@ -1,0 +1,149 @@
+"""Command-line sweep runner.
+
+The reference has no CLI (constructor kwargs only, SURVEY.md §5); this adds
+one for benchmarking and batch use:
+
+    python -m consensus_clustering_tpu run --dataset corr --k 2:15 \
+        --iterations 100 --seed 23 --out results.json
+    python -m consensus_clustering_tpu bench
+
+Results are written as JSON (PAC / CDF curves and stability statistics);
+matrices stay out of the JSON by design.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_k(spec: str):
+    if ":" in spec:
+        lo, hi = spec.split(":")
+        return tuple(range(int(lo), int(hi) + 1))
+    return tuple(int(v) for v in spec.split(","))
+
+
+def _load_dataset(name: str, n: int, d: int, seed: int):
+    import numpy as np
+
+    if name == "corr":
+        from consensus_clustering_tpu.data import load_corr
+
+        return load_corr(transform=True)
+    if name == "blobs":
+        from sklearn.datasets import make_blobs
+
+        x, _ = make_blobs(
+            n_samples=n, n_features=d, centers=8, cluster_std=3.0,
+            random_state=seed,
+        )
+        return x.astype(np.float32)
+    if name.endswith(".csv"):
+        import pandas as pd
+
+        return pd.read_csv(name, index_col=0).values.astype(np.float32)
+    raise SystemExit(f"unknown dataset {name!r} (corr | blobs | path.csv)")
+
+
+def _make_clusterer(name: str):
+    from consensus_clustering_tpu.models.agglomerative import (
+        AgglomerativeClustering,
+    )
+    from consensus_clustering_tpu.models.gmm import GaussianMixture
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.models.spectral import SpectralClustering
+
+    table = {
+        "kmeans": KMeans(),
+        "gmm": GaussianMixture(),
+        "agglomerative": AgglomerativeClustering(),
+        "spectral": SpectralClustering(),
+    }
+    if name not in table:
+        raise SystemExit(
+            f"unknown clusterer {name!r} (choose from {sorted(table)})"
+        )
+    return table[name]
+
+
+def cmd_run(args):
+    from consensus_clustering_tpu.api import ConsensusClustering
+
+    x = _load_dataset(args.dataset, args.n_samples, args.n_features, args.seed)
+    cc = ConsensusClustering(
+        clusterer=_make_clusterer(args.clusterer),
+        clusterer_options={} if args.clusterer != "kmeans" else {"n_init": 3},
+        K_range=_parse_k(args.k),
+        n_iterations=args.iterations,
+        subsampling=args.subsampling,
+        random_state=args.seed,
+        plot_cdf=False,
+        store_matrices=False,
+        checkpoint_dir=args.checkpoint_dir,
+        compute_consensus_labels=False,
+    )
+    t0 = time.perf_counter()
+    cc.fit(x)
+    wall = time.perf_counter() - t0
+
+    result = {
+        "dataset": args.dataset,
+        "shape": list(x.shape),
+        "clusterer": args.clusterer,
+        "K": sorted(cc.cdf_at_K_data),
+        "pac_area": {k: v["pac_area"] for k, v in cc.cdf_at_K_data.items()},
+        "areas": cc.areas_.tolist(),
+        "delta_k": cc.delta_k_.tolist(),
+        "best_k": cc.best_k_,
+        "metrics": cc.metrics_,
+        "wall_seconds": wall,
+    }
+    payload = json.dumps(result, indent=1, default=float)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload)
+        print(f"best_k={cc.best_k_}  -> {args.out}")
+    else:
+        print(payload)
+
+
+def cmd_bench(args):
+    del args
+    import bench  # repo-root benchmark; one-JSON-line contract
+
+    bench.main()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="consensus_clustering_tpu",
+        description="TPU-native consensus clustering",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run a consensus k-sweep")
+    run.add_argument("--dataset", default="corr",
+                     help="corr | blobs | path.csv")
+    run.add_argument("--clusterer", default="kmeans")
+    run.add_argument("--k", default="2:10", help="lo:hi or comma list")
+    run.add_argument("--iterations", type=int, default=100)
+    run.add_argument("--subsampling", type=float, default=0.8)
+    run.add_argument("--seed", type=int, default=23)
+    run.add_argument("--n-samples", type=int, default=5000)
+    run.add_argument("--n-features", type=int, default=50)
+    run.add_argument("--checkpoint-dir", default=None)
+    run.add_argument("--out", default=None)
+    run.set_defaults(fn=cmd_run)
+
+    bench_p = sub.add_parser("bench", help="run the benchmark harness")
+    bench_p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Static sweep configuration.
+
+The reference's config surface is 13 constructor kwargs
+(consensus_clustering_parallelised.py:21-36, SURVEY.md §2.2).  Here the
+static, shape-determining subset lives in a frozen dataclass that the sweep
+engine closes over at trace time; the sklearn-shaped facade
+(:mod:`consensus_clustering_tpu.api`) translates reference kwargs into it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from consensus_clustering_tpu.ops.analysis import pac_indices
+from consensus_clustering_tpu.ops.resample import subsample_size
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepConfig:
+    """Everything shape- or semantics-static about one consensus sweep.
+
+    Attributes:
+      n_samples: N, rows of X.
+      n_features: d, columns of X.
+      k_values: the K sweep, ascending (reference ``K_range``).
+      n_iterations: H, the resample count (reference ``n_iterations``).
+      subsampling: fraction of rows per resample (reference ``subsampling``).
+      bins: histogram bins for the consensus CDF (reference hard-codes 20).
+      pac_interval: (u1, u2) for the PAC score (reference ``PAC_interval``).
+      parity_zeros: reproduce the reference's zero-inflated histogram
+        (quirk Q6); False gives the corrected pairs-only density.
+      store_matrices: keep per-K Mij/Cij in the result (the reference always
+        does; for large N these are the dominant HBM/host cost, so the
+        facade may auto-disable).
+      chunk_size: resamples per accumulation GEMM (see ops.coassoc).
+      reseed_clusterer_per_resample: False (default) re-seeds the inner
+        clusterer identically for every resample — the reference's semantics
+        (a fixed integer ``random_state`` makes every sklearn fit draw the
+        same init stream, consensus_clustering_parallelised.py:212), which
+        correlates local optima across resamples and measurably deflates PAC
+        for multi-optimum clusterers like full-covariance GMMs.  True gives
+        every resample an independent init stream (honest resampling
+        variance; documented divergence).
+    """
+
+    n_samples: int
+    n_features: int
+    k_values: Tuple[int, ...] = (2, 3)
+    n_iterations: int = 25
+    subsampling: float = 0.8
+    bins: int = 20
+    pac_interval: Tuple[float, float] = (0.1, 0.9)
+    parity_zeros: bool = True
+    store_matrices: bool = True
+    chunk_size: int = 8
+    reseed_clusterer_per_resample: bool = False
+
+    def __post_init__(self):
+        if not self.k_values:
+            raise ValueError("k_values must be non-empty")
+        if any(k < 1 for k in self.k_values):
+            raise ValueError(f"k_values must be >= 1, got {self.k_values}")
+        if not 0.0 < self.subsampling <= 1.0:
+            raise ValueError(
+                f"subsampling must be in (0, 1], got {self.subsampling}"
+            )
+        if self.n_sub < 1:
+            raise ValueError(
+                f"subsampling {self.subsampling} of {self.n_samples} samples "
+                "leaves an empty subsample"
+            )
+        if self.k_max > self.n_sub:
+            raise ValueError(
+                f"max K {self.k_max} exceeds subsample size {self.n_sub}"
+            )
+
+    @property
+    def n_sub(self) -> int:
+        return subsample_size(self.n_samples, self.subsampling)
+
+    @property
+    def k_max(self) -> int:
+        return max(self.k_values)
+
+    @property
+    def pac_idx(self) -> Tuple[int, int]:
+        return pac_indices(self.pac_interval, self.bins)

@@ -1,0 +1,34 @@
+"""Bundled dataset loader.
+
+The reference ships a 29x29 correlation matrix (`corr.csv`) used by its demo
+notebook as the feature matrix after a PowerTransform
+(consensus clustering.ipynb cells 2-3).  The same file is bundled here.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def load_corr(transform: bool = False) -> np.ndarray:
+    """Load the bundled 29x29 correlation dataset.
+
+    Args:
+      transform: apply the notebook's ``PowerTransformer`` preprocessing.
+
+    Returns:
+      (29, 29) float32 array.
+    """
+    import pandas as pd
+
+    df = pd.read_csv(os.path.join(_DATA_DIR, "corr.csv"), index_col=0)
+    x = df.values.astype(np.float64)
+    if transform:
+        from sklearn.preprocessing import PowerTransformer
+
+        x = PowerTransformer().fit_transform(x)
+    return x.astype(np.float32)

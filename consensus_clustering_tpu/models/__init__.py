@@ -1,0 +1,14 @@
+"""Inner clusterer plugins: the pluggable-estimator layer of the framework.
+
+The reference accepts any sklearn estimator with ``fit_predict`` plus an
+``n_clusters`` or ``n_components`` attribute
+(consensus_clustering_parallelised.py:201-214).  Here the native plugins are
+pure-JAX clusterers implementing :class:`JaxClusterer` (traceable, vmappable
+over resamples, padded-K aware so the whole K sweep compiles once), and
+:class:`SklearnClusterer` adapts arbitrary sklearn estimators via the host
+execution backend.
+"""
+
+from consensus_clustering_tpu.models.protocol import JaxClusterer, HostClusterer
+
+__all__ = ["JaxClusterer", "HostClusterer"]

@@ -1,0 +1,165 @@
+"""JAX-native agglomerative (hierarchical) clustering.
+
+Serves two roles the reference assigns to sklearn's
+``AgglomerativeClustering``:
+
+- an inner clusterer plugin (BASELINE.json config #4 runs agglomerative on
+  corr.csv under the sweep), via :class:`AgglomerativeClustering`;
+- consensus-label extraction from the consensus matrix — the reference's
+  disabled code path (consensus_clustering_parallelised.py:292-314, quirk
+  Q5) — via :func:`consensus_labels_from_cij`, done properly on the
+  dissimilarity ``1 - Cij`` instead of treating ``Cij`` as coordinates.
+
+Design: classic Lance-Williams agglomeration over a dense distance matrix
+with *static shapes* — a ``fori_loop`` runs exactly ``n - 1`` merges; at each
+step the surviving labelling is snapshotted when the active-cluster count
+equals the traced ``k``, so the same compiled program serves every K in the
+sweep.  O(n^3) elementwise work on an (n, n) matrix: fully vectorised,
+fused by XLA, and exact — appropriate for subsample sizes up to a few
+thousand (the reference's own sklearn path has the same asymptotics).
+
+Linkages: single / complete / average / ward, all as Lance-Williams updates
+(ward on squared Euclidean distances, as standard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+_BIG = jnp.float32(3.4e38)
+
+
+def _lance_williams(
+    linkage: str,
+    d_il: jax.Array,
+    d_jl: jax.Array,
+    d_ij: jax.Array,
+    n_i: jax.Array,
+    n_j: jax.Array,
+    n_l: jax.Array,
+) -> jax.Array:
+    """Distance from the merged cluster (i u j) to every other cluster l."""
+    if linkage == "single":
+        return jnp.minimum(d_il, d_jl)
+    if linkage == "complete":
+        return jnp.maximum(d_il, d_jl)
+    if linkage == "average":
+        return (n_i * d_il + n_j * d_jl) / (n_i + n_j)
+    if linkage == "ward":
+        tot = n_i + n_j + n_l
+        return (
+            (n_i + n_l) * d_il + (n_j + n_l) * d_jl - n_l * d_ij
+        ) / tot
+    raise ValueError(f"unknown linkage {linkage!r}")
+
+
+def agglomerate(
+    dist: jax.Array, k: jax.Array, k_max: int, linkage: str = "average"
+) -> jax.Array:
+    """Cut a Lance-Williams agglomeration of ``dist`` at ``k`` clusters.
+
+    Args:
+      dist: (n, n) symmetric dissimilarity matrix (squared Euclidean for
+        ward).
+      k: traced int32 target cluster count, 1 <= k <= n.
+      k_max: static bound on k (labels are guaranteed < k <= k_max).
+      linkage: single | complete | average | ward.
+
+    Returns:
+      (n,) int32 labels in [0, k), numbered by ascending representative
+      index (deterministic).
+    """
+    del k_max  # shapes do not depend on it; kept for protocol symmetry
+    n = dist.shape[0]
+    k = jnp.asarray(k, jnp.int32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    # Self-distances (and later, dead rows) are masked with +BIG so argmin
+    # only ever sees live cluster pairs.
+    d0 = jnp.where(jnp.eye(n, dtype=bool), _BIG, dist.astype(jnp.float32))
+    state0 = dict(
+        d=d0,
+        active=jnp.ones((n,), bool),
+        sizes=jnp.ones((n,), jnp.float32),
+        rep=idx,           # cluster representative of each point
+        snapshot=jnp.zeros((n,), jnp.int32),
+    )
+
+    def merge(t, state):
+        d = state["d"]
+        # Snapshot the labelling *before* this merge if n - t == k.
+        take = (n - t) == k
+        snapshot = jnp.where(take, _labels(state["rep"], state["active"]), state["snapshot"])
+
+        flat = jnp.argmin(d)
+        i, j = jnp.minimum(flat // n, flat % n), jnp.maximum(flat // n, flat % n)
+        n_i, n_j = state["sizes"][i], state["sizes"][j]
+        new_row = _lance_williams(
+            linkage, d[i], d[j], d[i, j], n_i, n_j, state["sizes"]
+        )
+        # Merge j into i: i's row/col take the updated distances, j dies.
+        alive = state["active"].at[j].set(False)
+        new_row = jnp.where(alive, new_row, _BIG).at[i].set(_BIG)
+        d = d.at[i, :].set(new_row).at[:, i].set(new_row)
+        d = d.at[j, :].set(_BIG).at[:, j].set(_BIG)
+        sizes = state["sizes"].at[i].add(n_j)
+        rep = jnp.where(state["rep"] == state["rep"][j], state["rep"][i], state["rep"])
+        return dict(d=d, active=alive, sizes=sizes, rep=rep, snapshot=snapshot)
+
+    state = jax.lax.fori_loop(0, n - 1, merge, state0)
+    # k == 1 is the post-loop state (everything merged).
+    return jnp.where(k == 1, _labels(state["rep"], state["active"]), state["snapshot"])
+
+
+def _labels(rep: jax.Array, active: jax.Array) -> jax.Array:
+    """Renumber representatives to dense [0, n_active) by ascending index."""
+    order = jnp.cumsum(active.astype(jnp.int32)) - 1
+    return order[rep].astype(jnp.int32)
+
+
+def pairwise_sq_euclidean(x: jax.Array) -> jax.Array:
+    sq = jnp.sum(x * x, axis=1)
+    cross = jnp.matmul(x, x.T, precision=jax.lax.Precision.HIGHEST)
+    return jnp.maximum(sq[:, None] - 2.0 * cross + sq[None, :], 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class AgglomerativeClustering:
+    """Hierarchical inner clusterer implementing :class:`JaxClusterer`.
+
+    ``linkage`` defaults to ward like sklearn's estimator; ward operates on
+    squared Euclidean distances, the others on Euclidean.
+    """
+
+    linkage: str = "ward"
+
+    def fit_predict(
+        self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
+    ) -> jax.Array:
+        del key  # deterministic
+        x = x.astype(jnp.float32)
+        d = pairwise_sq_euclidean(x)
+        if self.linkage != "ward":
+            d = jnp.sqrt(d)
+        return agglomerate(d, k, k_max, self.linkage)
+
+
+def consensus_labels_from_cij(
+    cij, k: int, linkage: str = "average"
+):
+    """Consensus labels: agglomerate the dissimilarity 1 - Cij (quirk Q5).
+
+    The reference's dead code ran AgglomerativeClustering with manhattan
+    affinity on Cij-as-features (and crashes on modern sklearn); clustering
+    the consensus *dissimilarity* directly is the textbook Monti et al.
+    procedure, offered opt-in.
+    """
+    import numpy as np
+
+    cij = jnp.asarray(cij, jnp.float32)
+    d = 1.0 - cij
+    labels = agglomerate(d, jnp.int32(k), int(k), linkage)
+    return np.asarray(labels)

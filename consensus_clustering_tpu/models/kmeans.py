@@ -1,0 +1,183 @@
+"""JAX-native KMeans: k-means++ init, Lloyd iterations, best-of-n_init.
+
+The TPU replacement for the reference's default ``sklearn.cluster.KMeans``
+inner clusterer (consensus_clustering_parallelised.py:88-90, used in the hot
+loop at :282).  Design points (SURVEY.md §7.2 step 2, §7.3):
+
+- **Padded K**: the cluster count ``k`` is a *traced* scalar bounded by
+  static ``k_max``; centroid slots ``>= k`` are masked out of assignment,
+  init and updates, so a whole K sweep runs through one compilation.
+- **MXU-friendly Lloyd**: assignment distances are ``|x|^2 - 2 x.c + |c|^2``
+  (one (n_sub, d) x (d, k_max) GEMM per iteration) and centroid updates are
+  one-hot GEMMs (``A^T x`` / ``A^T 1``), not segment scatters.
+- **Fixed shapes, bounded loop**: ``lax.while_loop`` on (shift > tol and
+  iter < max_iter), which vmaps cleanly over resamples and n_init restarts.
+- **Restarts**: ``n_init`` independent k-means++ seedings run in a vmapped
+  batch; the restart with the lowest inertia wins (mirrors sklearn's
+  best-of-n_init semantics that the reference's default
+  ``clusterer_options={'n_init': 3}`` relies on).
+- **Empty clusters** keep their previous centroid (sklearn instead respawns
+  them from far points; documented divergence, only reachable on degenerate
+  subsamples).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_INF = jnp.float32(jnp.inf)
+
+
+def _pairwise_sqdist(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    """(n, k_max) squared Euclidean distances via one MXU GEMM.
+
+    Full-f32 precision: the TPU default (bf16 inputs) costs ~1e-2 absolute
+    error on the cross term, enough to flip boundary assignments; HIGHEST
+    keeps the MXU but runs the 3-pass bf16 decomposition.  Clamped at zero:
+    the expansion |x|^2 - 2 x.c + |c|^2 can go slightly negative in f32.
+    """
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    c_sq = jnp.sum(centroids * centroids, axis=1)
+    cross = jnp.matmul(x, centroids.T, precision=jax.lax.Precision.HIGHEST)
+    return jnp.maximum(x_sq - 2.0 * cross + c_sq, 0.0)
+
+
+def _kmeanspp_init(
+    key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
+) -> jax.Array:
+    """Greedy k-means++ seeding with slots >= k left at the first centre.
+
+    Like sklearn's default: each step draws ``2 + ceil(log(k_max))``
+    candidate centres ~ D^2 and keeps the one minimising the total potential
+    sum(min(D^2, d(x, cand)^2)) — markedly more consistent inits than
+    single-trial k-means++, which matters for consensus stability.
+
+    Slot j for j >= k duplicates slot 0; it is masked out of assignment by
+    the caller, so its value only needs to be finite.
+    """
+    import math
+
+    n = x.shape[0]
+    n_trials = 2 + int(math.ceil(math.log(max(k_max, 2))))
+    key0, key_rest = jax.random.split(key)
+    first = jax.random.randint(key0, (), 0, n)
+    centroids0 = jnp.broadcast_to(x[first], (k_max, x.shape[1]))
+    d2_0 = jnp.sum((x - x[first]) ** 2, axis=1)
+
+    def body(j, carry):
+        centroids, d2 = carry
+        kj = jax.random.fold_in(key_rest, j)
+        # Candidates ~ D^2 via Gumbel-max on log D^2; points already chosen
+        # have D^2 = 0 -> -inf logit -> never re-chosen.
+        logits = jnp.log(jnp.maximum(d2, 1e-30))
+        logits = jnp.where(d2 > 0, logits, -_INF)
+        cand_idx = jax.random.categorical(kj, logits, shape=(n_trials,))
+        cand = x[cand_idx]  # (T, dim)
+        # Potential of each candidate: sum_i min(d2_i, |x_i - cand|^2).
+        cand_d2 = jnp.sum(
+            (x[None, :, :] - cand[:, None, :]) ** 2, axis=-1
+        )  # (T, n)
+        pooled = jnp.minimum(cand_d2, d2[None, :])
+        best = jnp.argmin(jnp.sum(pooled, axis=1))
+        new_c = cand[best]
+        take = j < k  # slots >= k keep the duplicate of slot 0
+        centroids = centroids.at[j].set(
+            jnp.where(take, new_c, centroids[j])
+        )
+        d2 = jnp.where(take, pooled[best], d2)
+        return centroids, d2
+
+    centroids, _ = jax.lax.fori_loop(1, k_max, body, (centroids0, d2_0))
+    return centroids
+
+
+@dataclasses.dataclass(frozen=True)
+class KMeans:
+    """Pure-JAX KMeans implementing the :class:`JaxClusterer` protocol.
+
+    Args mirror sklearn's: ``n_init`` restarts (best inertia wins),
+    ``max_iter`` Lloyd cap, ``tol`` relative centre-shift tolerance
+    (normalised by the mean per-feature variance of the subsample, like
+    sklearn's ``_tolerance``).
+    """
+
+    n_init: int = 1
+    max_iter: int = 100
+    tol: float = 1e-4
+
+    def fit_predict(
+        self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
+    ) -> jax.Array:
+        labels, _ = self.fit(key, x, k, k_max)
+        return labels
+
+    def fit(
+        self,
+        key: jax.Array,
+        x: jax.Array,
+        k: jax.Array,
+        k_max: Optional[int] = None,
+    ):
+        """Run best-of-n_init KMeans; returns (labels, centroids)."""
+        if k_max is None:
+            k_max = int(k)
+        x = x.astype(jnp.float32)
+        k = jnp.asarray(k, jnp.int32)
+        valid = jnp.arange(k_max, dtype=jnp.int32) < k
+
+        tol_abs = self.tol * jnp.mean(jnp.var(x, axis=0))
+
+        def one_restart(rkey):
+            centroids = _kmeanspp_init(rkey, x, k, k_max)
+
+            def masked_dist(c):
+                d = _pairwise_sqdist(x, c)
+                return jnp.where(valid[None, :], d, _INF)
+
+            def cond(state):
+                _, shift, it = state
+                return jnp.logical_and(shift > tol_abs, it < self.max_iter)
+
+            def body(state):
+                centroids, _, it = state
+                d = masked_dist(centroids)
+                labels = jnp.argmin(d, axis=1)
+                # One-hot GEMM update: sums = A^T x, counts = A^T 1.
+                a = (
+                    labels[:, None]
+                    == jnp.arange(k_max, dtype=labels.dtype)[None, :]
+                ).astype(jnp.float32)
+                counts = jnp.sum(a, axis=0)
+                sums = jax.lax.dot_general(
+                    a, x, (((0,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32,
+                )
+                keep = (counts > 0) & valid
+                new_centroids = jnp.where(
+                    keep[:, None],
+                    sums / jnp.maximum(counts, 1.0)[:, None],
+                    centroids,
+                )
+                shift = jnp.sum((new_centroids - centroids) ** 2)
+                return new_centroids, shift, it + 1
+
+            init = (centroids, _INF, jnp.int32(0))
+            centroids, _, _ = jax.lax.while_loop(cond, body, init)
+            d = masked_dist(centroids)
+            labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+            inertia = jnp.sum(jnp.min(d, axis=1))
+            return labels, centroids, inertia
+
+        if self.n_init == 1:
+            labels, centroids, _ = one_restart(key)
+            return labels, centroids
+
+        keys = jax.random.split(key, self.n_init)
+        labels_b, centroids_b, inertia_b = jax.vmap(one_restart)(keys)
+        best = jnp.argmin(inertia_b)
+        return labels_b[best], centroids_b[best]

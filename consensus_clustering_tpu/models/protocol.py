@@ -1,0 +1,57 @@
+"""Clusterer plugin protocols.
+
+Two kinds of inner clusterer:
+
+- :class:`JaxClusterer` — pure-JAX, traceable: runs *inside* the compiled
+  sweep, vmapped over resamples and scanned over K.  The cluster count ``k``
+  is a traced scalar bounded by static ``k_max`` so one compilation covers
+  the whole K sweep (SURVEY.md §7.3 "K-sweep under jit": padding + masking is
+  the idiomatic choice).
+- :class:`HostClusterer` — anything that can only label a subsample on the
+  host (e.g. an arbitrary sklearn estimator).  The sweep engine falls back to
+  the host execution backend: labels are produced on CPU per resample, then
+  the accumulation/analysis still runs on device.
+
+This mirrors the reference's duck-typed plugin surface
+(consensus_clustering_parallelised.py:201-214) with explicit protocols
+instead of attribute sniffing.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import jax
+import numpy as np
+
+
+@runtime_checkable
+class JaxClusterer(Protocol):
+    """A traceable clusterer usable inside the compiled sweep."""
+
+    def fit_predict(
+        self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
+    ) -> jax.Array:
+        """Cluster one subsample.
+
+        Args:
+          key: PRNG key for this (resample, K) cell.
+          x: (n_sub, d) subsample.
+          k: traced int32 number of clusters, 1 <= k <= k_max.
+          k_max: static upper bound (one-hot height in the accumulator).
+
+        Returns:
+          (n_sub,) int32 labels in [0, k).
+        """
+        ...
+
+
+@runtime_checkable
+class HostClusterer(Protocol):
+    """A host-side clusterer; engages the host execution backend."""
+
+    def fit_predict_host(
+        self, seed: int, x: np.ndarray, k: int
+    ) -> np.ndarray:
+        """Cluster one subsample on host; returns (n_sub,) int labels."""
+        ...

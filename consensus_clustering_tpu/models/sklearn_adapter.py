@@ -1,0 +1,66 @@
+"""Host adapter for arbitrary sklearn-compatible estimators.
+
+The reference accepts any estimator exposing ``fit_predict`` plus an
+``n_clusters`` or ``n_components`` attribute, configured in place via
+``set_params`` (consensus_clustering_parallelised.py:201-214).  This adapter
+preserves that plugin surface: the estimator runs on the host (it cannot be
+traced), while resampling, accumulation and analysis stay on device via the
+host execution backend (:mod:`consensus_clustering_tpu.parallel.host`).
+
+Unlike the reference — which mutates and fits *one shared* estimator
+instance concurrently from worker threads (quirk Q3) — each call clones the
+estimator, so the adapter is reentrant by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class SklearnClusterer:
+    """Wrap an sklearn estimator as a :class:`HostClusterer`.
+
+    Duck-typing mirrors the reference: ``n_clusters`` (KMeans,
+    AgglomerativeClustering, SpectralClustering) or ``n_components``
+    (GaussianMixture); anything else raises AttributeError with the
+    reference's message semantics.
+    """
+
+    def __init__(self, estimator: Any, options: Optional[Dict[str, Any]] = None):
+        if not hasattr(estimator, "fit_predict"):
+            raise AttributeError(
+                f"{type(estimator).__name__} has no fit_predict method"
+            )
+        if not (
+            hasattr(estimator, "n_clusters")
+            or hasattr(estimator, "n_components")
+        ):
+            raise AttributeError(
+                "clusterer has neither n_clusters nor n_components attribute"
+            )
+        self.estimator = estimator
+        self.options = dict(options or {})
+
+    def _configure(self, seed: int, k: int):
+        from sklearn.base import clone
+
+        est = clone(self.estimator)
+        if hasattr(est, "n_clusters"):
+            est.n_clusters = k
+        else:
+            est.n_components = k
+        params = dict(self.options)
+        if "random_state" in est.get_params():
+            params["random_state"] = seed
+        if params:
+            est.set_params(**params)
+        return est
+
+    def fit_predict_host(
+        self, seed: int, x: np.ndarray, k: int
+    ) -> np.ndarray:
+        est = self._configure(seed, k)
+        labels = est.fit_predict(x)
+        return np.asarray(labels, dtype=np.int32)

@@ -1,0 +1,80 @@
+"""JAX-native spectral clustering inner clusterer.
+
+Covers BASELINE.json config #5 (spectral inner clusterer under the sweep) as
+a :class:`JaxClusterer`: RBF (or precomputed) affinity -> symmetric
+normalised graph Laplacian -> spectral embedding -> KMeans on the embedding.
+
+Padded-K handling: the embedding keeps the static ``k_max`` leading
+eigenvectors but zeroes columns ``>= k``, so the downstream KMeans sees a
+k-dimensional problem inside a k_max-wide buffer and the whole sweep still
+compiles once.  ``jnp.linalg.eigh`` is a dense full decomposition — exact
+and batched-friendly (it vmaps over resamples); appropriate up to a few
+thousand points per subsample.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from consensus_clustering_tpu.models.kmeans import KMeans
+
+
+def rbf_affinity(x: jax.Array, gamma: Optional[float] = None) -> jax.Array:
+    """exp(-gamma ||xi - xj||^2); gamma defaults to 1.0 like sklearn."""
+    from consensus_clustering_tpu.models.agglomerative import (
+        pairwise_sq_euclidean,
+    )
+
+    if gamma is None:
+        gamma = 1.0
+    return jnp.exp(-gamma * pairwise_sq_euclidean(x))
+
+
+@dataclasses.dataclass(frozen=True)
+class SpectralClustering:
+    """Spectral inner clusterer implementing :class:`JaxClusterer`.
+
+    ``affinity``: 'rbf' (on subsample features) or 'precomputed' (X rows are
+    affinity rows — for the reference-style workflow where the input is
+    itself an affinity/correlation matrix).  ``gamma`` as sklearn.
+    ``n_init`` forwards to the embedding-space KMeans.
+    """
+
+    affinity: str = "rbf"
+    gamma: Optional[float] = None
+    n_init: int = 3
+
+    def fit_predict(
+        self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
+    ) -> jax.Array:
+        x = x.astype(jnp.float32)
+        if self.affinity == "rbf":
+            a = rbf_affinity(x, self.gamma)
+        elif self.affinity == "precomputed":
+            a = x
+        else:
+            raise ValueError(f"unknown affinity {self.affinity!r}")
+
+        # Symmetric normalised adjacency: D^-1/2 A D^-1/2.  Its *top* k
+        # eigenvectors are the bottom-k of the normalised Laplacian.
+        deg = jnp.sum(a, axis=1)
+        inv_sqrt = jax.lax.rsqrt(jnp.maximum(deg, 1e-12))
+        a_norm = a * inv_sqrt[:, None] * inv_sqrt[None, :]
+        # eigh is ascending: the last k_max columns are the top ones.
+        _, vecs = jnp.linalg.eigh(a_norm)
+        emb = vecs[:, ::-1][:, :k_max]  # (n, k_max), leading first
+
+        # Diffusion-style scaling (recover D^-1/2 row geometry), then mask
+        # columns >= k and row-normalise — the embedding KMeans then sees
+        # only the k live coordinates.
+        emb = emb * inv_sqrt[:, None]
+        col_valid = jnp.arange(k_max, dtype=jnp.int32) < k
+        emb = jnp.where(col_valid[None, :], emb, 0.0)
+        norms = jnp.linalg.norm(emb, axis=1, keepdims=True)
+        emb = emb / jnp.maximum(norms, 1e-12)
+
+        return KMeans(n_init=self.n_init).fit_predict(key, emb, k, k_max)

@@ -43,20 +43,29 @@ def _binned_counts(
 ) -> jax.Array:
     """Masked histogram counts over [0, 1] with the last bin right-closed.
 
-    Matches ``np.histogram(range=(0, 1))`` binning: value v lands in
-    ``min(floor(v * bins), bins - 1)``.  Computed as a broadcast equality
-    reduction (no scatter, no gather) so XLA lowers it to fused vector ops.
+    Bit-compatible with ``np.histogram(range=(0, 1))``: membership is tested
+    directly against the bin edges (``edges[b] <= v < edges[b+1]``, last bin
+    right-closed), not via ``floor(v * bins)`` — the f32 product rounds
+    values one ulp below an edge (e.g. cij = 6/40 -> f32 0.14999999) into
+    the wrong bin.  Comparing against f32-rounded f64 edges is exact for f32
+    inputs: no f32 value lies strictly between an f64 edge and its nearest
+    f32 (rounding-to-nearest would contradict itself), so every comparison
+    agrees with NumPy's f64 one.  Computed as a broadcast interval-membership
+    reduction (no scatter, no gather) that XLA fuses into one pass.
     """
-    bin_ids = jnp.clip(
-        jnp.floor(values * bins).astype(jnp.int32), 0, bins - 1
+    edges = jnp.asarray(
+        np.linspace(0.0, 1.0, bins + 1).astype(np.float32)
     )
-    one_hot = (
-        bin_ids[None, :, :] == jnp.arange(bins, dtype=jnp.int32)[:, None, None]
-    )
+    lo = edges[:-1][:, None, None]
+    hi = edges[1:][:, None, None]
+    v = values[None, :, :]
+    in_bin = (v >= lo) & (v < hi)
+    # np.histogram's last bin includes the right edge.
+    in_bin = in_bin.at[-1].set((v[0] >= edges[-2]) & (v[0] <= edges[-1]))
     # int32 accumulation: counts reach N^2 (1e8 at N=10k), beyond f32's 2^24
     # exact-integer range.
     return jnp.sum(
-        (one_hot & mask[None, :, :]).astype(jnp.int32), axis=(1, 2)
+        (in_bin & mask[None, :, :]).astype(jnp.int32), axis=(1, 2)
     )
 
 

@@ -1,0 +1,6 @@
+"""Execution layer: device meshes and the compiled, sharded k-sweep."""
+
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.sweep import build_sweep, run_sweep
+
+__all__ = ["resample_mesh", "build_sweep", "run_sweep"]

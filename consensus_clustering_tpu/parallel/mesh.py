@@ -1,0 +1,44 @@
+"""Device mesh construction.
+
+The reference's execution backends are joblib threads/processes on one host
+(consensus_clustering_parallelised.py:162-199).  The TPU equivalent is a
+``jax.sharding.Mesh``: the resample axis ``'h'`` is the data-parallel axis
+(each chip owns H/D resamples and partial co-association counts ride ICI via
+``psum``), and the optional ``'n'`` axis shards the N x N consensus matrix
+rows for large-N runs (the long-context analog, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+RESAMPLE_AXIS = "h"
+ROW_AXIS = "n"
+
+
+def resample_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    row_shards: int = 1,
+) -> Mesh:
+    """Build an ('h', 'n') mesh over the given (default: all) devices.
+
+    ``row_shards`` devices shard consensus-matrix rows; the rest go to the
+    resample axis.  With one device this degenerates to a trivial 1x1 mesh,
+    which is also the single-chip path — there is no separate unsharded code
+    path to keep correct.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n_dev = len(devices)
+    if n_dev % row_shards != 0:
+        raise ValueError(
+            f"{n_dev} devices not divisible by row_shards={row_shards}"
+        )
+    import numpy as np
+
+    grid = np.asarray(devices).reshape(n_dev // row_shards, row_shards)
+    return Mesh(grid, (RESAMPLE_AXIS, ROW_AXIS))

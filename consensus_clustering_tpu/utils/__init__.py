@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: progress, plotting, logging, checkpointing."""

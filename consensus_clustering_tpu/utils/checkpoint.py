@@ -1,0 +1,97 @@
+"""Per-K checkpoint / resume for the consensus sweep.
+
+The reference has no checkpointing (its memmap files are overwritten, never
+resumed — SURVEY.md §5).  Here each completed K saves an npz with the exact
+accumulators (Mij, Iij) plus the analysis curves, keyed by a fingerprint of
+everything that determines them (seed + the semantics-bearing SweepConfig
+fields).  A resumed fit skips completed Ks — only the missing Ks are
+compiled and run — and refuses to mix checkpoints from a different
+config/seed (the fingerprint changes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from consensus_clustering_tpu.config import SweepConfig
+
+_META = "sweep_meta.json"
+
+
+def _fingerprint(config: SweepConfig, seed: int) -> str:
+    payload = dataclasses.asdict(config)
+    payload["seed"] = seed
+    # k_values don't invalidate other Ks' checkpoints: each K's result is
+    # independent of which siblings ran (resample plan is K-free, quirk Q8).
+    payload.pop("k_values")
+    payload.pop("store_matrices")
+    # chunk_size only shapes the accumulation GEMMs; counts are exact
+    # integers either way, so it must not invalidate checkpoints.
+    payload.pop("chunk_size")
+    blob = json.dumps(payload, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class SweepCheckpoint:
+    """Directory of per-K npz checkpoints with a config fingerprint."""
+
+    def __init__(self, directory: str, config: SweepConfig, seed: int):
+        self.directory = directory
+        self.fp = _fingerprint(config, seed)
+        os.makedirs(directory, exist_ok=True)
+        meta_path = os.path.join(directory, _META)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                existing = json.load(f)
+            if existing.get("fingerprint") != self.fp:
+                raise ValueError(
+                    f"checkpoint dir {directory} belongs to a different "
+                    "sweep (config/seed fingerprint mismatch: "
+                    f"{existing.get('fingerprint')} != {self.fp}); use a "
+                    "fresh directory"
+                )
+        else:
+            with open(meta_path, "w") as f:
+                json.dump(
+                    {
+                        "fingerprint": self.fp,
+                        "config": dataclasses.asdict(config),
+                        "seed": seed,
+                    },
+                    f, indent=1,
+                )
+
+    def _path(self, k: int) -> str:
+        return os.path.join(self.directory, f"k{k:04d}.npz")
+
+    def completed_ks(self) -> list:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("k") and name.endswith(".npz"):
+                out.append(int(name[1:-4]))
+        return sorted(out)
+
+    def save_k(self, k: int, entry: Dict[str, np.ndarray]):
+        arrays = {
+            name: np.asarray(val)
+            for name, val in entry.items()
+            if val is not None and name != "consensus_labels"
+        }
+        # np.savez appends ".npz" when missing, so the temp name must end
+        # with it for os.replace to find the file it wrote.
+        tmp = self._path(k) + ".tmp.npz"
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, self._path(k))  # atomic: no torn checkpoints
+
+    def load_k(self, k: int) -> Optional[Dict[str, np.ndarray]]:
+        path = self._path(k)
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as z:
+            return {name: z[name] for name in z.files}

@@ -1,0 +1,44 @@
+"""CDF plotting, matching the reference's figure semantics.
+
+Reference (consensus_clustering_parallelised.py:389-410): one 4x4in/120dpi
+figure, one CDF curve per K with a 0 prepended so curves start at the origin,
+dashed vlines at the PAC interval, legend 'K: <k>'.  matplotlib is imported
+lazily so headless/benchmark runs never pay for it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+
+def plot_cdf(
+    cdf_at_K_data: Dict[int, dict],
+    pac_interval: Tuple[float, float] = (0.1, 0.9),
+    show: bool = True,
+    save_path: str | None = None,
+):
+    import matplotlib
+
+    if not show:
+        matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=(4, 4), dpi=120)
+
+    for k, data in cdf_at_K_data.items():
+        x = data["bin_edges"]
+        y = [0] + [v for v in data["cdf"]]
+        plt.plot(x, y, marker="o", markersize=2.5, label=f"K: {k}",
+                 linewidth=2.0)
+
+    plt.vlines(pac_interval, *plt.ylim(), colors="k", linestyles="dashed",
+               lw=1.5)
+    plt.xlabel("consensus index value")
+    plt.ylabel("CDF")
+    plt.legend()
+    plt.tight_layout()
+    if save_path:
+        fig.savefig(save_path)
+    if show:
+        plt.show()
+    return fig

@@ -1,0 +1,20 @@
+"""Host-side progress reporting.
+
+The reference wraps the resample iterator in tqdm with a per-K description
+(consensus_clustering_parallelised.py:115-116); same surface here, degrading
+to a plain iterator when tqdm is unavailable or progress is disabled.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def progress_iter(it: Iterable, desc: str, enabled: bool = True) -> Iterable:
+    if not enabled:
+        return it
+    try:
+        from tqdm import tqdm
+    except ImportError:
+        return it
+    return tqdm(it, desc=desc)

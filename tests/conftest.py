@@ -6,12 +6,19 @@ real-TPU runs come from bench.py / the driver, not the unit suite.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# A sitecustomize module may have force-registered a TPU plugin and set
+# jax_platforms programmatically (overriding the env var), so pin the config
+# explicitly before any backend initialises.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
@@ -37,11 +44,6 @@ def blobs():
 def corr_data():
     """The bundled 29x29 correlation dataset, PowerTransformed like the
     reference notebook (consensus clustering.ipynb cells 2-3)."""
-    import pandas as pd
-    from sklearn.preprocessing import PowerTransformer
+    from consensus_clustering_tpu import load_corr
 
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "consensus_clustering_tpu", "data", "corr.csv"
-    )
-    df = pd.read_csv(path, index_col=0)
-    return PowerTransformer().fit_transform(df.values).astype(np.float32)
+    return load_corr(transform=True)

@@ -1,0 +1,203 @@
+"""API facade: reference-compatible surface, result schema, quirk handling."""
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu import ConsensusClustering, KMeans
+from consensus_clustering_tpu.models.sklearn_adapter import SklearnClusterer
+
+RESULT_KEYS = {
+    "consensus_labels", "hist", "cdf", "bin_edges", "pac_area",
+    "mij", "iij", "cij",
+}
+
+
+class TestResultSchema:
+    @pytest.fixture(scope="class")
+    def fitted(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=range(2, 5), random_state=7, n_iterations=10,
+            plot_cdf=False,
+        )
+        return cc.fit(x)
+
+    def test_result_dict_keys(self, fitted):
+        assert set(fitted.cdf_at_K_data) == {2, 3, 4}
+        for k, entry in fitted.cdf_at_K_data.items():
+            assert set(entry) == RESULT_KEYS
+
+    def test_reference_dtypes(self, fitted):
+        # Q4: H=10 < 256 -> uint8 accumulators; cij float32; hist/cdf f64.
+        entry = fitted.cdf_at_K_data[2]
+        assert entry["mij"].dtype == np.uint8
+        assert entry["iij"].dtype == np.uint8
+        assert entry["cij"].dtype == np.float32
+        assert entry["hist"].dtype == np.float64
+        assert entry["cdf"].dtype == np.float64
+        assert entry["bin_edges"].shape == (21,)
+        assert entry["consensus_labels"] == []
+        assert isinstance(entry["pac_area"], float)
+
+    def test_fit_returns_self(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2,), random_state=1, n_iterations=4, plot_cdf=False
+        )
+        assert cc.fit(x) is cc
+
+    def test_stability_attributes(self, fitted):
+        assert fitted.areas_.shape == (3,)
+        assert fitted.delta_k_.shape == (3,)
+        assert fitted.best_k_ in (2, 3, 4)
+        assert fitted.metrics_["resamples_per_second"] > 0
+
+    def test_best_k_on_blobs(self, blobs):
+        # 3 well-separated blobs: PAC must pick K=3 over 2 and 4..6.
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=range(2, 7), random_state=0, n_iterations=20,
+            plot_cdf=False, parity_zeros=False,
+        )
+        cc.fit(x)
+        assert cc.best_k_ == 3
+
+
+class TestQuirkHandling:
+    def test_q1_none_seed_raises_helpfully(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(K_range=(2,), plot_cdf=False)
+        with pytest.raises(ValueError, match="random_state"):
+            cc.fit(x)
+
+    def test_q11_options_not_shared(self):
+        a = ConsensusClustering(plot_cdf=False)
+        b = ConsensusClustering(plot_cdf=False)
+        a.clusterer_options["n_init"] = 99
+        assert b.clusterer_options == {"n_init": 3}
+
+    def test_q4_uint16_for_large_h(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2,), random_state=3, n_iterations=300, plot_cdf=False
+        )
+        cc.fit(x)
+        assert cc.cdf_at_K_data[2]["mij"].dtype == np.uint16
+
+    def test_q10_no_filesystem_side_effects(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        ConsensusClustering(memmap_folder="./memmap", plot_cdf=False)
+        assert list(tmp_path.iterdir()) == []
+
+    def test_default_options_dropped_for_optionless_clusterer(self, blobs):
+        # The *defaulted* {'n_init': 3} must not crash clusterers without
+        # that knob; explicit bogus options still error (next test).
+        from consensus_clustering_tpu.models.agglomerative import (
+            AgglomerativeClustering,
+        )
+
+        x, _ = blobs
+        cc = ConsensusClustering(
+            clusterer=AgglomerativeClustering(), K_range=(2,),
+            random_state=0, n_iterations=4, plot_cdf=False,
+        )
+        cc.fit(x)  # must not raise
+        assert 2 in cc.cdf_at_K_data
+
+    def test_consensus_labels_without_matrices_raises(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2,), random_state=0, n_iterations=4, plot_cdf=False,
+            store_matrices=False, compute_consensus_labels=True,
+        )
+        with pytest.raises(ValueError, match="store_matrices"):
+            cc.fit(x)
+
+    def test_unknown_clusterer_option_raises(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            clusterer=KMeans(), clusterer_options={"bogus": 1},
+            K_range=(2,), random_state=0, plot_cdf=False,
+        )
+        with pytest.raises(ValueError, match="bogus"):
+            cc.fit(x)
+
+    def test_bad_clusterer_type_raises(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            clusterer=object(), K_range=(2,), random_state=0, plot_cdf=False
+        )
+        with pytest.raises((TypeError, AttributeError)):
+            cc.fit(x)
+
+
+class TestSklearnPluginPath:
+    def test_sklearn_kmeans_via_host_backend(self, blobs):
+        from sklearn.cluster import KMeans as SkKMeans
+
+        x, _ = blobs
+        cc = ConsensusClustering(
+            clusterer=SkKMeans(), K_range=(2, 3), random_state=5,
+            n_iterations=6, plot_cdf=False, progress=False,
+        )
+        cc.fit(x)
+        assert set(cc.cdf_at_K_data) == {2, 3}
+        assert cc.cdf_at_K_data[3]["cdf"][-1] == pytest.approx(1.0, abs=1e-6)
+
+    def test_gaussian_mixture_n_components_duck_typing(self, blobs):
+        from sklearn.mixture import GaussianMixture as SkGMM
+
+        x, _ = blobs
+        cc = ConsensusClustering(
+            clusterer=SkGMM(), clusterer_options={"n_init": 1},
+            K_range=(3,), random_state=5, n_iterations=5, plot_cdf=False,
+            progress=False,
+        )
+        cc.fit(x)
+        assert 3 in cc.cdf_at_K_data
+
+    def test_adapter_rejects_non_estimator(self):
+        with pytest.raises(AttributeError, match="n_clusters nor n_components"):
+            SklearnClusterer(_FitPredictOnly())
+
+    def test_same_resample_plan_as_jax_backend(self, blobs):
+        # Host and compiled backends must draw identical subsamples: Iij is
+        # a pure function of the seed, whichever backend runs (SURVEY Q8).
+        from sklearn.cluster import KMeans as SkKMeans
+
+        x, _ = blobs
+        common = dict(
+            K_range=(2,), random_state=11, n_iterations=8, plot_cdf=False,
+        )
+        cc_host = ConsensusClustering(
+            clusterer=SkKMeans(), progress=False, **common
+        ).fit(x)
+        cc_jax = ConsensusClustering(**common).fit(x)
+        np.testing.assert_array_equal(
+            cc_host.cdf_at_K_data[2]["iij"], cc_jax.cdf_at_K_data[2]["iij"]
+        )
+
+
+class _FitPredictOnly:
+    def fit_predict(self, x):
+        return np.zeros(len(x))
+
+
+class TestStoreMatrices:
+    def test_auto_keeps_small(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2,), random_state=0, n_iterations=4, plot_cdf=False
+        )
+        cc.fit(x)
+        assert cc.cdf_at_K_data[2]["mij"] is not None
+
+    def test_explicit_false(self, blobs):
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2,), random_state=0, n_iterations=4, plot_cdf=False,
+            store_matrices=False,
+        )
+        cc.fit(x)
+        assert cc.cdf_at_K_data[2]["mij"] is None
+        assert cc.cdf_at_K_data[2]["pac_area"] >= -1e-6

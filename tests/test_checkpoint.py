@@ -1,0 +1,79 @@
+"""Checkpoint / resume: per-K skip, fingerprint safety, result equality."""
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu import ConsensusClustering
+
+
+def _fit(x, tmp, **kw):
+    cc = ConsensusClustering(
+        K_range=(2, 3, 4), random_state=5, n_iterations=8, plot_cdf=False,
+        checkpoint_dir=str(tmp), **kw,
+    )
+    return cc.fit(x)
+
+
+class TestCheckpointResume:
+    def test_resume_skips_completed_and_matches(self, blobs, tmp_path):
+        x, _ = blobs
+        first = _fit(x, tmp_path / "ck")
+        assert first.metrics_["run_seconds"] > 0
+        # Second fit: everything loaded, nothing recomputed.
+        second = _fit(x, tmp_path / "ck")
+        assert second.metrics_.get("resumed_from_checkpoint") is True
+        for k in (2, 3, 4):
+            np.testing.assert_array_equal(
+                first.cdf_at_K_data[k]["mij"], second.cdf_at_K_data[k]["mij"]
+            )
+            assert (
+                first.cdf_at_K_data[k]["pac_area"]
+                == second.cdf_at_K_data[k]["pac_area"]
+            )
+
+    def test_partial_resume_runs_only_missing(self, blobs, tmp_path):
+        import os
+
+        x, _ = blobs
+        ck = tmp_path / "ck"
+        cc = ConsensusClustering(
+            K_range=(2, 3), random_state=5, n_iterations=8, plot_cdf=False,
+            checkpoint_dir=str(ck),
+        ).fit(x)
+        # Extend the sweep: K=4 is new, 2/3 come from disk.
+        cc2 = ConsensusClustering(
+            K_range=(2, 3, 4), random_state=5, n_iterations=8,
+            plot_cdf=False, checkpoint_dir=str(ck),
+        ).fit(x)
+        assert set(cc2.cdf_at_K_data) == {2, 3, 4}
+        np.testing.assert_array_equal(
+            cc.cdf_at_K_data[2]["mij"], cc2.cdf_at_K_data[2]["mij"]
+        )
+        assert sorted(
+            int(f[1:-4]) for f in os.listdir(ck) if f.endswith(".npz")
+        ) == [2, 3, 4]
+
+    def test_fingerprint_mismatch_rejected(self, blobs, tmp_path):
+        x, _ = blobs
+        ck = tmp_path / "ck"
+        _fit(x, ck)
+        with pytest.raises(ValueError, match="fingerprint"):
+            ConsensusClustering(
+                K_range=(2,), random_state=6,  # different seed
+                n_iterations=8, plot_cdf=False, checkpoint_dir=str(ck),
+            ).fit(x)
+
+    def test_k_max_invariance_makes_extension_consistent(self, blobs, tmp_path):
+        # K=2 fitted alone (k_max=2) must equal K=2 from a 2..4 sweep
+        # (k_max=4): padded clusterer slots are inert by construction.
+        x, _ = blobs
+        alone = ConsensusClustering(
+            K_range=(2,), random_state=9, n_iterations=8, plot_cdf=False,
+        ).fit(x)
+        swept = ConsensusClustering(
+            K_range=(2, 3, 4), random_state=9, n_iterations=8,
+            plot_cdf=False,
+        ).fit(x)
+        np.testing.assert_array_equal(
+            alone.cdf_at_K_data[2]["mij"], swept.cdf_at_K_data[2]["mij"]
+        )

@@ -1,0 +1,128 @@
+"""JAX-native KMeans: quality, masking, determinism, vmap/jit behaviour."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from consensus_clustering_tpu.models.kmeans import KMeans, _pairwise_sqdist
+
+
+class TestPairwiseSqdist:
+    def test_matches_numpy(self, rng):
+        x = rng.normal(size=(17, 5)).astype(np.float32)
+        c = rng.normal(size=(4, 5)).astype(np.float32)
+        d = np.asarray(_pairwise_sqdist(jnp.asarray(x), jnp.asarray(c)))
+        expected = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(d, expected, atol=1e-4)
+        assert (d >= 0).all()
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, blobs):
+        x, y = blobs
+        km = KMeans(n_init=3)
+        labels = np.asarray(
+            km.fit_predict(jax.random.PRNGKey(0), jnp.asarray(x), 3, 3)
+        )
+        assert adjusted_rand_score(y, labels) > 0.99
+
+    def test_padded_k_matches_exact_k(self, blobs):
+        # Same key, k=3 with k_max=3 vs k_max=8: labels must be in [0, 3) and
+        # partition quality must be as good (masked slots are inert).
+        x, _ = blobs
+        km = KMeans(n_init=2)
+        l_exact = np.asarray(
+            km.fit_predict(jax.random.PRNGKey(1), jnp.asarray(x), 3, 3)
+        )
+        l_padded = np.asarray(
+            km.fit_predict(jax.random.PRNGKey(1), jnp.asarray(x), 3, 8)
+        )
+        assert l_padded.max() < 3
+        assert adjusted_rand_score(l_exact, l_padded) > 0.99
+
+    def test_labels_bounded_by_k(self, rng):
+        x = jnp.asarray(rng.normal(size=(40, 4)).astype(np.float32))
+        for k in (2, 4, 7):
+            labels = np.asarray(
+                KMeans().fit_predict(jax.random.PRNGKey(2), x, k, 8)
+            )
+            assert labels.min() >= 0 and labels.max() < k
+            assert len(np.unique(labels)) == k  # all clusters used on noise
+
+    def test_deterministic(self, blobs):
+        x, _ = blobs
+        km = KMeans(n_init=3)
+        a = km.fit_predict(jax.random.PRNGKey(5), jnp.asarray(x), 4, 6)
+        b = km.fit_predict(jax.random.PRNGKey(5), jnp.asarray(x), 4, 6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_restarts_improve_inertia(self, rng):
+        # With many restarts, inertia must be <= single-restart inertia.
+        x = jnp.asarray(rng.normal(size=(60, 3)).astype(np.float32))
+
+        def inertia(labels, x, k_max):
+            labels = np.asarray(labels)
+            xx = np.asarray(x)
+            total = 0.0
+            for j in range(k_max):
+                pts = xx[labels == j]
+                if len(pts):
+                    total += ((pts - pts.mean(0)) ** 2).sum()
+            return total
+
+        key = jax.random.PRNGKey(3)
+        l1 = KMeans(n_init=1).fit_predict(key, x, 5, 5)
+        l10 = KMeans(n_init=10).fit_predict(key, x, 5, 5)
+        assert inertia(l10, x, 5) <= inertia(l1, x, 5) + 1e-3
+
+    def test_vmap_over_resamples(self, blobs):
+        x, _ = blobs
+        sub = jnp.stack([jnp.asarray(x[i : i + 64]) for i in range(0, 40, 10)])
+        keys = jax.random.split(jax.random.PRNGKey(7), sub.shape[0])
+        km = KMeans(n_init=2)
+        labels = jax.vmap(
+            lambda k_, x_: km.fit_predict(k_, x_, 3, 5)
+        )(keys, sub)
+        assert labels.shape == (sub.shape[0], 64)
+        assert int(labels.max()) < 3
+
+    def test_traced_k_under_jit(self, blobs):
+        # k as a traced scalar: one compiled fn serves every k (padded k_max).
+        x, _ = blobs
+        km = KMeans(n_init=2)
+
+        @jax.jit
+        def run(k):
+            return km.fit_predict(jax.random.PRNGKey(0), jnp.asarray(x), k, 8)
+
+        for k in (2, 3, 6):
+            labels = np.asarray(run(k))
+            assert labels.max() < k
+
+    def test_quality_comparable_to_sklearn(self, rng):
+        # Looser blobs: our inertia within 5% of sklearn's on the same data.
+        from sklearn.cluster import KMeans as SkKMeans
+        from sklearn.datasets import make_blobs
+
+        x, _ = make_blobs(
+            n_samples=200, n_features=8, centers=5, cluster_std=2.5,
+            random_state=11,
+        )
+        x = x.astype(np.float32)
+        sk = SkKMeans(n_clusters=5, n_init=5, random_state=0).fit(x)
+        ours = KMeans(n_init=5).fit_predict(
+            jax.random.PRNGKey(0), jnp.asarray(x), 5, 5
+        )
+
+        def inertia(labels):
+            labels = np.asarray(labels)
+            total = 0.0
+            for j in range(5):
+                pts = x[labels == j]
+                if len(pts):
+                    total += ((pts - pts.mean(0)) ** 2).sum()
+            return total
+
+        assert inertia(ours) <= inertia(sk.labels_) * 1.05

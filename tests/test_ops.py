@@ -165,6 +165,25 @@ class TestAnalysis:
         np.testing.assert_allclose(np.asarray(cdf), o_cdf, rtol=1e-6)
         np.testing.assert_allclose(float(pac), o_pac, rtol=1e-6)
 
+    def test_binning_matches_numpy_at_ulp_boundaries(self):
+        # Ratios whose f32 value sits one ulp below a bin edge: floor(v*20)
+        # in f32 rounds them into the wrong bin (regression: 272 of 180900
+        # small (mij, iij) pairs diverged).  Membership must match
+        # np.histogram exactly for every small ratio.
+        m, i = np.meshgrid(np.arange(0, 64), np.arange(1, 64))
+        ratios = (m / (i + 1e-6)).astype(np.float32).ravel()
+        ratios = ratios[ratios <= 1.0]
+        n = int(np.sqrt(len(ratios))) + 1
+        cij = np.zeros((n, n), np.float32)
+        iu = np.triu_indices(n, k=1)
+        take = min(len(ratios), len(iu[0]))
+        cij[iu[0][:take], iu[1][:take]] = ratios[:take]
+        lo, hi = pac_indices((0.1, 0.9))
+        hist, cdf, pac = cdf_pac(jnp.asarray(cij), lo, hi, parity_zeros=True)
+        o_hist, o_cdf, _, o_pac = oracle_cdf_pac(cij, parity_zeros=True)
+        np.testing.assert_allclose(np.asarray(hist), o_hist, rtol=1e-6)
+        np.testing.assert_allclose(float(pac), o_pac, atol=1e-6)
+
     def test_pac_indices_reference_expression(self):
         # dbin=0.05, (0.1, 0.9) -> pac = cdf[17] - cdf[2] (quirk Q7).
         assert pac_indices((0.1, 0.9)) == (2, 18)

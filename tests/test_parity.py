@@ -1,0 +1,91 @@
+"""Parity vs the reference implementation's serial goldens on corr.csv.
+
+Fixtures in tests/fixtures/reference_goldens.json were produced by running
+the reference (trioxane/consensus_clustering) serially (n_jobs=1) on this
+machine's sklearn — the deterministic path, per SURVEY.md §4 (the notebook's
+published numbers came from racy multiprocessing on an older sklearn and are
+not reproducible).
+
+Two layers of parity:
+
+1. **Exact math parity** — given the reference's own index plan and sklearn
+   labels, our ops must reproduce Mij/Iij bit-for-bit and PAC to f32.
+   (Covered in test_ops.py and via the sklearn host backend here.)
+2. **Statistical parity** — with our JAX-native KMeans and resample plan
+   (different RNG by necessity), the PAC-vs-K curve on corr.csv must rank
+   K the same way and track the golden curve closely.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu import ConsensusClustering
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    with open(os.path.join(FIXTURES, "reference_goldens.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def jax_fit(corr_data):
+    cc = ConsensusClustering(
+        K_range=range(2, 15), random_state=23, n_iterations=30,
+        plot_cdf=False,
+    )
+    return cc.fit(corr_data)
+
+
+class TestStatisticalParity:
+    def test_pac_curve_tracks_goldens(self, jax_fit, goldens):
+        ours = np.array(
+            [jax_fit.cdf_at_K_data[k]["pac_area"] for k in range(2, 15)]
+        )
+        ref = np.array([goldens["kmeans_pac"][str(k)] for k in range(2, 15)])
+        # Same ordering/shape of the stability curve: strong rank agreement.
+        from scipy.stats import spearmanr
+
+        rho = spearmanr(ours, ref).statistic
+        assert rho > 0.95, (ours, ref)
+        # And pointwise closeness: resampling noise at H=30 on 29 points is
+        # a few percent; 0.08 absolute is ~2x the observed deviation.
+        np.testing.assert_allclose(ours, ref, atol=0.08)
+
+    def test_monotone_tail(self, jax_fit):
+        # On corr.csv the reference's PAC decreases monotonically K>=4;
+        # ours must show the same qualitative shape.
+        pac = [jax_fit.cdf_at_K_data[k]["pac_area"] for k in range(4, 15)]
+        assert all(a >= b - 0.02 for a, b in zip(pac, pac[1:]))
+
+    def test_iij_marginals_match_reference_exactly(self, jax_fit, goldens):
+        # Iij total = H * n_sub^2 is plan-independent: must equal the
+        # reference's exactly even though the draws differ.
+        iij = jax_fit.cdf_at_K_data[2]["iij"].astype(np.int64)
+        assert int(iij.sum()) == goldens["iij_sum"]
+
+
+class TestExactParityViaHostBackend:
+    """Our framework with the *sklearn* inner clusterer must land near the
+    serial-reference goldens: same estimator, same analysis math; only the
+    resample plan differs (JAX RNG vs MT19937)."""
+
+    def test_sklearn_kmeans_close_to_goldens(self, corr_data, goldens):
+        from sklearn.cluster import KMeans as SkKMeans
+
+        cc = ConsensusClustering(
+            clusterer=SkKMeans(), K_range=range(4, 9), random_state=23,
+            n_iterations=30, plot_cdf=False, progress=False,
+        )
+        cc.fit(corr_data)
+        ours = np.array(
+            [cc.cdf_at_K_data[k]["pac_area"] for k in range(4, 9)]
+        )
+        ref = np.array([goldens["kmeans_pac"][str(k)] for k in range(4, 9)])
+        np.testing.assert_allclose(ours, ref, atol=0.08)
+        assert list(np.argsort(ours)) == list(np.argsort(ref))
