@@ -114,6 +114,13 @@ class ConsensusClustering:
         independent init stream (see SweepConfig docs).
     progress : bool, keyword-only
         Per-K host progress bars for the host backend.
+    profile_dir : str, keyword-only, optional
+        Capture a ``jax.profiler`` trace of the compiled sweep's execution
+        into this directory (view with TensorBoard/xprof).
+    use_pallas : bool, keyword-only, optional
+        Force the Pallas consensus-histogram kernel on (True) or off
+        (False); None (default) picks by backend — Pallas on accelerators,
+        XLA fallback on CPU.
 
     Attributes
     ----------
@@ -154,6 +161,8 @@ class ConsensusClustering:
         reseed_clusterer_per_resample: bool = False,
         checkpoint_dir: Optional[str] = None,
         progress: bool = True,
+        profile_dir: Optional[str] = None,
+        use_pallas: Optional[bool] = None,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -194,6 +203,8 @@ class ConsensusClustering:
         self.reseed_clusterer_per_resample = reseed_clusterer_per_resample
         self.checkpoint_dir = checkpoint_dir
         self.progress = progress
+        self.profile_dir = profile_dir
+        self.use_pallas = use_pallas
 
     # -- clusterer resolution -------------------------------------------
 
@@ -296,6 +307,7 @@ class ConsensusClustering:
             store_matrices=self._resolve_store_matrices(n),
             chunk_size=self.chunk_size,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
+            use_pallas=self.use_pallas,
         )
 
         ckpt = None
@@ -335,7 +347,7 @@ class ConsensusClustering:
 
                 out = run_sweep(
                     clusterer, run_config, X, self.random_state,
-                    mesh=self.mesh,
+                    mesh=self.mesh, profile_dir=self.profile_dir,
                 )
 
         self._build_results(out, config, missing, loaded, ckpt)

@@ -84,6 +84,8 @@ def cmd_run(args):
         store_matrices=False,
         checkpoint_dir=args.checkpoint_dir,
         compute_consensus_labels=False,
+        profile_dir=args.profile_dir,
+        use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -135,6 +137,11 @@ def main(argv=None):
     run.add_argument("--n-samples", type=int, default=5000)
     run.add_argument("--n-features", type=int, default=50)
     run.add_argument("--checkpoint-dir", default=None)
+    run.add_argument("--profile-dir", default=None,
+                     help="capture a jax.profiler trace here")
+    run.add_argument("--use-pallas", choices=["auto", "on", "off"],
+                     default="auto",
+                     help="consensus-histogram kernel selection")
     run.add_argument("--out", default=None)
     run.set_defaults(fn=cmd_run)
 

@@ -10,7 +10,7 @@ engine closes over at trace time; the sklearn-shaped facade
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 from consensus_clustering_tpu.ops.analysis import pac_indices
 from consensus_clustering_tpu.ops.resample import subsample_size
@@ -42,6 +42,8 @@ class SweepConfig:
         for multi-optimum clusterers like full-covariance GMMs.  True gives
         every resample an independent init stream (honest resampling
         variance; documented divergence).
+      use_pallas: True forces the Pallas consensus-histogram kernel, False
+        forces the XLA fallback, None picks by backend (Pallas on TPU).
     """
 
     n_samples: int
@@ -55,6 +57,7 @@ class SweepConfig:
     store_matrices: bool = True
     chunk_size: int = 8
     reseed_clusterer_per_resample: bool = False
+    use_pallas: Optional[bool] = None
 
     def __post_init__(self):
         if not self.k_values:

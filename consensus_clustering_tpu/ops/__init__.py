@@ -9,6 +9,8 @@ from consensus_clustering_tpu.ops.coassoc import coassociation_counts
 from consensus_clustering_tpu.ops.analysis import (
     consensus_matrix,
     cdf_pac,
+    cdf_pac_from_counts,
+    masked_histogram_counts,
     area_under_cdf,
     delta_k,
     pac_indices,
@@ -21,6 +23,8 @@ __all__ = [
     "coassociation_counts",
     "consensus_matrix",
     "cdf_pac",
+    "cdf_pac_from_counts",
+    "masked_histogram_counts",
     "area_under_cdf",
     "delta_k",
     "pac_indices",

@@ -25,20 +25,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def consensus_matrix(mij: jax.Array, iij: jax.Array) -> jax.Array:
+def consensus_matrix(
+    mij: jax.Array, iij: jax.Array, row_offset: jax.Array = 0
+) -> jax.Array:
     """``Cij = Mij / (Iij + 1e-6)`` (f32), diagonal set to 1.0.
 
     Never-co-sampled pairs give ~0, not NaN (quirk Q9).  Matches the
     reference to 1 f32 ulp: NumPy adds the 1e-6 regulariser in f64 before the
     f32 divide, while on TPU (no f64) the add itself rounds to f32.
+
+    ``row_offset`` (may be traced) is the global index of row 0, for callers
+    passing a row block of a sharded consensus matrix: the "diagonal" is then
+    wherever global row index == column index.
     """
     cij = mij.astype(jnp.float32) / (iij.astype(jnp.float32) + 1e-6)
-    n = cij.shape[-1]
-    eye = jnp.eye(n, dtype=jnp.bool_)
-    return jnp.where(eye, jnp.float32(1.0), cij)
+    rows = row_offset + jnp.arange(cij.shape[-2], dtype=jnp.int32)
+    cols = jnp.arange(cij.shape[-1], dtype=jnp.int32)
+    diag = rows[:, None] == cols[None, :]
+    return jnp.where(diag, jnp.float32(1.0), cij)
 
 
-def _binned_counts(
+def masked_histogram_counts(
     values: jax.Array, mask: jax.Array, bins: int
 ) -> jax.Array:
     """Masked histogram counts over [0, 1] with the last bin right-closed.
@@ -94,7 +101,28 @@ def cdf_pac(
     i = jnp.arange(n, dtype=jnp.int32)
     upper = i[None, :] > i[:, None]
 
-    counts = _binned_counts(cij, upper, bins)
+    counts = masked_histogram_counts(cij, upper, bins)
+    return cdf_pac_from_counts(
+        counts, n, pac_lo_idx, pac_hi_idx, parity_zeros
+    )
+
+
+def cdf_pac_from_counts(
+    counts: jax.Array,
+    n_samples: int,
+    pac_lo_idx: int,
+    pac_hi_idx: int,
+    parity_zeros: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Histogram density, CDF and PAC from strict-upper-triangle bin counts.
+
+    ``counts`` are the (bins,) raw counts of the N(N-1)/2 upper-triangle
+    consensus values — e.g. psum'd over a mesh axis by callers that shard
+    consensus-matrix rows.  The parity-zeros bookkeeping (quirk Q6) is purely
+    a function of N, so it is applied here, once, after any reduction.
+    """
+    n = n_samples
+    bins = counts.shape[0]
     if parity_zeros:
         # triu(.., k=1).ravel() keeps the zeroed lower triangle + diagonal in
         # the histogram input: N(N+1)/2 extra zeros in bin 0, density over N^2.

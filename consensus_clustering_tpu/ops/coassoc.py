@@ -18,25 +18,27 @@ serial reference bit-for-bit (as int32).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
 
 def _one_hot_chunk(
-    labels: jax.Array, indices: jax.Array, k_max: int, n_samples: int
+    labels: jax.Array, indices: jax.Array, k_max: int, n_cols: int
 ) -> jax.Array:
-    """(B, K_max, N) bf16 one-hot with C[b, labels[b,s], indices[b,s]] = 1.
+    """(B, K_max, n_cols) bf16 one-hot with C[b, labels[b,s], indices[b,s]]=1.
 
     Out-of-range labels/indices (used for padding partial chunks) are dropped.
     JAX wraps negative indices Python-style *before* ``mode="drop"`` can drop
-    them, so invalid entries are first redirected to column N, which is
-    genuinely out of bounds and therefore dropped.
+    them, so invalid entries are first redirected to column ``n_cols``, which
+    is genuinely out of bounds and therefore dropped.
     """
     batch = labels.shape[0]
     valid = (labels >= 0) & (labels < k_max) & (indices >= 0)
     labels = jnp.where(valid, labels, 0)
-    indices = jnp.where(valid, indices, n_samples)
-    c = jnp.zeros((batch, k_max, n_samples), dtype=jnp.bfloat16)
+    indices = jnp.where(valid, indices, n_cols)
+    c = jnp.zeros((batch, k_max, n_cols), dtype=jnp.bfloat16)
     rows = jnp.arange(batch, dtype=jnp.int32)[:, None]
     return c.at[rows, labels, indices].set(1, mode="drop")
 
@@ -47,8 +49,12 @@ def coassociation_counts(
     n_samples: int,
     k_max: int,
     chunk_size: int = 8,
+    *,
+    n_cols: Optional[int] = None,
+    row_start: Optional[jax.Array] = None,
+    n_rows: Optional[int] = None,
 ) -> jax.Array:
-    """Accumulate the (N, N) co-association count matrix over all resamples.
+    """Accumulate the co-association count matrix over all resamples.
 
     Args:
       labels: (H, n_sub) int32 cluster labels per resample; entries must be in
@@ -58,11 +64,22 @@ def coassociation_counts(
       k_max: static upper bound on the number of clusters (one-hot height).
       chunk_size: resamples per scan step; B*K_max is the contracted GEMM
         dimension, so larger chunks mean bigger, more MXU-efficient GEMMs at
-        (B, K_max, N) one-hot HBM cost.
+        (B, K_max, n_cols) one-hot HBM cost.
+      n_cols: one-hot width (default N); pass the row-padded width when the
+        caller shards consensus-matrix rows so every row block stays in
+        bounds.  Columns >= N never receive scatters and stay zero.
+      row_start: if given (a traced scalar is fine), compute only the row
+        block ``[row_start, row_start + n_rows)`` — the shard owned by one
+        device on the mesh's ``'n'`` axis.  Requires ``n_rows``.
+      n_rows: static height of the row block.
 
     Returns:
-      (N, N) int32 ``Mij``.
+      (N, N) int32 ``Mij`` — or its (n_rows, n_cols) row block.
     """
+    if n_cols is None:
+        n_cols = n_samples
+    if (row_start is None) != (n_rows is None):
+        raise ValueError("row_start and n_rows must be passed together")
     n_iterations = labels.shape[0]
     chunk_size = max(1, min(chunk_size, n_iterations))
     n_chunks = -(-n_iterations // chunk_size)
@@ -81,16 +98,23 @@ def coassociation_counts(
 
     def step(mij: jax.Array, chunk):
         chunk_labels, chunk_indices = chunk
-        c = _one_hot_chunk(chunk_labels, chunk_indices, k_max, n_samples)
-        c = c.reshape(chunk_size * k_max, n_samples)
+        c = _one_hot_chunk(chunk_labels, chunk_indices, k_max, n_cols)
+        c = c.reshape(chunk_size * k_max, n_cols)
+        if row_start is None:
+            left = c
+        else:
+            left = jax.lax.dynamic_slice(
+                c, (0, row_start), (chunk_size * k_max, n_rows)
+            )
         partial = jax.lax.dot_general(
-            c,
+            left,
             c,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return mij + partial, None
 
-    mij0 = jnp.zeros((n_samples, n_samples), dtype=jnp.float32)
+    out_rows = n_cols if row_start is None else n_rows
+    mij0 = jnp.zeros((out_rows, n_cols), dtype=jnp.float32)
     mij, _ = jax.lax.scan(step, mij0, (labels, indices))
     return mij.astype(jnp.int32)

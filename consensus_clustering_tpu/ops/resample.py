@@ -17,6 +17,8 @@ permutation so it vmaps over H with static shapes, and ``Iij`` is a single
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -62,34 +64,61 @@ def resample_indices(
 
 
 def indicator_matrix(
-    indices: jax.Array, n_samples: int, dtype: jnp.dtype = jnp.bfloat16
+    indices: jax.Array,
+    n_samples: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+    *,
+    n_cols: Optional[int] = None,
 ) -> jax.Array:
-    """(H, N) 0/1 indicator R with R[h, indices[h, :]] = 1.
+    """(H, n_cols) 0/1 indicator R with R[h, indices[h, :]] = 1.
 
     bfloat16 by default so the Iij GEMM runs on the MXU; the values are
     exactly representable and the contraction accumulates in f32.
+    ``n_cols`` (default N) widens the indicator for row-sharded callers;
+    columns >= N stay zero.
 
     Negative indices (padding sentinels) are dropped, not wrapped: JAX wraps
     negative indices Python-style before ``mode="drop"`` applies, so they are
-    first redirected to the out-of-bounds column N.
+    first redirected to the out-of-bounds column ``n_cols``.
     """
+    if n_cols is None:
+        n_cols = n_samples
     n_iterations = indices.shape[0]
-    indices = jnp.where(indices >= 0, indices, n_samples)
-    r = jnp.zeros((n_iterations, n_samples), dtype=dtype)
+    indices = jnp.where(indices >= 0, indices, n_cols)
+    r = jnp.zeros((n_iterations, n_cols), dtype=dtype)
     rows = jnp.arange(n_iterations, dtype=jnp.int32)[:, None]
     return r.at[rows, indices].set(1, mode="drop")
 
 
-def cosample_counts(indices: jax.Array, n_samples: int) -> jax.Array:
+def cosample_counts(
+    indices: jax.Array,
+    n_samples: int,
+    *,
+    n_cols: Optional[int] = None,
+    row_start: Optional[jax.Array] = None,
+    n_rows: Optional[int] = None,
+) -> jax.Array:
     """Co-sampling count matrix ``Iij[i, j] = #{resamples containing both}``.
 
     Reference: ``Iij = R^T @ R`` (consensus_clustering_parallelised.py:260-264).
     Here: one (N, H) x (H, N) MXU GEMM with f32 accumulation — exact for
     H < 2^24 — returned as int32.
+
+    ``row_start``/``n_rows`` (with ``n_cols`` the padded width) select the
+    ``[row_start, row_start + n_rows)`` row block, for callers that shard
+    consensus-matrix rows over a mesh axis; ``row_start`` may be traced.
     """
-    r = indicator_matrix(indices, n_samples)
+    if (row_start is None) != (n_rows is None):
+        raise ValueError("row_start and n_rows must be passed together")
+    r = indicator_matrix(indices, n_samples, n_cols=n_cols)
+    if row_start is None:
+        left = r
+    else:
+        left = jax.lax.dynamic_slice(
+            r, (0, row_start), (r.shape[0], n_rows)
+        )
     iij = jax.lax.dot_general(
-        r,
+        left,
         r,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,

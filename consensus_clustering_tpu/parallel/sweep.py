@@ -8,11 +8,16 @@ one XLA program:
 - the resample plan is drawn on device once, identical for every K (quirk
   Q8) and for every device count (keys are folded with the *global* resample
   index),
-- resamples are sharded over the mesh's ``'h'`` axis with ``shard_map``;
-  each chip clusters its local resamples (clusterer vmapped over them) and
-  contributes partial ``Iij`` / ``Mij`` counts that are ``lax.psum``'d over
-  ICI — the functional, race-free analog of the reference's shared-memmap
-  accumulation (quirk Q2 is unrepresentable here),
+- resamples are sharded over the WHOLE ('h', 'n') mesh with ``shard_map``
+  for the clustering work; each chip clusters its local resamples (clusterer
+  vmapped over them) and contributes partial ``Iij`` / ``Mij`` counts that
+  are ``lax.psum``'d over ICI — the functional, race-free analog of the
+  reference's shared-memmap accumulation (quirk Q2 is unrepresentable here),
+- the N x N consensus matrices shard their ROWS over the ``'n'`` axis (the
+  long-context analog, SURVEY.md §5.7): labels ride a cheap all_gather along
+  'n', each chip computes only its (N/n_r, N) block of the count GEMMs, and
+  the CDF histogram reduces per block before a (bins,)-sized psum — so the
+  N=10k..20k configs' O(N^2) HBM cost divides across the mesh,
 - the K sweep is a ``lax.scan`` over a traced K with padded one-hot shapes
   (static ``k_max``), so the whole sweep costs one compilation,
 - CDF/PAC analysis runs on device; only (bins,)-sized curves (plus the N x N
@@ -21,7 +26,6 @@ one XLA program:
 
 from __future__ import annotations
 
-import dataclasses
 import time
 from functools import partial
 from typing import Any, Dict, Optional
@@ -35,9 +39,10 @@ from jax import shard_map
 from consensus_clustering_tpu.config import SweepConfig
 from consensus_clustering_tpu.models.protocol import JaxClusterer
 from consensus_clustering_tpu.ops.analysis import (
-    cdf_pac,
+    cdf_pac_from_counts,
     consensus_matrix,
 )
+from consensus_clustering_tpu.ops.pallas_hist import consensus_hist_counts
 from consensus_clustering_tpu.ops.coassoc import coassociation_counts
 from consensus_clustering_tpu.ops.resample import (
     cosample_counts,
@@ -59,31 +64,59 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     """
     if mesh is None:
         mesh = resample_mesh([jax.devices()[0]])
-    if mesh.shape[ROW_AXIS] != 1:
-        raise NotImplementedError(
-            "consensus-matrix row sharding (mesh axis 'n' > 1) lands with "
-            "the large-N path; use row_shards=1"
-        )
     n_h = mesh.shape[RESAMPLE_AXIS]
+    n_r = mesh.shape[ROW_AXIS]
 
     n = config.n_samples
     h_total = config.n_iterations
     n_sub = config.n_sub
     k_max = config.k_max
     lo, hi = config.pac_idx
-    # Pad H to a multiple of the resample-axis size; padded rows carry
-    # indices = -1 and are dropped by the one-hot builders.
-    h_pad = -(-h_total // n_h) * n_h
+    # Row sharding: each of the n_r devices on the 'n' axis owns n_local
+    # consensus-matrix rows; N is padded so the blocks tile evenly (padded
+    # rows/cols receive no scatters and are cropped after the shard_map).
+    n_local = -(-n // n_r)
+    n_pad = n_local * n_r
+    # Resamples shard over BOTH axes for the clustering work (n_h * n_r
+    # devices); pad H to a multiple and mark padded rows with indices = -1,
+    # which every one-hot builder drops.
+    h_pad = -(-h_total // (n_h * n_r)) * (n_h * n_r)
     k_arr = jnp.asarray(config.k_values, jnp.int32)
 
     def local_body(x, indices, key_cluster):
-        """Runs per device: indices is this chip's (h_pad/n_h, n_sub) shard."""
-        local_h = indices.shape[0]
-        h0 = jax.lax.axis_index(RESAMPLE_AXIS) * local_h
-        h_global = h0 + jnp.arange(local_h, dtype=jnp.int32)
-        h_valid = h_global < h_total
+        """Runs per device.
 
-        iij = jax.lax.psum(cosample_counts(indices, n), RESAMPLE_AXIS)
+        ``indices`` is this chip's (h_pad / (n_h * n_r), n_sub) resample
+        shard: clustering is data-parallel over every device.  For the
+        accumulation GEMMs the same chips are re-viewed as an (n_h, n_r)
+        grid: labels/indices are all_gather'd along the 'n' axis (cheap —
+        int32 label rows, not matrices) so each 'h' row holds its full
+        resample shard, each device computes its own (n_local, n_pad) row
+        block of Mij/Iij, and the blocks psum over 'h' only.  The CDF
+        histogram is computed per block and psum'd over 'n'.
+        """
+        local_h = indices.shape[0]
+        h_idx = jax.lax.axis_index(RESAMPLE_AXIS)
+        r_idx = jax.lax.axis_index(ROW_AXIS)
+        h_global = (h_idx * n_r + r_idx) * local_h + jnp.arange(
+            local_h, dtype=jnp.int32
+        )
+        h_valid = h_global < h_total
+        row_start = r_idx * n_local
+
+        # This 'h' row's full resample shard, in global order (tiled gather
+        # along 'n' concatenates the r_idx blocks in index order).
+        indices_row = jax.lax.all_gather(
+            indices, ROW_AXIS, tiled=True, axis=0
+        )
+        iij = jax.lax.psum(
+            cosample_counts(
+                indices_row, n,
+                n_cols=n_pad, row_start=row_start, n_rows=n_local,
+            ),
+            RESAMPLE_AXIS,
+        )
+
         # Clamped gather: padded rows read x[0], get clustered (cheap,
         # bounded) and are then masked out of the accumulation.
         x_sub = x[jnp.where(indices >= 0, indices, 0)]
@@ -103,15 +136,26 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 lambda kk, xs: clusterer.fit_predict(kk, xs, k, k_max)
             )(keys, x_sub)
             labels = jnp.where(h_valid[:, None], labels, -1)
+            labels_row = jax.lax.all_gather(
+                labels, ROW_AXIS, tiled=True, axis=0
+            )
             mij = jax.lax.psum(
                 coassociation_counts(
-                    labels, indices, n, k_max, config.chunk_size
+                    labels_row, indices_row, n, k_max, config.chunk_size,
+                    n_cols=n_pad, row_start=row_start, n_rows=n_local,
                 ),
                 RESAMPLE_AXIS,
             )
-            cij = consensus_matrix(mij, iij)
-            hist, cdf, pac = cdf_pac(
-                cij, lo, hi, config.bins, config.parity_zeros
+            cij = consensus_matrix(mij, iij, row_offset=row_start)
+            counts = jax.lax.psum(
+                consensus_hist_counts(
+                    cij, n, row_start, config.bins,
+                    use_pallas=config.use_pallas,
+                ),
+                ROW_AXIS,
+            )
+            hist, cdf, pac = cdf_pac_from_counts(
+                counts, n, lo, hi, config.parity_zeros
             )
             out = {"hist": hist, "cdf": cdf, "pac_area": pac}
             if config.store_matrices:
@@ -122,11 +166,16 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
         _, per_k_out = jax.lax.scan(per_k, 0, k_arr)
         return per_k_out, iij
 
+    per_k_specs = {"hist": P(), "cdf": P(), "pac_area": P()}
+    if config.store_matrices:
+        per_k_specs["mij"] = P(None, ROW_AXIS, None)
+        per_k_specs["cij"] = P(None, ROW_AXIS, None)
+
     sharded_body = shard_map(
         local_body,
         mesh=mesh,
-        in_specs=(P(), P(RESAMPLE_AXIS), P()),
-        out_specs=(P(), P()),
+        in_specs=(P(), P((RESAMPLE_AXIS, ROW_AXIS)), P()),
+        out_specs=(per_k_specs, P(ROW_AXIS, None)),
         check_vma=False,
     )
 
@@ -143,20 +192,14 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 ]
             )
         per_k_out, iij = sharded_body(x, indices, key_cluster)
-        per_k_out["iij"] = iij
+        # Crop row/column padding introduced by the 'n'-axis block layout.
+        per_k_out["iij"] = iij[:n, :n]
+        if config.store_matrices:
+            per_k_out["mij"] = per_k_out["mij"][:, :n, :n]
+            per_k_out["cij"] = per_k_out["cij"][:, :n, :n]
         return per_k_out
 
     return sweep
-
-
-@dataclasses.dataclass
-class SweepTiming:
-    compile_seconds: float
-    run_seconds: float
-
-    @property
-    def resamples_per_second(self) -> float:
-        return float("nan")
 
 
 def run_sweep(
@@ -165,12 +208,16 @@ def run_sweep(
     x: np.ndarray,
     seed: int,
     mesh: Optional[Mesh] = None,
+    profile_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Build, compile and execute a sweep; return host-side results + timings.
 
     The result dict maps output names to NumPy arrays and carries
     ``timing`` (compile vs run wall-clock) — the structured-metrics analog of
-    the reference's tqdm it/s stream (SURVEY.md §5).
+    the reference's tqdm it/s stream (SURVEY.md §5).  ``profile_dir``
+    captures a ``jax.profiler`` trace of the execution (view with
+    TensorBoard / xprof) — the tracing subsystem the reference lacks
+    entirely (SURVEY.md §5 row 1).
     """
     sweep = build_sweep(clusterer, config, mesh)
     key = jax.random.PRNGKey(seed)
@@ -179,10 +226,18 @@ def run_sweep(
     t0 = time.perf_counter()
     compiled = sweep.lower(xj, key).compile()
     t1 = time.perf_counter()
-    out = jax.block_until_ready(compiled(xj, key))
+    # Time until the results are ON HOST, not merely dispatched: on some
+    # platforms (e.g. the axon TPU tunnel) block_until_ready returns before
+    # the device has finished, so the device->host copy is the only reliable
+    # completion barrier.
+    if profile_dir is not None:
+        with jax.profiler.trace(profile_dir):
+            out = compiled(xj, key)
+            host = jax.tree.map(np.asarray, out)
+    else:
+        out = compiled(xj, key)
+        host = jax.tree.map(np.asarray, out)
     t2 = time.perf_counter()
-
-    host = jax.tree.map(np.asarray, out)
     total_resamples = config.n_iterations * len(config.k_values)
     host["timing"] = {
         "compile_seconds": t1 - t0,

@@ -31,9 +31,11 @@ def _fingerprint(config: SweepConfig, seed: int) -> str:
     # independent of which siblings ran (resample plan is K-free, quirk Q8).
     payload.pop("k_values")
     payload.pop("store_matrices")
-    # chunk_size only shapes the accumulation GEMMs; counts are exact
-    # integers either way, so it must not invalidate checkpoints.
+    # chunk_size only shapes the accumulation GEMMs and use_pallas only
+    # selects the histogram kernel; counts are exact integers either way,
+    # so neither may invalidate checkpoints.
     payload.pop("chunk_size")
+    payload.pop("use_pallas", None)
     blob = json.dumps(payload, sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
 
@@ -73,8 +75,12 @@ class SweepCheckpoint:
     def completed_ks(self) -> list:
         out = []
         for name in os.listdir(self.directory):
+            # Strict k<digits>.npz only: a crash between save_k's write and
+            # rename can leave k....npz.tmp.npz behind, which must not parse.
             if name.startswith("k") and name.endswith(".npz"):
-                out.append(int(name[1:-4]))
+                stem = name[1:-4]
+                if stem.isdigit():
+                    out.append(int(stem))
         return sorted(out)
 
     def save_k(self, k: int, entry: Dict[str, np.ndarray]):

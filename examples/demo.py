@@ -1,0 +1,74 @@
+"""Demo: the reference notebook's workflow on the TPU framework.
+
+Mirrors `consensus clustering.ipynb` (the reference's de-facto integration
+test, SURVEY.md §3.5): load the bundled 29x29 correlation dataset, apply a
+PowerTransform, run a KMeans consensus sweep K=4..14 with H=30 resamples,
+print per-K PAC areas, then repeat with a Gaussian-mixture inner clusterer
+for K=5..8 — exercising the ``n_components`` plugin path the reference
+duck-types (consensus_clustering_parallelised.py:205-210).
+
+Differences from the notebook, by design:
+- the sweep runs as one compiled XLA program on the available device(s)
+  instead of 3 joblib worker processes racing on a memmap;
+- the inner clusterers are the JAX-native KMeans / GaussianMixture; swap in
+  ``sklearn.mixture.GaussianMixture(n_init=2)`` to exercise the host
+  adapter with the identical API;
+- Delta(K) and best-K come for free (``areas_``, ``delta_k_``, ``best_k_``).
+
+Run:  python examples/demo.py [--plot]
+"""
+
+import argparse
+
+import numpy as np
+
+from consensus_clustering_tpu import (
+    ConsensusClustering,
+    GaussianMixture,
+    load_corr,
+)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--plot", action="store_true",
+        help="show the per-K consensus CDF figure",
+    )
+    args = parser.parse_args()
+
+    x = load_corr(transform=True)  # notebook cells 2-3
+    print(f"data: {x.shape[0]} samples x {x.shape[1]} features")
+
+    # --- KMeans sweep, notebook cells 8-10 -----------------------------
+    cc = ConsensusClustering(
+        K_range=range(4, 15),
+        random_state=23,
+        n_iterations=30,
+        plot_cdf=args.plot,
+    )
+    cc.fit(x)
+    print("\nKMeans consensus sweep (K=4..14, H=30):")
+    for k, entry in cc.cdf_at_K_data.items():
+        print(f"  K={k:2d}  PAC={entry['pac_area']:.5f}")
+    print(f"  best K by PAC: {cc.best_k_}")
+    print(f"  Delta(K): {np.round(cc.delta_k_, 4).tolist()}")
+
+    # --- GaussianMixture sweep, notebook cells 12-14 -------------------
+    gmm = ConsensusClustering(
+        clusterer=GaussianMixture(n_init=2),
+        clusterer_options={},
+        K_range=range(5, 9),
+        random_state=23,
+        n_iterations=30,
+        plot_cdf=False,
+    )
+    gmm.fit(x)
+    print("\nGaussianMixture consensus sweep (K=5..8, H=30):")
+    for k, entry in gmm.cdf_at_K_data.items():
+        print(f"  K={k:2d}  PAC={entry['pac_area']:.5f}")
+    print(f"  best K by PAC: {gmm.best_k_}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Pallas consensus-histogram kernel vs the XLA fallback and NumPy.
+
+Runs the kernel in interpreter mode (CPU backend, per conftest); the real
+TPU lowering is exercised by bench.py / the driver.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.ops.analysis import cdf_pac
+from consensus_clustering_tpu.ops.pallas_hist import consensus_hist_counts
+
+
+def _numpy_counts(cij, n_valid, row_offset, bins):
+    rows = row_offset + np.arange(cij.shape[0])[:, None]
+    cols = np.arange(cij.shape[1])[None, :]
+    mask = (cols > rows) & (rows < n_valid) & (cols < n_valid)
+    counts, _ = np.histogram(
+        np.asarray(cij)[mask], bins=bins, range=(0.0, 1.0)
+    )
+    return counts
+
+
+class TestPallasHist:
+    @pytest.mark.parametrize("shape", [(29, 29), (64, 128), (300, 300)])
+    def test_full_matrix_matches_numpy(self, rng, shape):
+        cij = rng.random(shape, dtype=np.float32)
+        got = consensus_hist_counts(
+            jnp.asarray(cij), shape[1], 0, 20, use_pallas=True,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), _numpy_counts(cij, shape[1], 0, 20)
+        )
+
+    def test_row_block_with_offset_and_padding(self, rng):
+        # A (40, 130) block of a padded 130x130 layout whose true N is 119:
+        # rows 80..119 are real, 120..129 are layout padding.
+        n_valid, row_offset = 119, 80
+        block = rng.random((40, 130), dtype=np.float32)
+        got = consensus_hist_counts(
+            jnp.asarray(block), n_valid, row_offset, 20, use_pallas=True,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), _numpy_counts(block, n_valid, row_offset, 20)
+        )
+
+    def test_edge_values_bin_like_numpy(self):
+        # Exact bin edges, 1.0 (right-closed last bin), and a value one f32
+        # ulp below an edge must land exactly where np.histogram puts them.
+        vals = np.array(
+            [0.0, 0.05, 0.1, 0.15, np.float32(6 / 40), 0.95, 1.0, 0.999999],
+            dtype=np.float32,
+        )
+        n = vals.size + 1
+        cij = np.zeros((n, n), dtype=np.float32)
+        cij[0, 1:] = vals  # row 0, cols 1.. are strict-upper entries
+        got = consensus_hist_counts(
+            jnp.asarray(cij), n, 0, 20, use_pallas=True, interpret=True
+        )
+        manual = _numpy_counts(cij, n, 0, 20)
+        np.testing.assert_array_equal(np.asarray(got), manual)
+
+    def test_matches_xla_fallback(self, rng):
+        cij = rng.random((100, 100), dtype=np.float32)
+        pallas = consensus_hist_counts(
+            jnp.asarray(cij), 100, 0, 20, use_pallas=True, interpret=True
+        )
+        xla = consensus_hist_counts(
+            jnp.asarray(cij), 100, 0, 20, use_pallas=False
+        )
+        np.testing.assert_array_equal(np.asarray(pallas), np.asarray(xla))
+
+    def test_consistent_with_cdf_pac(self, rng):
+        # cdf_pac's internal counts path and the kernel must agree: same
+        # CDF when counts are fed through cdf_pac_from_counts.
+        from consensus_clustering_tpu.ops.analysis import cdf_pac_from_counts
+
+        cij = rng.random((57, 57), dtype=np.float32)
+        counts = consensus_hist_counts(
+            jnp.asarray(cij), 57, 0, 20, use_pallas=True, interpret=True
+        )
+        hist_k, cdf_k, pac_k = cdf_pac_from_counts(counts, 57, 2, 17)
+        hist_x, cdf_x, pac_x = cdf_pac(jnp.asarray(cij), 2, 17)
+        np.testing.assert_array_equal(np.asarray(cdf_k), np.asarray(cdf_x))
+        np.testing.assert_array_equal(np.asarray(hist_k), np.asarray(hist_x))
+        assert float(pac_k) == float(pac_x)

@@ -119,14 +119,48 @@ class TestSweepSharded:
         # Each point appears in exactly H * n_sub total slots.
         assert ref["iij"].astype(np.int64).trace() == 13 * config.n_sub
 
-    def test_row_sharding_not_yet_supported(self, blobs):
+    @pytest.mark.parametrize("h_shards,row_shards", [(4, 2), (2, 4), (1, 8)])
+    def test_row_sharding_invariance(self, blobs, h_shards, row_shards):
+        # Sharding consensus-matrix ROWS over the 'n' axis (the long-context
+        # analog, SURVEY.md §5.7) must be bit-identical to the 1-device run,
+        # for every (h, n) mesh factorisation.
         x, _ = blobs
-        with pytest.raises(NotImplementedError):
-            build_sweep(
-                KMeans(),
-                _sweep_config(x),
-                resample_mesh(row_shards=2),
-            )
+        config = _sweep_config(x, n_iterations=16)
+        km = KMeans(n_init=2)
+        ref = run_sweep(
+            km, config, x, seed=5, mesh=resample_mesh(jax.devices()[:1])
+        )
+        mesh = resample_mesh(
+            jax.devices()[: h_shards * row_shards], row_shards=row_shards
+        )
+        sharded = run_sweep(km, config, x, seed=5, mesh=mesh)
+        np.testing.assert_array_equal(ref["iij"], sharded["iij"])
+        np.testing.assert_array_equal(ref["mij"], sharded["mij"])
+        np.testing.assert_array_equal(ref["cij"], sharded["cij"])
+        np.testing.assert_allclose(ref["cdf"], sharded["cdf"], atol=1e-7)
+        np.testing.assert_allclose(
+            ref["pac_area"], sharded["pac_area"], atol=1e-7
+        )
+
+    def test_row_sharding_uneven_rows(self, blobs):
+        # N=119 over 8 row shards: 15-row blocks, one row of padding —
+        # padded rows/cols must be cropped and contribute nothing.
+        x, _ = blobs
+        x = x[:119]
+        config = _sweep_config(x, n_iterations=9)
+        km = KMeans(n_init=2)
+        ref = run_sweep(
+            km, config, x, seed=4, mesh=resample_mesh(jax.devices()[:1])
+        )
+        sharded = run_sweep(
+            km, config, x, seed=4, mesh=resample_mesh(row_shards=8)
+        )
+        assert sharded["mij"].shape == (3, 119, 119)
+        np.testing.assert_array_equal(ref["mij"], sharded["mij"])
+        np.testing.assert_array_equal(ref["iij"], sharded["iij"])
+        np.testing.assert_allclose(
+            ref["pac_area"], sharded["pac_area"], atol=1e-7
+        )
 
 
 class TestSweepConfigValidation:
