@@ -405,11 +405,24 @@ class ConsensusClustering:
             from consensus_clustering_tpu.models.agglomerative import (
                 consensus_labels_from_cij,
             )
+            from consensus_clustering_tpu.ops.analysis import (
+                cluster_consensus,
+                item_consensus,
+            )
 
             for k, entry in entries.items():
                 if entry["cij"] is not None:
-                    entry["consensus_labels"] = consensus_labels_from_cij(
+                    labels = consensus_labels_from_cij(
                         entry["cij"], k, linkage=self.agg_clustering_linkage
+                    )
+                    entry["consensus_labels"] = labels
+                    # Monti's per-cluster / per-item consensus statistics
+                    # (extra keys beyond the reference's result schema).
+                    entry["cluster_consensus"] = cluster_consensus(
+                        entry["cij"], labels
+                    )
+                    entry["item_consensus"] = item_consensus(
+                        entry["cij"], labels
                     )
 
         self.cdf_at_K_data = {k: entries[k] for k in config.k_values}

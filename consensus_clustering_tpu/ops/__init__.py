@@ -12,7 +12,9 @@ from consensus_clustering_tpu.ops.analysis import (
     cdf_pac_from_counts,
     masked_histogram_counts,
     area_under_cdf,
+    cluster_consensus,
     delta_k,
+    item_consensus,
     pac_indices,
 )
 
@@ -26,6 +28,8 @@ __all__ = [
     "cdf_pac_from_counts",
     "masked_histogram_counts",
     "area_under_cdf",
+    "cluster_consensus",
     "delta_k",
+    "item_consensus",
     "pac_indices",
 ]

@@ -1,0 +1,36 @@
+"""CLI smoke tests (tiny shapes, CPU)."""
+
+import json
+
+from consensus_clustering_tpu.cli import main
+
+
+class TestCli:
+    def test_run_corr_kmeans(self, tmp_path, capsys):
+        out = tmp_path / "results.json"
+        main([
+            "run", "--dataset", "corr", "--clusterer", "kmeans",
+            "--k", "2:4", "--iterations", "8", "--seed", "23",
+            "--out", str(out),
+        ])
+        result = json.loads(out.read_text())
+        assert result["K"] == [2, 3, 4]
+        assert set(result["pac_area"]) == {"2", "3", "4"} or set(
+            result["pac_area"]
+        ) == {2, 3, 4}
+        assert result["best_k"] in (2, 3, 4)
+        assert len(result["delta_k"]) == 3
+
+    def test_run_comma_k_to_stdout(self, capsys):
+        main([
+            "run", "--dataset", "corr", "--k", "3,5",
+            "--iterations", "6", "--seed", "7",
+        ])
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["K"] == [3, 5]
+
+    def test_unknown_clusterer_exits(self):
+        import pytest
+
+        with pytest.raises(SystemExit):
+            main(["run", "--clusterer", "nope", "--k", "2:3"])

@@ -1,0 +1,107 @@
+"""Monti item/cluster consensus statistics vs naive loops."""
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.ops.analysis import (
+    cluster_consensus,
+    item_consensus,
+)
+
+
+def _naive_cluster_consensus(cij, labels):
+    ks = np.unique(labels)
+    out = np.full(ks.size, np.nan)
+    for idx, k in enumerate(ks):
+        members = np.flatnonzero(labels == k)
+        vals = [
+            cij[i, j] for a, i in enumerate(members)
+            for j in members[a + 1:]
+        ]
+        if vals:
+            out[idx] = np.mean(vals)
+    return out
+
+
+def _naive_item_consensus(cij, labels):
+    ks = np.unique(labels)
+    n = cij.shape[0]
+    out = np.full((n, ks.size), np.nan)
+    for i in range(n):
+        for idx, k in enumerate(ks):
+            members = [j for j in np.flatnonzero(labels == k) if j != i]
+            if members:
+                out[i, idx] = np.mean([cij[i, j] for j in members])
+    return out
+
+
+@pytest.fixture
+def cij_labels(rng):
+    n = 23
+    cij = rng.random((n, n))
+    cij = (cij + cij.T) / 2
+    np.fill_diagonal(cij, 1.0)
+    labels = rng.integers(0, 4, size=n)
+    labels[0] = 3  # ensure every cluster id occurs
+    return cij, labels
+
+
+class TestConsensusStats:
+    def test_cluster_consensus_matches_naive(self, cij_labels):
+        cij, labels = cij_labels
+        np.testing.assert_allclose(
+            cluster_consensus(cij, labels),
+            _naive_cluster_consensus(cij, labels),
+        )
+
+    def test_item_consensus_matches_naive(self, cij_labels):
+        cij, labels = cij_labels
+        np.testing.assert_allclose(
+            item_consensus(cij, labels),
+            _naive_item_consensus(cij, labels),
+        )
+
+    def test_singleton_cluster_is_nan(self):
+        cij = np.eye(3)
+        labels = np.array([0, 1, 1])
+        cc = cluster_consensus(cij, labels)
+        assert np.isnan(cc[0]) and not np.isnan(cc[1])
+        ic = item_consensus(cij, labels)
+        # cluster 0 has no member other than item 0 itself.
+        assert np.isnan(ic[0, 0])
+        assert ic[0, 1] == pytest.approx(0.0)
+
+    def test_perfect_blocks(self):
+        # Two perfect consensus blocks: within-cluster consensus 1, item
+        # consensus 1 for own cluster and 0 for the other.
+        cij = np.zeros((4, 4))
+        cij[:2, :2] = 1.0
+        cij[2:, 2:] = 1.0
+        labels = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            cluster_consensus(cij, labels), [1.0, 1.0]
+        )
+        ic = item_consensus(cij, labels)
+        np.testing.assert_allclose(ic[:, 0], [1.0, 1.0, 0.0, 0.0])
+        np.testing.assert_allclose(ic[:, 1], [0.0, 0.0, 1.0, 1.0])
+
+    def test_api_integration(self, blobs):
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, _ = blobs
+        # H=16: with H=8 and seed 0 one point is (legitimately) never
+        # sampled — all-zero consensus row, singleton cluster, NaN stats.
+        cc = ConsensusClustering(
+            K_range=(3,), n_iterations=16, random_state=0, plot_cdf=False,
+            compute_consensus_labels=True, store_matrices=True,
+        )
+        cc.fit(x)
+        entry = cc.cdf_at_K_data[3]
+        assert len(entry["consensus_labels"]) == x.shape[0]
+        assert entry["cluster_consensus"].shape[0] >= 1
+        assert entry["item_consensus"].shape == (
+            x.shape[0], entry["cluster_consensus"].shape[0]
+        )
+        # Well-separated blobs at the true K: strong within-cluster
+        # consensus.
+        assert np.nanmin(entry["cluster_consensus"]) > 0.8
