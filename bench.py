@@ -1,80 +1,149 @@
 """Benchmark: full consensus k-sweep throughput vs the CPU-joblib reference.
 
-Headline config is BASELINE.json #2: make_blobs N=5000 d=50, KMeans(n_init=3)
-inner clusterer, H=500 resamples, K in [2, 20] — run as ONE compiled XLA
-program on the available device(s).  The CPU baseline
-(benchmarks/baseline_cpu.json) was measured by running the actual reference
-implementation on this machine (serially: single-core box, and n_jobs=1 is
-the reference's only race-free mode), steady-state resamples/sec per K,
-extrapolated linearly in H (per-resample work is H-independent).
+Headline config (the default, what the driver records) is BASELINE.json #2:
+make_blobs N=5000 d=50, KMeans(n_init=3) inner clusterer, H=500 resamples,
+K in [2, 20] — run as ONE compiled XLA program on the available device(s).
+The CPU baseline (benchmarks/baseline_cpu.json) was measured by running the
+actual reference implementation on this machine (serially: single-core box,
+and n_jobs=1 is the reference's only race-free mode), steady-state
+resamples/sec per K, extrapolated linearly in H (per-resample work is
+H-independent).
 
 Prints exactly one JSON line:
   {"metric": ..., "value": <resamples/sec>, "unit": "resamples/sec",
    "vs_baseline": <speedup>, ...}
+
+The other BASELINE.json configs run via --config (corr / blobs10k / agglo /
+spectral); shapes scaled down to one chip are marked in the metric string.
 """
 
+import argparse
 import json
 import os
-import sys
-import time
 
 
-def main():
-    import jax
-
-    backend = jax.default_backend()
-    on_accelerator = backend not in ("cpu",)
-
+def _blobs(n, d, seed=0):
     import numpy as np
     from sklearn.datasets import make_blobs
 
+    x, _ = make_blobs(
+        n_samples=n, n_features=d, centers=8, cluster_std=3.0,
+        random_state=seed,
+    )
+    return x.astype(np.float32)
+
+
+def _build(config_name, small):
+    """Returns (clusterer, SweepConfig, x, metric string, is_headline)."""
     from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.data import load_corr
+    from consensus_clustering_tpu.models.agglomerative import (
+        AgglomerativeClustering,
+    )
     from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.models.spectral import SpectralClustering
+
+    if config_name == "headline":
+        n, d, h, k_hi = (500, 20, 50, 10) if small else (5000, 50, 500, 20)
+        x = _blobs(n, d)
+        metric = (f"consensus k-sweep throughput (N={n} d={d} H={h} "
+                  f"K=2..{k_hi}, KMeans n_init=3)")
+        cfg = SweepConfig(
+            n_samples=n, n_features=d, k_values=tuple(range(2, k_hi + 1)),
+            n_iterations=h, store_matrices=False, chunk_size=16,
+        )
+        # KMeans(n_init=3) mirrors the reference's default clusterer_options.
+        return KMeans(n_init=3), cfg, x, metric, not small
+    if config_name == "corr":
+        # BASELINE config #1: bundled dataset, H=100, k in [2, 10].
+        x = load_corr(transform=True)
+        cfg = SweepConfig(
+            n_samples=x.shape[0], n_features=x.shape[1],
+            k_values=tuple(range(2, 11)), n_iterations=100,
+            store_matrices=False,
+        )
+        return (KMeans(n_init=3), cfg, x,
+                "corr.csv KMeans H=100 K=2..10", False)
+    if config_name == "blobs10k":
+        # BASELINE config #3 (large-N consensus matrix): N=10000, H=1000.
+        n, h = (1000, 100) if small else (10000, 1000)
+        x = _blobs(n, 50)
+        cfg = SweepConfig(
+            n_samples=n, n_features=50, k_values=tuple(range(2, 21)),
+            n_iterations=h, store_matrices=False, chunk_size=8,
+        )
+        return (KMeans(n_init=3), cfg, x,
+                f"large-N blobs N={n} KMeans H={h} K=2..20", False)
+    if config_name == "agglo":
+        # BASELINE config #4: agglomerative inner clusterer on corr, H=500.
+        x = load_corr(transform=True)
+        cfg = SweepConfig(
+            n_samples=x.shape[0], n_features=x.shape[1],
+            k_values=tuple(range(2, 11)), n_iterations=500,
+            store_matrices=False,
+        )
+        return (AgglomerativeClustering(linkage="average"), cfg, x,
+                "corr.csv Agglomerative H=500 K=2..10", False)
+    if config_name == "spectral":
+        # BASELINE config #5 scaled to one chip (the full N=20000 H=2000
+        # k<=30 shape assumes a v4-32 pod).
+        n, h, k_hi = (512, 10, 6) if small else (2000, 50, 10)
+        x = _blobs(n, 30)
+        cfg = SweepConfig(
+            n_samples=n, n_features=30, k_values=tuple(range(2, k_hi + 1)),
+            n_iterations=h, store_matrices=False,
+        )
+        return (
+            SpectralClustering(gamma=0.02, solver="lobpcg"), cfg, x,
+            f"spectral(lobpcg) blobs N={n} H={h} K=2..{k_hi} [scaled-down]",
+            False,
+        )
+    raise SystemExit(f"unknown --config {config_name!r}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--config", default="headline",
+        choices=["headline", "corr", "blobs10k", "agglo", "spectral"],
+    )
+    parser.add_argument(
+        "--small", action="store_true",
+        help="toy shapes (same code path); implied on CPU",
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    backend = jax.default_backend()
+    small = args.small or backend == "cpu"
+
     from consensus_clustering_tpu.parallel.sweep import run_sweep
 
-    if on_accelerator and "--small" not in sys.argv:
-        n, d, h, k_hi = 5000, 50, 500, 20
-    else:
-        # CPU smoke config: same code path, toy shapes.
-        n, d, h, k_hi = 500, 20, 50, 10
+    clusterer, config, x, metric, is_headline = _build(args.config, small)
+    out = run_sweep(clusterer, config, x, seed=23)
 
-    x, _ = make_blobs(
-        n_samples=n, n_features=d, centers=8, cluster_std=3.0, random_state=0
-    )
-    x = x.astype(np.float32)
-
-    config = SweepConfig(
-        n_samples=n,
-        n_features=d,
-        k_values=tuple(range(2, k_hi + 1)),
-        n_iterations=h,
-        subsampling=0.8,
-        store_matrices=False,
-        chunk_size=16,
-    )
-    # KMeans(n_init=3) mirrors the reference's default clusterer_options.
-    out = run_sweep(KMeans(n_init=3), config, x, seed=23)
-
-    total_resamples = h * len(config.k_values)
+    total_resamples = config.n_iterations * len(config.k_values)
     rate = out["timing"]["resamples_per_second"]
     wall = out["timing"]["run_seconds"]
 
-    baseline_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "benchmarks", "baseline_cpu.json",
-    )
     vs_baseline = None
-    is_baseline_config = (n, d, h, k_hi) == (5000, 50, 500, 20)
-    if is_baseline_config and os.path.exists(baseline_path):
-        with open(baseline_path) as f:
-            base = json.load(f)
-        base_total = 500 * len(range(2, 21))
-        base_rate = base_total / base["sweep_wall_seconds_extrapolated_H500"]
-        vs_baseline = rate / base_rate
+    if is_headline:
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks", "baseline_cpu.json",
+        )
+        if os.path.exists(baseline_path):
+            with open(baseline_path) as f:
+                base = json.load(f)
+            base_total = 500 * len(range(2, 21))
+            base_rate = (
+                base_total / base["sweep_wall_seconds_extrapolated_H500"]
+            )
+            vs_baseline = rate / base_rate
 
     record = {
-        "metric": "consensus k-sweep throughput "
-                  f"(N={n} d={d} H={h} K=2..{k_hi}, KMeans n_init=3)",
+        "metric": metric,
         "value": round(rate, 2),
         "unit": "resamples/sec",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
@@ -84,6 +153,9 @@ def main():
         "total_resamples": total_resamples,
         "pac_head": [round(float(p), 5) for p in out["pac_area"][:3]],
     }
+    peak = out["timing"].get("device_memory", {}).get("peak_bytes_in_use")
+    if peak:
+        record["peak_device_bytes"] = peak
     print(json.dumps(record))
 
 

@@ -121,6 +121,9 @@ class ConsensusClustering:
         Force the Pallas consensus-histogram kernel on (True) or off
         (False); None (default) picks by backend — Pallas on accelerators,
         XLA fallback on CPU.
+    metrics_path : str, keyword-only, optional
+        Append structured JSON-lines run metrics (timings, resamples/sec,
+        device-memory high-water, per-K PAC) to this file.
 
     Attributes
     ----------
@@ -163,6 +166,7 @@ class ConsensusClustering:
         progress: bool = True,
         profile_dir: Optional[str] = None,
         use_pallas: Optional[bool] = None,
+        metrics_path: Optional[str] = None,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -205,6 +209,7 @@ class ConsensusClustering:
         self.progress = progress
         self.profile_dir = profile_dir
         self.use_pallas = use_pallas
+        self.metrics_path = metrics_path
 
     # -- clusterer resolution -------------------------------------------
 
@@ -352,6 +357,22 @@ class ConsensusClustering:
 
         self._build_results(out, config, missing, loaded, ckpt)
 
+        from consensus_clustering_tpu.utils.metrics import MetricsLogger
+
+        MetricsLogger(self.metrics_path).emit(
+            "sweep_complete",
+            n_samples=n,
+            k_values=list(config.k_values),
+            n_iterations=config.n_iterations,
+            resumed_ks=sorted(loaded),
+            pac_area={
+                int(k): float(v["pac_area"])
+                for k, v in self.cdf_at_K_data.items()
+            },
+            best_k=self.best_k_,
+            **self.metrics_,
+        )
+
         if self.plot_cdf:
             from consensus_clustering_tpu.utils.plotting import plot_cdf
 
@@ -448,7 +469,9 @@ class ConsensusClustering:
         self.metrics_ = (
             dict(out["timing"])
             if out is not None
+            # Fully resumed: no compute ran, so there is no rate — None,
+            # not inf (json.dumps would emit the non-standard `Infinity`).
             else {"compile_seconds": 0.0, "run_seconds": 0.0,
-                  "resamples_per_second": float("inf"),
+                  "resamples_per_second": None,
                   "resumed_from_checkpoint": True}
         )

@@ -86,6 +86,7 @@ def cmd_run(args):
         compute_consensus_labels=False,
         profile_dir=args.profile_dir,
         use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
+        metrics_path=args.metrics_path,
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -142,6 +143,8 @@ def main(argv=None):
     run.add_argument("--use-pallas", choices=["auto", "on", "off"],
                      default="auto",
                      help="consensus-histogram kernel selection")
+    run.add_argument("--metrics-path", default=None,
+                     help="append JSON-lines run metrics to this file")
     run.add_argument("--out", default=None)
     run.set_defaults(fn=cmd_run)
 

@@ -1,6 +1,7 @@
-"""Execution layer: device meshes and the compiled, sharded k-sweep."""
+"""Execution layer: meshes, the compiled sharded k-sweep, multi-host init."""
 
+from consensus_clustering_tpu.parallel import distributed
 from consensus_clustering_tpu.parallel.mesh import resample_mesh
 from consensus_clustering_tpu.parallel.sweep import build_sweep, run_sweep
 
-__all__ = ["resample_mesh", "build_sweep", "run_sweep"]
+__all__ = ["distributed", "resample_mesh", "build_sweep", "run_sweep"]

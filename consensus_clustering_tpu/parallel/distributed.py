@@ -1,0 +1,81 @@
+"""Multi-host bootstrap: one call before building a cross-host mesh.
+
+The reference is strictly single-host — its only "transport" is a shared
+memmap file (consensus_clustering_parallelised.py:154-159; SURVEY.md §2.5).
+Here multi-host scaling needs no new communication code: once every process
+has called :func:`initialize`, ``jax.devices()`` spans all hosts, the same
+``resample_mesh`` / ``build_sweep`` program runs unchanged, and XLA routes
+the ``psum``/``all_gather`` collectives over ICI within a slice and DCN
+across slices.
+
+Typical multi-host launch (same script on every host)::
+
+    from consensus_clustering_tpu.parallel import distributed, resample_mesh
+    distributed.initialize()                  # env-driven on TPU pods
+    mesh = resample_mesh(row_shards=2)        # global devices
+    cc = ConsensusClustering(..., mesh=mesh)
+
+On TPU pods the coordinator/process_id arguments resolve from the
+environment automatically; on CPU/GPU clusters pass them explicitly.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise the JAX distributed runtime (idempotent).
+
+    A thin, logged wrapper over ``jax.distributed.initialize``: safe to call
+    when already initialised (logs and returns) and in single-process runs
+    with explicit ``num_processes=1`` (no-op).
+    """
+    if num_processes == 1:
+        logger.info("distributed: single process, nothing to initialise")
+        return
+    if _already_initialized():
+        logger.info("distributed: already initialised")
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Belt and braces across jax versions: the double-init message has
+        # been both "already initialized" and "should only be called once".
+        msg = str(e).lower()
+        if "already initialized" in msg or "only be called once" in msg:
+            logger.info("distributed: already initialised")
+            return
+        raise
+    logger.info(
+        "distributed: process %d/%d up, %d global devices",
+        jax.process_index(), jax.process_count(), len(jax.devices()),
+    )
+
+
+def _already_initialized() -> bool:
+    """True if the jax distributed client is already up (version-tolerant)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
+def is_primary() -> bool:
+    """True on the process that should write checkpoints/plots/logs."""
+    return jax.process_index() == 0

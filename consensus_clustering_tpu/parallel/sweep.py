@@ -239,9 +239,12 @@ def run_sweep(
         host = jax.tree.map(np.asarray, out)
     t2 = time.perf_counter()
     total_resamples = config.n_iterations * len(config.k_values)
+    from consensus_clustering_tpu.utils.metrics import device_memory_stats
+
     host["timing"] = {
         "compile_seconds": t1 - t0,
         "run_seconds": t2 - t1,
         "resamples_per_second": total_resamples / max(t2 - t1, 1e-9),
+        "device_memory": device_memory_stats(),
     }
     return host

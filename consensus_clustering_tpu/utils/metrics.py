@@ -1,0 +1,60 @@
+"""Structured run metrics: JSON-lines events + device memory high-water.
+
+The reference's only telemetry is a tqdm it/s stream and one bare print
+(SURVEY.md §5 "Metrics / logging / observability").  Here every sweep can
+emit machine-readable events — compile/run wall-clock, resamples/sec, and
+the device's peak HBM bytes when the backend exposes allocator stats — to a
+JSON-lines file or a logger.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Allocator stats of the first addressable device, {} if unsupported.
+
+    On TPU/GPU backends this includes ``peak_bytes_in_use`` — the HBM
+    high-water mark SURVEY.md §5 asks the build to record.  The CPU
+    interpreter (and some plugin backends) return nothing.
+    """
+    dev = jax.local_devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except (AttributeError, NotImplementedError, RuntimeError):
+        return {}
+    if not stats:
+        return {}
+    keep = (
+        "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+        "largest_alloc_size",
+    )
+    return {k: int(v) for k, v in stats.items() if k in keep}
+
+
+class MetricsLogger:
+    """Append structured events to a JSON-lines file and/or the log.
+
+    Each event is one line: ``{"ts": <unix>, "event": <name>, ...fields}``.
+    ``path=None`` logs via :mod:`logging` only.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+
+    def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
+        record = {"ts": round(time.time(), 3), "event": event, **fields}
+        line = json.dumps(record, default=float, sort_keys=True)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        logger.info("metrics: %s", line)
+        return record

@@ -1,0 +1,58 @@
+"""Structured metrics and multi-host bootstrap helpers."""
+
+import json
+
+import numpy as np
+
+from consensus_clustering_tpu.utils.metrics import (
+    MetricsLogger,
+    device_memory_stats,
+)
+
+
+class TestMetrics:
+    def test_device_memory_stats_shape(self):
+        stats = device_memory_stats()
+        # CPU interpreter may expose nothing; whatever comes back must be
+        # int-valued and from the allowed key set.
+        assert all(isinstance(v, int) for v in stats.values())
+        assert set(stats) <= {
+            "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size",
+        }
+
+    def test_jsonl_emission(self, tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        m = MetricsLogger(str(path))
+        m.emit("sweep_complete", resamples_per_second=123.4, best_k=3)
+        m.emit("other", nested={"a": 1})
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 2
+        first = json.loads(lines[0])
+        assert first["event"] == "sweep_complete"
+        assert first["best_k"] == 3
+        assert "ts" in first
+
+    def test_api_emits_metrics(self, tmp_path, blobs):
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, _ = blobs
+        path = tmp_path / "m.jsonl"
+        cc = ConsensusClustering(
+            K_range=(2, 3), n_iterations=6, random_state=1, plot_cdf=False,
+            store_matrices=False, metrics_path=str(path),
+        )
+        cc.fit(x)
+        record = json.loads(path.read_text().strip().splitlines()[-1])
+        assert record["event"] == "sweep_complete"
+        assert record["k_values"] == [2, 3]
+        assert record["resamples_per_second"] > 0
+        assert set(record["pac_area"]) == {"2", "3"}
+
+
+class TestDistributed:
+    def test_single_process_noop(self):
+        from consensus_clustering_tpu.parallel import distributed
+
+        distributed.initialize(num_processes=1)  # must not raise
+        assert distributed.is_primary()

@@ -175,3 +175,32 @@ class TestSpectral:
             )
         )
         assert adjusted_rand_score(y, labels) > 0.99
+
+    def test_lobpcg_solver_matches_dense(self, blobs):
+        # The large-subsample eigensolver (top-k block power iteration)
+        # must recover the same clustering as the exact dense eigh path,
+        # and vmap over resample keys.
+        x, y = blobs
+        xj = jnp.asarray(x)
+        lob = SpectralClustering(gamma=0.5, solver="lobpcg")
+        labels = np.asarray(
+            lob.fit_predict(jax.random.PRNGKey(4), xj, 3, 6)
+        )
+        assert adjusted_rand_score(y, labels) > 0.99
+        keys = jax.random.split(jax.random.PRNGKey(5), 3)
+        batch = np.asarray(
+            jax.vmap(lambda kk: lob.fit_predict(kk, xj, 3, 6))(keys)
+        )
+        for row in batch:
+            assert adjusted_rand_score(y, row) > 0.99
+
+    def test_lobpcg_small_subsample_falls_back_dense(self, blobs):
+        # n < 4 * k_max: LOBPCG's block cannot fit; silently use eigh.
+        x, y = blobs
+        xj = jnp.asarray(x[:20])
+        labels = np.asarray(
+            SpectralClustering(gamma=0.5, solver="lobpcg").fit_predict(
+                jax.random.PRNGKey(6), xj, 3, 6
+            )
+        )
+        assert labels.shape == (20,) and labels.max() < 3
