@@ -124,6 +124,12 @@ class ConsensusClustering:
     metrics_path : str, keyword-only, optional
         Append structured JSON-lines run metrics (timings, resamples/sec,
         device-memory high-water, per-K PAC) to this file.
+    k_batch_size : int, keyword-only, optional
+        Run the K sweep in batches of this many K values, each its own
+        compiled program, checkpointing after every batch (needs
+        ``checkpoint_dir`` for the resume benefit).  Caps peak HBM when
+        storing matrices and bounds how much work a crash can lose, at the
+        cost of one compilation per batch.  None (default) = one program.
 
     Attributes
     ----------
@@ -167,6 +173,7 @@ class ConsensusClustering:
         profile_dir: Optional[str] = None,
         use_pallas: Optional[bool] = None,
         metrics_path: Optional[str] = None,
+        k_batch_size: Optional[int] = None,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -210,6 +217,9 @@ class ConsensusClustering:
         self.profile_dir = profile_dir
         self.use_pallas = use_pallas
         self.metrics_path = metrics_path
+        if k_batch_size is not None and k_batch_size < 1:
+            raise ValueError(f"k_batch_size must be >= 1, got {k_batch_size}")
+        self.k_batch_size = k_batch_size
 
     # -- clusterer resolution -------------------------------------------
 
@@ -332,30 +342,50 @@ class ConsensusClustering:
                     loaded[k] = entry
             missing = [k for k in config.k_values if k not in loaded]
 
-        out = None
+        entries: Dict[int, dict] = {}
+        timings = []
+        shared_iij = None
         if missing:
-            run_config = dataclasses.replace(
-                config, k_values=tuple(missing)
-            )
             clusterer, is_host = self._resolve_clusterer()
-            if is_host:
-                from consensus_clustering_tpu.parallel.host import (
-                    run_host_sweep,
+            batch = self.k_batch_size or len(missing)
+            for i0 in range(0, len(missing), batch):
+                chunk = missing[i0:i0 + batch]
+                run_config = dataclasses.replace(
+                    config, k_values=tuple(chunk)
                 )
+                if is_host:
+                    from consensus_clustering_tpu.parallel.host import (
+                        run_host_sweep,
+                    )
 
-                out = run_host_sweep(
-                    clusterer, run_config, X, self.random_state,
-                    progress=self.progress,
+                    out = run_host_sweep(
+                        clusterer, run_config, X, self.random_state,
+                        progress=self.progress,
+                    )
+                else:
+                    from consensus_clustering_tpu.parallel.sweep import (
+                        run_sweep,
+                    )
+
+                    out = run_sweep(
+                        clusterer, run_config, X, self.random_state,
+                        mesh=self.mesh, profile_dir=self.profile_dir,
+                    )
+                chunk_entries = self._entries_from_out(
+                    out, chunk, config, shared_iij
                 )
-            else:
-                from consensus_clustering_tpu.parallel.sweep import run_sweep
+                if config.store_matrices and shared_iij is None and chunk:
+                    shared_iij = chunk_entries[chunk[0]]["iij"]
+                # Checkpoint as soon as a batch lands: a crash mid-sweep
+                # resumes from the completed batches (SURVEY.md §5 failure
+                # recovery — the reference loses everything).
+                if ckpt is not None:
+                    for k in chunk:
+                        ckpt.save_k(k, chunk_entries[k])
+                entries.update(chunk_entries)
+                timings.append(out["timing"])
 
-                out = run_sweep(
-                    clusterer, run_config, X, self.random_state,
-                    mesh=self.mesh, profile_dir=self.profile_dir,
-                )
-
-        self._build_results(out, config, missing, loaded, ckpt)
+        self._build_results(entries, config, loaded, timings)
 
         from consensus_clustering_tpu.utils.metrics import MetricsLogger
 
@@ -379,37 +409,53 @@ class ConsensusClustering:
             plot_cdf(self.cdf_at_K_data, self.PAC_interval)
         return self
 
-    def _build_results(
+    def _entries_from_out(
         self,
-        out: Optional[Dict[str, Any]],
+        out: Dict[str, Any],
+        ks: list,
         config: SweepConfig,
-        fresh_ks: list,
-        loaded: Dict[int, Dict[str, np.ndarray]],
-        ckpt,
-    ):
+        shared_iij: Optional[np.ndarray] = None,
+    ) -> Dict[int, dict]:
+        """Per-K result-dict entries (the reference's schema) from one
+        executed batch.
+
+        ``shared_iij`` lets k-batched fits reuse one converted host copy of
+        the K-independent Iij (quirk Q8) instead of allocating an identical
+        (N, N) array per batch.
+        """
         acc_dtype = self._accumulator_dtype()
         edges = _bin_edges(config.bins)
-
+        iij = (
+            shared_iij
+            if shared_iij is not None
+            else out["iij"].astype(acc_dtype)
+        )
         entries: Dict[int, dict] = {}
-        if out is not None:
-            iij = out["iij"].astype(acc_dtype)
-            for i, k in enumerate(fresh_ks):
-                entry = {
-                    "consensus_labels": [],
-                    "hist": out["hist"][i].astype(np.float64),
-                    "cdf": out["cdf"][i].astype(np.float64),
-                    "bin_edges": edges,
-                    "pac_area": float(out["pac_area"][i]),
-                }
-                if config.store_matrices:
-                    entry["mij"] = out["mij"][i].astype(acc_dtype)
-                    entry["iij"] = iij
-                    entry["cij"] = out["cij"][i]
-                else:
-                    entry["mij"] = entry["cij"] = entry["iij"] = None
-                entries[k] = entry
-                if ckpt is not None:
-                    ckpt.save_k(k, entry)
+        for i, k in enumerate(ks):
+            entry = {
+                "consensus_labels": [],
+                "hist": out["hist"][i].astype(np.float64),
+                "cdf": out["cdf"][i].astype(np.float64),
+                "bin_edges": edges,
+                "pac_area": float(out["pac_area"][i]),
+            }
+            if config.store_matrices:
+                entry["mij"] = out["mij"][i].astype(acc_dtype)
+                entry["iij"] = iij
+                entry["cij"] = out["cij"][i]
+            else:
+                entry["mij"] = entry["cij"] = entry["iij"] = None
+            entries[k] = entry
+        return entries
+
+    def _build_results(
+        self,
+        entries: Dict[int, dict],
+        config: SweepConfig,
+        loaded: Dict[int, Dict[str, np.ndarray]],
+        timings: list,
+    ):
+        edges = _bin_edges(config.bins)
         for k, saved in loaded.items():
             entries[k] = {
                 "consensus_labels": [],
@@ -466,12 +512,25 @@ class ConsensusClustering:
         self.best_k_ = int(max(
             k for k, hit in zip(config.k_values, near_min) if hit
         ))
-        self.metrics_ = (
-            dict(out["timing"])
-            if out is not None
+        if timings:
+            compile_s = sum(t["compile_seconds"] for t in timings)
+            run_s = sum(t["run_seconds"] for t in timings)
+            n_fresh = sum(1 for k in config.k_values if k not in loaded)
+            total = config.n_iterations * n_fresh
+            self.metrics_ = {
+                "compile_seconds": compile_s,
+                "run_seconds": run_s,
+                "resamples_per_second": total / max(run_s, 1e-9),
+                "n_batches": len(timings),
+            }
+            mem = timings[-1].get("device_memory")
+            if mem:
+                self.metrics_["device_memory"] = mem
+        else:
             # Fully resumed: no compute ran, so there is no rate — None,
             # not inf (json.dumps would emit the non-standard `Infinity`).
-            else {"compile_seconds": 0.0, "run_seconds": 0.0,
-                  "resamples_per_second": None,
-                  "resumed_from_checkpoint": True}
-        )
+            self.metrics_ = {
+                "compile_seconds": 0.0, "run_seconds": 0.0,
+                "resamples_per_second": None,
+                "resumed_from_checkpoint": True,
+            }

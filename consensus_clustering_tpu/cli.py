@@ -87,6 +87,7 @@ def cmd_run(args):
         profile_dir=args.profile_dir,
         use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
         metrics_path=args.metrics_path,
+        k_batch_size=args.k_batch_size,
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -117,7 +118,9 @@ def cmd_bench(args):
     del args
     import bench  # repo-root benchmark; one-JSON-line contract
 
-    bench.main()
+    # Explicit empty argv: bench has its own parser and must not re-parse
+    # this process's sys.argv (which still holds the 'bench' token).
+    bench.main([])
 
 
 def main(argv=None):
@@ -145,6 +148,9 @@ def main(argv=None):
                      help="consensus-histogram kernel selection")
     run.add_argument("--metrics-path", default=None,
                      help="append JSON-lines run metrics to this file")
+    run.add_argument("--k-batch-size", type=int, default=None,
+                     help="compile/run the sweep in batches of this many "
+                          "K values, checkpointing after each")
     run.add_argument("--out", default=None)
     run.set_defaults(fn=cmd_run)
 
