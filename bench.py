@@ -113,9 +113,35 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
 
+    # Fail fast if backend init hangs (e.g. a wedged TPU tunnel): a clear
+    # error beats an indefinite hang under the driver.  Compile/run time is
+    # NOT under this watchdog — only device discovery.
+    import threading
+
+    try:
+        init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    except ValueError:
+        init_timeout = 240.0
+    ready = threading.Event()
+
+    def _watchdog():
+        if not ready.wait(timeout=init_timeout):
+            import sys
+
+            print(
+                f"bench: backend init exceeded {init_timeout:.0f}s "
+                "(tunnel wedged?); aborting",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(3)
+
+    if init_timeout > 0:  # <= 0 disables the watchdog
+        threading.Thread(target=_watchdog, daemon=True).start()
+
     import jax
 
     backend = jax.default_backend()
+    ready.set()
     small = args.small or backend == "cpu"
 
     from consensus_clustering_tpu.parallel.sweep import run_sweep

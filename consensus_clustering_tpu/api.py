@@ -12,10 +12,12 @@ Deliberate divergences from the reference (each per SURVEY.md §7.4):
 - Q1: ``random_state=None`` (the reference default) raises a clear
   ValueError at fit time instead of crashing with TypeError deep in the
   resample loop; pass an integer seed.
-- Q2/Q3: ``n_jobs`` / ``parallelization_method`` / ``memmap_folder`` are
-  accepted for compatibility but ignored (with a log message): parallelism
-  comes from the device mesh, accumulation is an exact psum, and there is no
-  shared mutable state to race on.
+- Q2/Q3: device sweeps take their parallelism from the mesh, accumulation
+  is an exact psum, and there is no shared mutable state to race on.
+  ``n_jobs`` still parallelises the *host-backend* labelling loop (sklearn
+  clusterers) with joblib threads — race-free, since each task owns its
+  label row and each fit clones the estimator; ``parallelization_method``
+  and ``memmap_folder`` are accepted but ignored (with a log message).
 - Q4: on-device accumulators are int32; the result dict's ``mij``/``iij``
   are cast to the reference's uint8/uint16 dtype rule for H < 2^16, and kept
   uint32 beyond it instead of silently overflowing.
@@ -193,8 +195,10 @@ class ConsensusClustering:
 
         if n_jobs != 1 or parallelization_method != "multithreading":
             logger.info(
-                "n_jobs/parallelization_method are ignored: parallelism "
-                "comes from the device mesh (got n_jobs=%s, method=%r)",
+                "device sweeps parallelise over the mesh; n_jobs=%s applies "
+                "only to host-backend (sklearn) clusterer labelling, and "
+                "parallelization_method=%r is ignored (threads are race-free "
+                "here: no shared accumulator or estimator)",
                 n_jobs, parallelization_method,
             )
         if memmap_folder is not None:
@@ -360,7 +364,7 @@ class ConsensusClustering:
 
                     out = run_host_sweep(
                         clusterer, run_config, X, self.random_state,
-                        progress=self.progress,
+                        progress=self.progress, n_jobs=self.n_jobs,
                     )
                 else:
                     from consensus_clustering_tpu.parallel.sweep import (
@@ -425,11 +429,14 @@ class ConsensusClustering:
         """
         acc_dtype = self._accumulator_dtype()
         edges = _bin_edges(config.bins)
-        iij = (
-            shared_iij
-            if shared_iij is not None
-            else out["iij"].astype(acc_dtype)
-        )
+        if config.store_matrices:
+            # Only materialise the host-dtype copy when it will be kept —
+            # per batch this is a full (N, N) array.
+            iij = (
+                shared_iij
+                if shared_iij is not None
+                else out["iij"].astype(acc_dtype)
+            )
         entries: Dict[int, dict] = {}
         for i, k in enumerate(ks):
             entry = {

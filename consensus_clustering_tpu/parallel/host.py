@@ -38,9 +38,19 @@ def run_host_sweep(
     x: np.ndarray,
     seed: int,
     progress: bool = True,
+    n_jobs: int = 1,
 ) -> Dict[str, Any]:
     """Run the sweep with host-side labelling; same result schema as
-    :func:`consensus_clustering_tpu.parallel.sweep.run_sweep`."""
+    :func:`consensus_clustering_tpu.parallel.sweep.run_sweep`.
+
+    ``n_jobs`` parallelises the host labelling loop with joblib threads —
+    the reference's execution-backend semantics
+    (consensus_clustering_parallelised.py:185-189) made race-free: every
+    task owns its label row and each fit clones the estimator
+    (:class:`SklearnClusterer`), so there is no shared accumulator (quirk
+    Q2) and no shared estimator (quirk Q3) to race on.  Accumulation stays
+    one functional device pass per K either way.
+    """
     n = config.n_samples
     lo, hi = config.pac_idx
     x = np.asarray(x)
@@ -71,21 +81,38 @@ def run_host_sweep(
     if config.store_matrices:
         out["mij"], out["cij"] = [], []
 
+    def _fit_seed(h: int) -> int:
+        # Reference semantics by default (fixed estimator seed per fit);
+        # opt-in per-resample streams mirror the resample plan's
+        # ``seed + i`` pattern.
+        return seed + h if config.reseed_clusterer_per_resample else seed
+
     for k in config.k_values:
-        labels = np.empty_like(indices)
-        it = progress_iter(
-            range(config.n_iterations),
-            desc=f"Consensus clustering with {k} clusters",
-            enabled=progress,
-        )
-        for h in it:
-            # Reference semantics by default (fixed estimator seed per fit);
-            # opt-in per-resample streams mirror the resample plan's
-            # ``seed + i`` pattern.
-            fit_seed = (
-                seed + h if config.reseed_clusterer_per_resample else seed
+        desc = f"Consensus clustering with {k} clusters"
+        if n_jobs != 1:
+            from joblib import Parallel, delayed
+
+            # return_as='generator': the progress bar tracks COMPLETED
+            # fits; iterating the task generator directly would only track
+            # joblib's (look-ahead) dispatch.
+            gen = Parallel(
+                n_jobs=n_jobs, prefer="threads", return_as="generator"
+            )(
+                delayed(clusterer.fit_predict_host)(
+                    _fit_seed(h), x[indices[h]], k
+                )
+                for h in range(config.n_iterations)
             )
-            labels[h] = clusterer.fit_predict_host(fit_seed, x[indices[h]], k)
+            rows = list(progress_iter(gen, desc=desc, enabled=progress))
+            labels = np.asarray(rows, dtype=indices.dtype)
+        else:
+            labels = np.empty_like(indices)
+            for h in progress_iter(
+                range(config.n_iterations), desc=desc, enabled=progress
+            ):
+                labels[h] = clusterer.fit_predict_host(
+                    _fit_seed(h), x[indices[h]], k
+                )
         mij, cij, hist, cdf, pac = analyse(
             jnp.asarray(labels), indices_dev, iij_dev
         )

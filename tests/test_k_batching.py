@@ -47,6 +47,27 @@ class TestKBatching:
                 second.cdf_at_K_data[k]["mij"],
             )
 
+    def test_partial_checkpoint_recomputes_only_missing(self, blobs, tmp_path):
+        # Simulate a crash after the first batch: only Ks 2,3 are on disk.
+        # The refit must recompute exactly the missing Ks (one batch) and
+        # agree bit-for-bit with the uninterrupted run.
+        import os
+
+        x, _ = blobs
+        full = _fit(x, k_batch_size=2, checkpoint_dir=str(tmp_path))
+        for k in (4, 5):
+            os.remove(tmp_path / f"k{k:04d}.npz")
+        refit = _fit(x, k_batch_size=2, checkpoint_dir=str(tmp_path))
+        assert refit.metrics_["n_batches"] == 1  # only Ks {4, 5} re-ran
+        for k in (2, 3, 4, 5):
+            np.testing.assert_array_equal(
+                full.cdf_at_K_data[k]["mij"], refit.cdf_at_K_data[k]["mij"]
+            )
+            assert (
+                full.cdf_at_K_data[k]["pac_area"]
+                == refit.cdf_at_K_data[k]["pac_area"]
+            )
+
     def test_rejects_bad_batch_size(self):
         import pytest
 

@@ -110,3 +110,33 @@ def test_consensus_labels_opt_in(blobs):
     from sklearn.metrics import adjusted_rand_score
 
     assert adjusted_rand_score(y, labels) > 0.99
+
+
+def test_host_backend_n_jobs_parity(blobs):
+    # joblib-threaded host labelling must equal the serial loop exactly:
+    # deterministic estimator seed per fit, no shared accumulator (Q2) or
+    # estimator (Q3) to race on.
+    from sklearn.cluster import KMeans as SkKMeans
+
+    from consensus_clustering_tpu import ConsensusClustering
+
+    x, _ = blobs
+
+    def fit(n_jobs):
+        cc = ConsensusClustering(
+            clusterer=SkKMeans(n_init=2), K_range=(2, 3), n_iterations=8,
+            random_state=5, plot_cdf=False, progress=False,
+            store_matrices=True, n_jobs=n_jobs,
+        )
+        cc.fit(x)
+        return cc
+
+    serial, threaded = fit(1), fit(3)
+    for k in (2, 3):
+        np.testing.assert_array_equal(
+            serial.cdf_at_K_data[k]["mij"], threaded.cdf_at_K_data[k]["mij"]
+        )
+        assert (
+            serial.cdf_at_K_data[k]["pac_area"]
+            == threaded.cdf_at_K_data[k]["pac_area"]
+        )
