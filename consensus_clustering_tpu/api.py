@@ -90,10 +90,17 @@ class ConsensusClustering:
     clusterer_options : dict, optional
         Options applied to the clusterer (default ``{'n_init': 3}`` like the
         reference, without the shared-mutable-default quirk Q11).
-    K_range, n_iterations, subsampling, random_state,
-    consensus_matrix_analysis, PAC_interval, plot_cdf,
-    agg_clustering_linkage : as the reference.
-    n_jobs, parallelization_method, memmap_folder :
+    K_range, n_iterations, subsampling, random_state, PAC_interval,
+    plot_cdf, agg_clustering_linkage : as the reference.
+    consensus_matrix_analysis : {'PAC', 'delta_k'}
+        K-selection criterion for ``best_k_`` — live config here (the
+        reference stores it and never reads it): PAC argmin, or Monti's
+        Delta(K) elbow.
+    n_jobs : int
+        Thread count for the host-backend (sklearn clusterer) labelling
+        loop, race-free (per-fit estimator clones, per-task label rows).
+        Device sweeps take their parallelism from ``mesh`` instead.
+    parallelization_method, memmap_folder :
         accepted for API compatibility; ignored (see module docstring).
     mesh : jax.sharding.Mesh, keyword-only, optional
         Device mesh to shard resamples over; default is single-device.
@@ -413,6 +420,74 @@ class ConsensusClustering:
             plot_cdf(self.cdf_at_K_data, self.PAC_interval)
         return self
 
+    def _select_best_k(self, config: SweepConfig) -> int:
+        """Pick best_k_ per ``consensus_matrix_analysis`` — a LIVE config
+        here (the reference stores it and never reads it, SURVEY.md §2.2
+        dead config): 'PAC' (default, argmin PAC with near-ties broken
+        toward the largest stable K), or 'delta_k' (Monti's elbow: the
+        largest K whose relative area gain Delta(K) still exceeds 2.5%).
+        """
+        mode = self.consensus_matrix_analysis
+        ks = list(config.k_values)
+        if mode == "delta_k":
+            # Monti's elbow: among Ks whose relative area gain is
+            # meaningful (> 2.5%), pick the one with the largest DROP to
+            # the next K's gain (the gain past the range's end counts as
+            # 0).  Gains are floored at 0 first (noise can dip the CDF
+            # area).  Every K is reachable: no meaningful gain anywhere ->
+            # the smallest K; still gaining strongly at the end of the
+            # range -> the largest K (its final drop is its whole gain).
+            if len(ks) == 1:
+                return ks[0]
+            gains = np.maximum(np.asarray(self.delta_k_, float), 0.0)
+            meaningful = [i for i in range(1, len(ks)) if gains[i] > 0.025]
+            if not meaningful:
+                return int(ks[0])
+            drops = [
+                gains[i] - (gains[i + 1] if i + 1 < len(ks) else 0.0)
+                for i in meaningful
+            ]
+            return int(ks[meaningful[int(np.argmax(drops))]])
+        if mode != "PAC":
+            raise ValueError(
+                f"consensus_matrix_analysis={mode!r} not supported "
+                "(choose 'PAC' or 'delta_k')"
+            )
+        pac = np.asarray(
+            [self.cdf_at_K_data[k]["pac_area"] for k in ks]
+        )
+        # argmin PAC, breaking near-ties (several Ks perfectly stable, e.g.
+        # clean blobs where both K=2 and K=3 give PAC ~ 0) toward the
+        # largest such K: the finest partition that is still stable.
+        near_min = pac <= pac.min() + 1e-3
+        return int(max(k for k, hit in zip(ks, near_min) if hit))
+
+    def fit_predict(self, X) -> np.ndarray:
+        """Fit the sweep and return consensus labels at ``best_k_``.
+
+        The sklearn-style convenience the reference's disabled
+        ``_get_consensus_labels`` path never delivered (quirk Q5): runs
+        ``fit(X)``, then extracts labels by agglomerating ``1 - Cij`` at
+        the selected K.  Requires the consensus matrices
+        (``store_matrices`` must not resolve to False).
+        """
+        self.fit(X)
+        entry = self.cdf_at_K_data[self.best_k_]
+        if len(entry["consensus_labels"]):
+            return np.asarray(entry["consensus_labels"])
+        if entry["cij"] is None:
+            raise ValueError(
+                "fit_predict needs the consensus matrices; pass "
+                "store_matrices=True"
+            )
+        from consensus_clustering_tpu.models.agglomerative import (
+            consensus_labels_from_cij,
+        )
+
+        return consensus_labels_from_cij(
+            entry["cij"], self.best_k_, linkage=self.agg_clustering_linkage
+        )
+
     def _entries_from_out(
         self,
         out: Dict[str, Any],
@@ -509,16 +584,7 @@ class ConsensusClustering:
             dtype=np.float64,
         )
         self.delta_k_ = delta_k(self.areas_)
-        pac = np.asarray(
-            [self.cdf_at_K_data[k]["pac_area"] for k in config.k_values]
-        )
-        # argmin PAC, breaking near-ties (several Ks perfectly stable, e.g.
-        # clean blobs where both K=2 and K=3 give PAC ~ 0) toward the largest
-        # such K: the finest partition that is still stable.
-        near_min = pac <= pac.min() + 1e-3
-        self.best_k_ = int(max(
-            k for k, hit in zip(config.k_values, near_min) if hit
-        ))
+        self.best_k_ = self._select_best_k(config)
         if timings:
             compile_s = sum(t["compile_seconds"] for t in timings)
             run_s = sum(t["run_seconds"] for t in timings)

@@ -16,9 +16,9 @@ loop at :282).  Design points (SURVEY.md §7.2 step 2, §7.3):
   batch; the restart with the lowest inertia wins (mirrors sklearn's
   best-of-n_init semantics that the reference's default
   ``clusterer_options={'n_init': 3}`` relies on).
-- **Empty clusters** keep their previous centroid (sklearn instead respawns
-  them from far points; documented divergence, only reachable on degenerate
-  subsamples).
+- **Empty clusters** respawn on the points farthest from their assigned
+  centroids (one `top_k` per Lloyd step), like sklearn's relocation
+  strategy; only reachable on degenerate subsamples.
 """
 
 from __future__ import annotations
@@ -162,6 +162,21 @@ class KMeans:
                     keep[:, None],
                     sums / jnp.maximum(counts, 1.0)[:, None],
                     centroids,
+                )
+                # Empty-cluster relocation (sklearn-style): respawn each
+                # empty valid slot on a distinct point among those farthest
+                # from their assigned centroid.  Static shapes: rank the
+                # empties with a cumsum, index the top_k farthest points.
+                empty = valid & (counts == 0)
+                d_min = jnp.min(d, axis=1)
+                n_far = min(k_max, x.shape[0])
+                _, far_idx = jax.lax.top_k(d_min, n_far)
+                empty_rank = jnp.clip(
+                    jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, n_far - 1
+                )
+                respawn = x[far_idx[empty_rank]]
+                new_centroids = jnp.where(
+                    empty[:, None], respawn, new_centroids
                 )
                 shift = jnp.sum((new_centroids - centroids) ** 2)
                 return new_centroids, shift, it + 1

@@ -201,3 +201,102 @@ class TestStoreMatrices:
         cc.fit(x)
         assert cc.cdf_at_K_data[2]["mij"] is None
         assert cc.cdf_at_K_data[2]["pac_area"] >= -1e-6
+
+
+class TestSelectionAndFitPredict:
+    def test_delta_k_criterion(self, blobs):
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, y = blobs  # 3 well-separated clusters
+        cc = ConsensusClustering(
+            K_range=(2, 3, 4, 5), n_iterations=12, random_state=2,
+            plot_cdf=False, store_matrices=False, progress=False,
+            consensus_matrix_analysis="delta_k",
+        )
+        cc.fit(x)
+        assert cc.best_k_ == 3  # the elbow at the true cluster count
+
+    def test_unknown_criterion_raises(self, blobs):
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2, 3), n_iterations=6, random_state=2, plot_cdf=False,
+            progress=False, consensus_matrix_analysis="nope",
+        )
+        import pytest
+
+        with pytest.raises(ValueError):
+            cc.fit(x)
+
+    def test_fit_predict_labels_blobs(self, blobs):
+        from sklearn.metrics import adjusted_rand_score
+
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, y = blobs
+        cc = ConsensusClustering(
+            K_range=(2, 3, 4), n_iterations=16, random_state=0,
+            plot_cdf=False, store_matrices=True, progress=False,
+        )
+        labels = cc.fit_predict(x)
+        assert labels.shape == (x.shape[0],)
+        assert cc.best_k_ == 3
+        assert adjusted_rand_score(y, labels) > 0.95
+
+
+class TestKMeansEmptyClusterRelocation:
+    def test_no_empty_clusters_on_duplicates(self):
+        # 4 distinct values, k=4, most mass on one point: naive Lloyd from
+        # a degenerate init would leave empty slots; relocation must not.
+        import jax
+        import jax.numpy as jnp
+
+        from consensus_clustering_tpu.models.kmeans import KMeans
+
+        x = np.concatenate([
+            np.zeros((40, 2)), np.ones((3, 2)), 2 * np.ones((3, 2)),
+            3 * np.ones((3, 2)),
+        ]).astype(np.float32)
+        labels = np.asarray(
+            KMeans(n_init=1).fit_predict(
+                jax.random.PRNGKey(0), jnp.asarray(x), jnp.int32(4), 4
+            )
+        )
+        assert set(labels.tolist()) == {0, 1, 2, 3}
+
+
+class TestDeltaKSelection:
+    def _select(self, ks, areas):
+        from consensus_clustering_tpu import ConsensusClustering
+        from consensus_clustering_tpu.config import SweepConfig
+        from consensus_clustering_tpu.ops.analysis import delta_k
+
+        cc = ConsensusClustering(consensus_matrix_analysis="delta_k")
+        cc.delta_k_ = delta_k(np.asarray(areas))
+        config = SweepConfig(
+            n_samples=100, n_features=2, k_values=tuple(ks)
+        )
+        return cc._select_best_k(config)
+
+    def test_smallest_k_reachable_when_no_gain(self):
+        # 2 true clusters: everything past K=2 is noise-level gain.
+        assert self._select(
+            (2, 3, 4, 5), [0.80, 0.805, 0.81, 0.812]
+        ) == 2
+
+    def test_elbow_in_the_middle(self):
+        assert self._select(
+            (2, 3, 4, 5), [0.40, 0.80, 0.81, 0.812]
+        ) == 3
+
+    def test_largest_k_reachable_when_still_gaining(self):
+        assert self._select(
+            (2, 3, 4, 5), [0.40, 0.55, 0.70, 0.85]
+        ) == 5
+
+    def test_negative_tail_gain_cannot_win(self):
+        # A dip after a tiny gain must not make the noise K the elbow.
+        assert self._select(
+            (2, 3, 4, 5, 6), [0.40, 0.80, 0.808, 0.8088, 0.807]
+        ) == 3
