@@ -1,0 +1,60 @@
+"""Chunk-size / knob tuning sweep for the accumulation GEMMs.
+
+Runs the headline config at several ``chunk_size`` values (resamples per
+accumulation GEMM: bigger chunks = fewer passes over the N x N accumulator
+in HBM, at (B, k_max, N) one-hot cost) and prints one JSON line per point.
+Run on the real chip when tuning; results guide the bench.py default.
+
+    python benchmarks/tune.py [--n 5000] [--h 200] [--chunks 8,16,32,64]
+"""
+
+import argparse
+import json
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=5000)
+    parser.add_argument("--d", type=int, default=50)
+    parser.add_argument("--h", type=int, default=200)
+    parser.add_argument("--k-hi", type=int, default=20)
+    parser.add_argument("--chunks", default="8,16,32,64")
+    parser.add_argument("--seed", type=int, default=23)
+    args = parser.parse_args(argv)
+
+    import numpy as np
+    from sklearn.datasets import make_blobs
+
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+    x, _ = make_blobs(
+        n_samples=args.n, n_features=args.d, centers=8, cluster_std=3.0,
+        random_state=0,
+    )
+    x = x.astype(np.float32)
+
+    best = None
+    for chunk in (int(c) for c in args.chunks.split(",")):
+        config = SweepConfig(
+            n_samples=args.n, n_features=args.d,
+            k_values=tuple(range(2, args.k_hi + 1)),
+            n_iterations=args.h, store_matrices=False, chunk_size=chunk,
+        )
+        out = run_sweep(KMeans(n_init=3), config, x, seed=args.seed)
+        t = out["timing"]
+        rec = {
+            "chunk_size": chunk,
+            "resamples_per_second": round(t["resamples_per_second"], 2),
+            "run_seconds": round(t["run_seconds"], 4),
+            "compile_seconds": round(t["compile_seconds"], 2),
+        }
+        print(json.dumps(rec), flush=True)
+        if best is None or rec["resamples_per_second"] > best[1]:
+            best = (chunk, rec["resamples_per_second"])
+    print(json.dumps({"best_chunk_size": best[0], "rps": best[1]}))
+
+
+if __name__ == "__main__":
+    main()

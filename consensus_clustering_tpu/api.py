@@ -48,6 +48,10 @@ logger = logging.getLogger(__name__)
 
 _DEFAULT_CLUSTERER_OPTIONS = {"n_init": 3}
 
+# delta_k K-selection: a relative CDF-area gain below this is treated as
+# resampling noise (parity-mode areas wobble ~3% per K on small inputs).
+_DELTA_K_THRESHOLD = 0.05
+
 
 def _apply_options(clusterer: Any, options: Dict[str, Any]) -> Any:
     """Apply reference-style ``clusterer_options`` to a JAX clusterer.
@@ -194,6 +198,13 @@ class ConsensusClustering:
             if clusterer_options is None
             else dict(clusterer_options)
         )
+        if consensus_matrix_analysis not in ("PAC", "delta_k"):
+            # Validate now: a typo must not cost a full sweep before the
+            # final best-K step raises.
+            raise ValueError(
+                f"consensus_matrix_analysis={consensus_matrix_analysis!r} "
+                "not supported (choose 'PAC' or 'delta_k')"
+            )
         self.consensus_matrix_analysis = consensus_matrix_analysis
         self.PAC_interval = tuple(PAC_interval)
         self.plot_cdf = plot_cdf
@@ -425,29 +436,22 @@ class ConsensusClustering:
         here (the reference stores it and never reads it, SURVEY.md §2.2
         dead config): 'PAC' (default, argmin PAC with near-ties broken
         toward the largest stable K), or 'delta_k' (Monti's elbow: the
-        largest K whose relative area gain Delta(K) still exceeds 2.5%).
+        largest K whose relative area gain Delta(K) still exceeds
+        ``_DELTA_K_THRESHOLD``).
         """
         mode = self.consensus_matrix_analysis
         ks = list(config.k_values)
         if mode == "delta_k":
-            # Monti's elbow: among Ks whose relative area gain is
-            # meaningful (> 2.5%), pick the one with the largest DROP to
-            # the next K's gain (the gain past the range's end counts as
-            # 0).  Gains are floored at 0 first (noise can dip the CDF
-            # area).  Every K is reachable: no meaningful gain anywhere ->
-            # the smallest K; still gaining strongly at the end of the
-            # range -> the largest K (its final drop is its whole gain).
-            if len(ks) == 1:
-                return ks[0]
+            # Monti's elbow, exactly as documented: the largest K whose
+            # relative area gain Delta(K) still exceeds _DELTA_K_THRESHOLD.
+            # Gains are floored at 0 (noise can dip the CDF area); no
+            # meaningful gain anywhere selects the smallest K.
             gains = np.maximum(np.asarray(self.delta_k_, float), 0.0)
-            meaningful = [i for i in range(1, len(ks)) if gains[i] > 0.025]
-            if not meaningful:
-                return int(ks[0])
-            drops = [
-                gains[i] - (gains[i + 1] if i + 1 < len(ks) else 0.0)
-                for i in meaningful
-            ]
-            return int(ks[meaningful[int(np.argmax(drops))]])
+            chosen = ks[0]
+            for i in range(1, len(ks)):
+                if gains[i] > _DELTA_K_THRESHOLD:
+                    chosen = ks[i]
+            return int(chosen)
         if mode != "PAC":
             raise ValueError(
                 f"consensus_matrix_analysis={mode!r} not supported "
@@ -471,22 +475,36 @@ class ConsensusClustering:
         the selected K.  Requires the consensus matrices
         (``store_matrices`` must not resolve to False).
         """
+        X = np.asarray(X)
+        if X.ndim == 2 and not self._resolve_store_matrices(X.shape[0]):
+            # Statically doomed: fail before the (possibly hours-long)
+            # sweep, not after it.
+            raise ValueError(
+                "fit_predict needs the consensus matrices; pass "
+                "store_matrices=True"
+            )
         self.fit(X)
         entry = self.cdf_at_K_data[self.best_k_]
         if len(entry["consensus_labels"]):
             return np.asarray(entry["consensus_labels"])
         if entry["cij"] is None:
             raise ValueError(
-                "fit_predict needs the consensus matrices; pass "
-                "store_matrices=True"
+                "consensus matrices unavailable for the selected K — this "
+                "fit was resumed from checkpoints written with "
+                "store_matrices=False; use a fresh checkpoint_dir (or "
+                "delete the stale per-K files) and refit"
             )
         from consensus_clustering_tpu.models.agglomerative import (
             consensus_labels_from_cij,
         )
 
-        return consensus_labels_from_cij(
+        labels = consensus_labels_from_cij(
             entry["cij"], self.best_k_, linkage=self.agg_clustering_linkage
         )
+        # Keep the reference-schema result dict consistent with what was
+        # just computed.
+        entry["consensus_labels"] = labels
+        return np.asarray(labels)
 
     def _entries_from_out(
         self,

@@ -216,18 +216,14 @@ class TestSelectionAndFitPredict:
         cc.fit(x)
         assert cc.best_k_ == 3  # the elbow at the true cluster count
 
-    def test_unknown_criterion_raises(self, blobs):
+    def test_unknown_criterion_raises_at_construction(self):
         from consensus_clustering_tpu import ConsensusClustering
 
-        x, _ = blobs
-        cc = ConsensusClustering(
-            K_range=(2, 3), n_iterations=6, random_state=2, plot_cdf=False,
-            progress=False, consensus_matrix_analysis="nope",
-        )
         import pytest
 
-        with pytest.raises(ValueError):
-            cc.fit(x)
+        # Must fail in milliseconds, not after a full sweep.
+        with pytest.raises(ValueError, match="consensus_matrix_analysis"):
+            ConsensusClustering(consensus_matrix_analysis="nope")
 
     def test_fit_predict_labels_blobs(self, blobs):
         from sklearn.metrics import adjusted_rand_score
@@ -243,6 +239,25 @@ class TestSelectionAndFitPredict:
         assert labels.shape == (x.shape[0],)
         assert cc.best_k_ == 3
         assert adjusted_rand_score(y, labels) > 0.95
+        # The result dict stays consistent with what was just computed.
+        np.testing.assert_array_equal(
+            cc.cdf_at_K_data[3]["consensus_labels"], labels
+        )
+
+    def test_fit_predict_without_matrices_fails_fast(self, blobs):
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, _ = blobs
+        cc = ConsensusClustering(
+            K_range=(2, 3), n_iterations=6, random_state=0, plot_cdf=False,
+            progress=False, store_matrices=False,
+        )
+        import time
+
+        t0 = time.perf_counter()
+        with pytest.raises(ValueError, match="store_matrices"):
+            cc.fit_predict(x)
+        assert time.perf_counter() - t0 < 1.0  # before the sweep, not after
 
 
 class TestKMeansEmptyClusterRelocation:

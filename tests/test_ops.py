@@ -214,3 +214,27 @@ class TestAnalysis:
     def test_area_under_cdf(self):
         cdf = jnp.ones((20,), jnp.float32)
         assert float(area_under_cdf(cdf)) == pytest.approx(1.0)
+
+
+def test_coassociation_chunk_size_invariance(rng):
+    # The chunked accumulation GEMM must be exact for ANY chunking: counts
+    # are integers, f32 accumulation is exact below 2^24.
+    import jax.numpy as jnp
+
+    from consensus_clustering_tpu.ops.coassoc import coassociation_counts
+
+    n, h, n_sub, k_max = 57, 23, 41, 5
+    labels = rng.integers(0, k_max, size=(h, n_sub)).astype(np.int32)
+    indices = np.stack([
+        rng.permutation(n)[:n_sub] for _ in range(h)
+    ]).astype(np.int32)
+    outs = [
+        np.asarray(
+            coassociation_counts(
+                jnp.asarray(labels), jnp.asarray(indices), n, k_max, chunk
+            )
+        )
+        for chunk in (1, 4, 7, 23, 64)
+    ]
+    for other in outs[1:]:
+        np.testing.assert_array_equal(outs[0], other)
