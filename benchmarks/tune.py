@@ -3,13 +3,23 @@
 Runs the headline config at several ``chunk_size`` values (resamples per
 accumulation GEMM: bigger chunks = fewer passes over the N x N accumulator
 in HBM, at (B, k_max, N) one-hot cost) and prints one JSON line per point.
-Run on the real chip when tuning; results guide the bench.py default.
+Run on the real chip when tuning; results guide the bench.py default —
+pass ``--out benchmarks/tuning_results.json`` to record them in the repo.
 
     python benchmarks/tune.py [--n 5000] [--h 200] [--chunks 8,16,32,64]
+
+``use_pallas`` is left at None, which now resolves through the one-time
+kernel-availability probe (ops/pallas_hist.py) — a broken kernel degrades
+to the XLA fallback instead of killing the tuning run; force a path with
+--use-pallas on|off to tune a specific one.
 """
 
 import argparse
 import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(argv=None):
@@ -20,7 +30,28 @@ def main(argv=None):
     parser.add_argument("--k-hi", type=int, default=20)
     parser.add_argument("--chunks", default="8,16,32,64")
     parser.add_argument("--seed", type=int, default=23)
+    parser.add_argument(
+        "--use-pallas", choices=("auto", "on", "off"), default="auto",
+        help="histogram path: auto = probe the kernel once and fall back "
+        "if it cannot compile; on/off force it",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="also append the records to this JSON file "
+        "(e.g. benchmarks/tuning_results.json)",
+    )
     args = parser.parse_args(argv)
+
+    try:
+        chunks = [int(c) for c in args.chunks.split(",") if c.strip()]
+    except ValueError:
+        parser.error(f"--chunks must be comma-separated ints: {args.chunks!r}")
+    if not chunks:
+        parser.error("--chunks parsed to an empty list")
+    if any(c < 1 for c in chunks):
+        # coassoc clamps chunk_size to >= 1, which would silently mislabel
+        # the tuning record.
+        parser.error(f"--chunks values must be >= 1: {chunks}")
 
     import numpy as np
     from sklearn.datasets import make_blobs
@@ -36,11 +67,15 @@ def main(argv=None):
     x = x.astype(np.float32)
 
     best = None
-    for chunk in (int(c) for c in args.chunks.split(",")):
+    records = []
+    for chunk in chunks:
         config = SweepConfig(
             n_samples=args.n, n_features=args.d,
             k_values=tuple(range(2, args.k_hi + 1)),
             n_iterations=args.h, store_matrices=False, chunk_size=chunk,
+            use_pallas={"auto": None, "on": True, "off": False}[
+                args.use_pallas
+            ],
         )
         out = run_sweep(KMeans(n_init=3), config, x, seed=args.seed)
         t = out["timing"]
@@ -51,9 +86,26 @@ def main(argv=None):
             "compile_seconds": round(t["compile_seconds"], 2),
         }
         print(json.dumps(rec), flush=True)
+        records.append(rec)
         if best is None or rec["resamples_per_second"] > best[1]:
             best = (chunk, rec["resamples_per_second"])
-    print(json.dumps({"best_chunk_size": best[0], "rps": best[1]}))
+    summary = {"best_chunk_size": best[0], "rps": best[1]}
+    print(json.dumps(summary))
+    if args.out:
+        import jax
+
+        payload = {
+            "backend": jax.default_backend(),
+            "config": {
+                "n": args.n, "d": args.d, "h": args.h, "k_hi": args.k_hi,
+                "seed": args.seed, "use_pallas": args.use_pallas,
+            },
+            "points": records,
+            **summary,
+        }
+        with open(args.out, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ globally, and callers psum the (bins,) counts over the axis.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional
 
 import jax
@@ -28,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger(__name__)
 
 # f32 tiles: sublane multiple of 8, lane multiple of 128.  One tile is
 # 256 KiB in VMEM — small enough to double-buffer, large enough to amortise
@@ -70,6 +73,13 @@ def _hist_kernel(
         & (local_cols < n_cols)
     )
 
+    # Mosaic cannot store scalars to VMEM, so the per-bin counts are
+    # accumulated into a full lane-shaped (8, _OUT_LANES) register vector
+    # (bin b lives at [0, b], selected with iota one-hots) and flushed with
+    # a single vector read-modify-write.
+    sub = jax.lax.broadcasted_iota(jnp.int32, (8, _OUT_LANES), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (8, _OUT_LANES), 1)
+    acc = jnp.zeros((8, _OUT_LANES), jnp.int32)
     edges = np.linspace(0.0, 1.0, bins + 1).astype(np.float32)
     for b in range(bins):
         in_bin = (v >= edges[b]) & (
@@ -77,7 +87,8 @@ def _hist_kernel(
         )
         # np.histogram's last bin is right-closed.
         count = jnp.sum((in_bin & mask).astype(jnp.int32))
-        out_ref[0, b] += count
+        acc = acc + jnp.where((sub == 0) & (lane == b), count, 0)
+    out_ref[:] += acc
 
 
 @functools.partial(
@@ -127,6 +138,48 @@ def _pallas_hist(
     return out[0, :bins]
 
 
+# One-time lowering/execution probe per backend.  Round-1's bench produced
+# zero data because the default path crashed Mosaic lowering on the real
+# chip; ``use_pallas=None`` must therefore never select a kernel that has
+# not been proven to compile AND run on the active backend.
+_PROBE_CACHE: dict = {}
+
+
+def kernel_available() -> bool:
+    """True iff the Pallas kernel compiles and runs on the active backend.
+
+    The probe compiles and executes the kernel once on a (264, 264) block
+    — a multi-tile grid with ragged edge tiles, the layout class where
+    Mosaic lowering bugs hide (a (1, 1)-grid probe would miss them) — and
+    caches the verdict per backend.  Any failure (lowering, compile, or
+    runtime) degrades to the XLA fallback with a logged warning instead of
+    killing the caller — a bench round must never again produce zero data
+    because of one kernel.
+    """
+    backend = jax.default_backend()
+    if backend not in _PROBE_CACHE:
+        if backend == "cpu":
+            # pallas_call on CPU requires interpret mode; the compiled
+            # kernel is a TPU artifact.  The fallback is the CPU path.
+            _PROBE_CACHE[backend] = False
+        else:
+            try:
+                out = _pallas_hist(
+                    jnp.zeros((264, 264), jnp.float32), 0, 20, 260
+                )
+                jax.block_until_ready(out)
+                _PROBE_CACHE[backend] = True
+            except Exception:  # noqa: BLE001 — any failure means fallback
+                logger.warning(
+                    "Pallas consensus-histogram kernel failed its probe on "
+                    "backend %r; using the XLA fallback",
+                    backend,
+                    exc_info=True,
+                )
+                _PROBE_CACHE[backend] = False
+    return _PROBE_CACHE[backend]
+
+
 def consensus_hist_counts(
     cij: jax.Array,
     n_valid: int,
@@ -153,9 +206,11 @@ def consensus_hist_counts(
     """
     if use_pallas is None:
         # The real chip may report a plugin platform name ('tpu' upstream,
-        # 'axon' through the tunnel this image uses) — anything that is not
-        # the CPU interpreter gets the kernel.
-        use_pallas = jax.default_backend() != "cpu"
+        # 'axon' through the tunnel this image uses) — any non-CPU backend
+        # gets the kernel, but only after it passes a one-time
+        # compile-and-run probe (see kernel_available); otherwise the XLA
+        # fallback keeps the sweep alive.
+        use_pallas = kernel_available()
     if use_pallas:
         return _pallas_hist(
             cij, row_offset, bins, n_valid, interpret=interpret

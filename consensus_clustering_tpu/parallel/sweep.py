@@ -42,7 +42,10 @@ from consensus_clustering_tpu.ops.analysis import (
     cdf_pac_from_counts,
     consensus_matrix,
 )
-from consensus_clustering_tpu.ops.pallas_hist import consensus_hist_counts
+from consensus_clustering_tpu.ops.pallas_hist import (
+    consensus_hist_counts,
+    kernel_available,
+)
 from consensus_clustering_tpu.ops.coassoc import coassociation_counts
 from consensus_clustering_tpu.ops.resample import (
     cosample_counts,
@@ -82,6 +85,13 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     # which every one-hot builder drops.
     h_pad = -(-h_total // (n_h * n_r)) * (n_h * n_r)
     k_arr = jnp.asarray(config.k_values, jnp.int32)
+    # Resolve the histogram path NOW, outside the traced program: the
+    # kernel-availability probe compiles and runs the Pallas kernel once on
+    # the active backend (ops/pallas_hist.py), which must not happen inside
+    # a shard_map trace.  None -> probed default; True/False -> forced.
+    use_pallas = config.use_pallas
+    if use_pallas is None:
+        use_pallas = kernel_available()
 
     def local_body(x, indices, key_cluster):
         """Runs per device.
@@ -150,7 +160,7 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
             counts = jax.lax.psum(
                 consensus_hist_counts(
                     cij, n, row_start, config.bins,
-                    use_pallas=config.use_pallas,
+                    use_pallas=use_pallas,
                 ),
                 ROW_AXIS,
             )

@@ -1,7 +1,10 @@
 """Pallas consensus-histogram kernel vs the XLA fallback and NumPy.
 
 Runs the kernel in interpreter mode (CPU backend, per conftest); the real
-TPU lowering is exercised by bench.py / the driver.
+compiled TPU lowering is exercised by ``benchmarks/tpu_kernel_check.py``,
+bench.py and the driver.  The ``kernel_available`` probe tested here is
+what keeps ``use_pallas=None`` from ever selecting a kernel that cannot
+compile on the active backend (the round-1 bench failure mode).
 """
 
 import jax.numpy as jnp
@@ -9,7 +12,11 @@ import numpy as np
 import pytest
 
 from consensus_clustering_tpu.ops.analysis import cdf_pac
-from consensus_clustering_tpu.ops.pallas_hist import consensus_hist_counts
+from consensus_clustering_tpu.ops import pallas_hist
+from consensus_clustering_tpu.ops.pallas_hist import (
+    consensus_hist_counts,
+    kernel_available,
+)
 
 
 def _numpy_counts(cij, n_valid, row_offset, bins):
@@ -72,6 +79,49 @@ class TestPallasHist:
             jnp.asarray(cij), 100, 0, 20, use_pallas=False
         )
         np.testing.assert_array_equal(np.asarray(pallas), np.asarray(xla))
+
+    def test_probe_false_on_cpu_and_cached(self):
+        pallas_hist._PROBE_CACHE.clear()
+        try:
+            assert kernel_available() is False
+            assert pallas_hist._PROBE_CACHE == {"cpu": False}
+        finally:
+            pallas_hist._PROBE_CACHE.clear()
+
+    def test_default_use_pallas_never_crashes(self, rng, monkeypatch, caplog):
+        # Simulate the round-1 failure: a non-CPU backend whose kernel dies
+        # at lowering.  use_pallas=None must degrade to the XLA fallback
+        # with a warning and still produce exact counts.
+        import logging
+
+        def boom(*args, **kwargs):
+            raise ValueError("Cannot store scalars to VMEM")
+
+        pallas_hist._PROBE_CACHE.clear()
+        monkeypatch.setattr(
+            pallas_hist.jax, "default_backend", lambda: "faketpu"
+        )
+        monkeypatch.setattr(pallas_hist, "_pallas_hist", boom)
+        cij = rng.random((50, 50), dtype=np.float32)
+        try:
+            with caplog.at_level(
+                logging.WARNING, logger=pallas_hist.logger.name
+            ):
+                got = consensus_hist_counts(jnp.asarray(cij), 50, 0, 20)
+            assert any(
+                "failed its probe" in r.message for r in caplog.records
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got), _numpy_counts(cij, 50, 0, 20)
+            )
+            # Verdict is cached: a second call must not re-probe.
+            monkeypatch.setattr(
+                pallas_hist, "_pallas_hist",
+                lambda *a, **k: pytest.fail("probe ran twice"),
+            )
+            consensus_hist_counts(jnp.asarray(cij), 50, 0, 20)
+        finally:
+            pallas_hist._PROBE_CACHE.clear()
 
     def test_consistent_with_cdf_pac(self, rng):
         # cdf_pac's internal counts path and the kernel must agree: same
