@@ -48,9 +48,11 @@ def _build(config_name, small):
         x = _blobs(n, d)
         metric = (f"consensus k-sweep throughput (N={n} d={d} H={h} "
                   f"K=2..{k_hi}, KMeans n_init=3)")
+        # chunk_size=4 per the on-chip sweep in benchmarks/tuning_results.json
+        # (chunks 2..8 are within noise, 16+ consistently slower).
         cfg = SweepConfig(
             n_samples=n, n_features=d, k_values=tuple(range(2, k_hi + 1)),
-            n_iterations=h, store_matrices=False, chunk_size=16,
+            n_iterations=h, store_matrices=False, chunk_size=4,
         )
         # KMeans(n_init=3) mirrors the reference's default clusterer_options.
         return KMeans(n_init=3), cfg, x, metric, not small
@@ -111,6 +113,15 @@ def main(argv=None):
         "--small", action="store_true",
         help="toy shapes (same code path); implied on CPU",
     )
+    parser.add_argument(
+        "--repeats", type=int, default=3,
+        help="re-execute the compiled sweep this many times and report the "
+        "fastest (filters shared-tunnel interference); 1 on CPU",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of the first execution here",
+    )
     args = parser.parse_args(argv)
 
     # Fail fast if backend init hangs (e.g. a wedged TPU tunnel): a clear
@@ -147,7 +158,11 @@ def main(argv=None):
     from consensus_clustering_tpu.parallel.sweep import run_sweep
 
     clusterer, config, x, metric, is_headline = _build(args.config, small)
-    out = run_sweep(clusterer, config, x, seed=23)
+    repeats = 1 if backend == "cpu" else max(1, args.repeats)
+    out = run_sweep(
+        clusterer, config, x, seed=23,
+        profile_dir=args.profile_dir, repeats=repeats,
+    )
 
     total_resamples = config.n_iterations * len(config.k_values)
     rate = out["timing"]["resamples_per_second"]
@@ -177,6 +192,9 @@ def main(argv=None):
         "sweep_wall_seconds": round(wall, 4),
         "compile_seconds": round(out["timing"]["compile_seconds"], 2),
         "total_resamples": total_resamples,
+        "all_run_seconds": [
+            round(t, 4) for t in out["timing"]["all_run_seconds"]
+        ],
         "pac_head": [round(float(p), 5) for p in out["pac_area"][:3]],
     }
     peak = out["timing"].get("device_memory", {}).get("peak_bytes_in_use")

@@ -37,7 +37,7 @@ def main(argv=None):
     )
     parser.add_argument(
         "--out", default=None,
-        help="also append the records to this JSON file "
+        help="also write the records to this JSON file, overwriting it "
         "(e.g. benchmarks/tuning_results.json)",
     )
     args = parser.parse_args(argv)

@@ -187,6 +187,7 @@ class ConsensusClustering:
         use_pallas: Optional[bool] = None,
         metrics_path: Optional[str] = None,
         k_batch_size: Optional[int] = None,
+        compute_dtype: str = "float32",
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -242,6 +243,9 @@ class ConsensusClustering:
         if k_batch_size is not None and k_batch_size < 1:
             raise ValueError(f"k_batch_size must be >= 1, got {k_batch_size}")
         self.k_batch_size = k_batch_size
+        # Validated by SweepConfig; "float64" needs JAX_ENABLE_X64 + CPU
+        # backend (see SweepConfig.dtype for when that is worth it).
+        self.compute_dtype = compute_dtype
 
     # -- clusterer resolution -------------------------------------------
 
@@ -345,6 +349,7 @@ class ConsensusClustering:
             chunk_size=self.chunk_size,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
             use_pallas=self.use_pallas,
+            dtype=self.compute_dtype,
         )
 
         ckpt = None
@@ -445,7 +450,12 @@ class ConsensusClustering:
             # Monti's elbow, exactly as documented: the largest K whose
             # relative area gain Delta(K) still exceeds _DELTA_K_THRESHOLD.
             # Gains are floored at 0 (noise can dip the CDF area); no
-            # meaningful gain anywhere selects the smallest K.
+            # meaningful gain anywhere selects the smallest K.  A gain that
+            # resurges after a flat (sub-threshold) stretch is honoured
+            # deliberately: on noisy curves the flat region can be a local
+            # artefact, and "largest K with real gain" is the documented
+            # contract — a first-flattening rule would need a different
+            # docstring and different tests.
             gains = np.maximum(np.asarray(self.delta_k_, float), 0.0)
             chosen = ks[0]
             for i in range(1, len(ks)):
@@ -453,6 +463,9 @@ class ConsensusClustering:
                     chosen = ks[i]
             return int(chosen)
         if mode != "PAC":
+            # Unreachable through the constructor (which validates the
+            # value); kept as a deliberate backstop for post-construction
+            # attribute mutation, which sklearn-style APIs permit.
             raise ValueError(
                 f"consensus_matrix_analysis={mode!r} not supported "
                 "(choose 'PAC' or 'delta_k')"

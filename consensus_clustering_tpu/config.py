@@ -44,6 +44,14 @@ class SweepConfig:
         variance; documented divergence).
       use_pallas: True forces the Pallas consensus-histogram kernel, False
         forces the XLA fallback, None picks by backend (Pallas on TPU).
+      dtype: working float dtype for the data and the inner clusterers
+        ("float32" default).  "float64" needs ``JAX_ENABLE_X64`` and a CPU
+        backend (TPUs have no f64 ALUs) — it exists for parity with the
+        reference on ill-conditioned problems: sklearn's full-covariance
+        GMM *refuses* f32 input on data like corr.csv (n_sub < d makes
+        every component covariance singular up to reg_covar), and f32 EM
+        there is chaotic enough to decorrelate per-resample optima and
+        inflate PAC ~4x.  Accumulation stays exact integers either way.
     """
 
     n_samples: int
@@ -58,8 +66,13 @@ class SweepConfig:
     chunk_size: int = 8
     reseed_clusterer_per_resample: bool = False
     use_pallas: Optional[bool] = None
+    dtype: str = "float32"
 
     def __post_init__(self):
+        if self.dtype not in ("float32", "float64"):
+            raise ValueError(
+                f"dtype must be 'float32' or 'float64', got {self.dtype!r}"
+            )
         if not self.k_values:
             raise ValueError("k_values must be non-empty")
         if any(k < 1 for k in self.k_values):

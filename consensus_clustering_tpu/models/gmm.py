@@ -70,11 +70,20 @@ class GaussianMixture:
     def fit_predict(
         self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
     ) -> jax.Array:
-        x = x.astype(jnp.float32)
+        # Work in the input's float dtype (f32 default; f64 for the
+        # x64/CPU parity path, where full-covariance EM on n < d data is
+        # otherwise numerically chaotic — see SweepConfig.dtype).
+        # Non-floats and sub-f32 floats -> f32: bf16/f16 would overflow
+        # the 1e30 loop sentinels and run Cholesky in half precision.
+        if (
+            not jnp.issubdtype(x.dtype, jnp.floating)
+            or jnp.finfo(x.dtype).bits < 32
+        ):
+            x = x.astype(jnp.float32)
         n, d = x.shape
         k = jnp.asarray(k, jnp.int32)
         valid = jnp.arange(k_max, dtype=jnp.int32) < k
-        eye = jnp.eye(d, dtype=jnp.float32)
+        eye = jnp.eye(d, dtype=x.dtype)
 
         def m_step(resp):
             """resp (n, k_max) -> (weights, means, cholesky factors)."""
@@ -105,7 +114,7 @@ class GaussianMixture:
             resp0 = (
                 labels0[:, None]
                 == jnp.arange(k_max, dtype=labels0.dtype)[None, :]
-            ).astype(jnp.float32)
+            ).astype(x.dtype)
             params0 = m_step(resp0)
 
             def e_step(params):
@@ -132,7 +141,12 @@ class GaussianMixture:
             # compares False and the loop would never start).
             params, _, lb, _ = jax.lax.while_loop(
                 cond, body,
-                (params0, jnp.float32(-1e30), jnp.float32(1e30), jnp.int32(0)),
+                (
+                    params0,
+                    jnp.asarray(-1e30, x.dtype),
+                    jnp.asarray(1e30, x.dtype),
+                    jnp.int32(0),
+                ),
             )
             log_w, means, chol = params
             log_p = _masked_log_prob(x, means, chol, log_w, valid)

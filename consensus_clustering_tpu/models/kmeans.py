@@ -16,9 +16,10 @@ loop at :282).  Design points (SURVEY.md §7.2 step 2, §7.3):
   batch; the restart with the lowest inertia wins (mirrors sklearn's
   best-of-n_init semantics that the reference's default
   ``clusterer_options={'n_init': 3}`` relies on).
-- **Empty clusters** respawn on the points farthest from their assigned
-  centroids (one `top_k` per Lloyd step), like sklearn's relocation
-  strategy; only reachable on degenerate subsamples.
+- **Empty clusters** respawn on far points chosen by a strided-bucket
+  argmax over per-point distances (sort-free — a `top_k` here costs a
+  batch-wide sort per Lloyd step on TPU); only reachable on degenerate
+  subsamples.
 """
 
 from __future__ import annotations
@@ -64,9 +65,14 @@ def _kmeanspp_init(
     n = x.shape[0]
     n_trials = 2 + int(math.ceil(math.log(max(k_max, 2))))
     key0, key_rest = jax.random.split(key)
-    first = jax.random.randint(key0, (), 0, n)
+    first = jax.random.randint(key0, (), 0, n, dtype=jnp.int32)
     centroids0 = jnp.broadcast_to(x[first], (k_max, x.shape[1]))
     d2_0 = jnp.sum((x - x[first]) ** 2, axis=1)
+    # Hoisted for the per-step candidate distances: |x - c|^2 as a GEMM
+    # (|x|^2 - 2 x.c + |c|^2) keeps the (T, n) distance step on the MXU —
+    # the broadcast-subtract form materialises a (T, n, d) intermediate on
+    # the VPU every step and was ~1/3 of sweep device time.
+    x_sq = jnp.sum(x * x, axis=1)
 
     def body(j, carry):
         centroids, d2 = carry
@@ -78,9 +84,13 @@ def _kmeanspp_init(
         cand_idx = jax.random.categorical(kj, logits, shape=(n_trials,))
         cand = x[cand_idx]  # (T, dim)
         # Potential of each candidate: sum_i min(d2_i, |x_i - cand|^2).
-        cand_d2 = jnp.sum(
-            (x[None, :, :] - cand[:, None, :]) ** 2, axis=-1
+        cross = jnp.matmul(
+            cand, x.T, precision=jax.lax.Precision.HIGHEST
         )  # (T, n)
+        cand_sq = jnp.sum(cand * cand, axis=1)
+        cand_d2 = jnp.maximum(
+            cand_sq[:, None] - 2.0 * cross + x_sq[None, :], 0.0
+        )
         pooled = jnp.minimum(cand_d2, d2[None, :])
         best = jnp.argmin(jnp.sum(pooled, axis=1))
         new_c = cand[best]
@@ -125,7 +135,16 @@ class KMeans:
         """Run best-of-n_init KMeans; returns (labels, centroids)."""
         if k_max is None:
             k_max = int(k)
-        x = x.astype(jnp.float32)
+        # Work in the input's float dtype (f32 default; f64 for the
+        # x64/CPU parity path — see SweepConfig.dtype); non-floats and
+        # sub-f32 floats (bf16/f16 would run Lloyd's thresholds and
+        # accumulations in half precision) -> f32.
+        if (
+            not jnp.issubdtype(x.dtype, jnp.floating)
+            or jnp.finfo(x.dtype).bits < 32
+        ):
+            x = x.astype(jnp.float32)
+        inf = jnp.asarray(jnp.inf, x.dtype)
         k = jnp.asarray(k, jnp.int32)
         valid = jnp.arange(k_max, dtype=jnp.int32) < k
 
@@ -136,7 +155,7 @@ class KMeans:
 
             def masked_dist(c):
                 d = _pairwise_sqdist(x, c)
-                return jnp.where(valid[None, :], d, _INF)
+                return jnp.where(valid[None, :], d, inf)
 
             def cond(state):
                 _, shift, it = state
@@ -150,12 +169,14 @@ class KMeans:
                 a = (
                     labels[:, None]
                     == jnp.arange(k_max, dtype=labels.dtype)[None, :]
-                ).astype(jnp.float32)
+                ).astype(x.dtype)
                 counts = jnp.sum(a, axis=0)
                 sums = jax.lax.dot_general(
                     a, x, (((0,), (0,)), ((), ())),
                     precision=jax.lax.Precision.HIGHEST,
-                    preferred_element_type=jnp.float32,
+                    # Accumulate in the working dtype: pinning f32 here
+                    # would silently degrade the f64 parity path.
+                    preferred_element_type=x.dtype,
                 )
                 keep = (counts > 0) & valid
                 new_centroids = jnp.where(
@@ -163,16 +184,32 @@ class KMeans:
                     sums / jnp.maximum(counts, 1.0)[:, None],
                     centroids,
                 )
-                # Empty-cluster relocation (sklearn-style): respawn each
-                # empty valid slot on a distinct point among those farthest
-                # from their assigned centroid.  Static shapes: rank the
-                # empties with a cumsum, index the top_k farthest points.
+                # Empty-cluster relocation (sklearn-flavoured): respawn each
+                # empty valid slot on a distinct point far from its assigned
+                # centroid.  A lax.top_k here lowers to a sort of the whole
+                # vmapped batch on every Lloyd step — it was ~47% of sweep
+                # device time for a path that almost never fires — so
+                # instead the points are partitioned into k_max strided
+                # buckets (point i -> bucket i mod k_max, decorrelated from
+                # generators that order points by cluster) and empty slot
+                # rank r takes the farthest point of bucket r: one O(n)
+                # argmax, distinct picks guaranteed by construction.
                 empty = valid & (counts == 0)
                 d_min = jnp.min(d, axis=1)
-                n_far = min(k_max, x.shape[0])
-                _, far_idx = jax.lax.top_k(d_min, n_far)
+                n_pts = x.shape[0]
+                n_row = -(-n_pts // k_max)
+                pad = n_row * k_max - n_pts
+                d_pad = (
+                    jnp.concatenate([d_min, jnp.full((pad,), -inf, d_min.dtype)])
+                    if pad
+                    else d_min
+                )
+                far_row = jnp.argmax(d_pad.reshape(n_row, k_max), axis=0)
+                far_idx = jnp.minimum(
+                    far_row * k_max + jnp.arange(k_max), n_pts - 1
+                )
                 empty_rank = jnp.clip(
-                    jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, n_far - 1
+                    jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, k_max - 1
                 )
                 respawn = x[far_idx[empty_rank]]
                 new_centroids = jnp.where(
@@ -181,7 +218,7 @@ class KMeans:
                 shift = jnp.sum((new_centroids - centroids) ** 2)
                 return new_centroids, shift, it + 1
 
-            init = (centroids, _INF, jnp.int32(0))
+            init = (centroids, inf, jnp.int32(0))
             centroids, _, _ = jax.lax.while_loop(cond, body, init)
             d = masked_dist(centroids)
             labels = jnp.argmin(d, axis=1).astype(jnp.int32)

@@ -103,8 +103,14 @@ def coassociation_counts(
         if row_start is None:
             left = c
         else:
+            # int32-pinned start indices (a bare 0 is int64 under x64).
             left = jax.lax.dynamic_slice(
-                c, (0, row_start), (chunk_size * k_max, n_rows)
+                c,
+                (
+                    jnp.asarray(0, jnp.int32),
+                    jnp.asarray(row_start, jnp.int32),
+                ),
+                (chunk_size * k_max, n_rows),
             )
         partial = jax.lax.dot_general(
             left,

@@ -114,8 +114,12 @@ def cosample_counts(
     if row_start is None:
         left = r
     else:
+        # Both start indices pinned to int32: a bare Python 0 is int64
+        # under x64 and dynamic_slice rejects mixed index dtypes.
         left = jax.lax.dynamic_slice(
-            r, (0, row_start), (r.shape[0], n_rows)
+            r,
+            (jnp.asarray(0, jnp.int32), jnp.asarray(row_start, jnp.int32)),
+            (r.shape[0], n_rows),
         )
     iij = jax.lax.dot_general(
         left,

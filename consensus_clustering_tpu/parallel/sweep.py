@@ -191,7 +191,7 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
 
     @jax.jit
     def sweep(x: jax.Array, key: jax.Array) -> Dict[str, jax.Array]:
-        x = x.astype(jnp.float32)
+        x = x.astype(jnp.dtype(config.dtype))
         key_resample, key_cluster = jax.random.split(key)
         indices = resample_indices(key_resample, n, h_total, n_sub)
         if h_pad > h_total:
@@ -219,6 +219,7 @@ def run_sweep(
     seed: int,
     mesh: Optional[Mesh] = None,
     profile_dir: Optional[str] = None,
+    repeats: int = 1,
 ) -> Dict[str, Any]:
     """Build, compile and execute a sweep; return host-side results + timings.
 
@@ -228,10 +229,17 @@ def run_sweep(
     captures a ``jax.profiler`` trace of the execution (view with
     TensorBoard / xprof) — the tracing subsystem the reference lacks
     entirely (SURVEY.md §5 row 1).
+
+    ``repeats`` re-executes the already-compiled program that many times and
+    reports the FASTEST wall-clock (plus every individual time in
+    ``all_run_seconds``).  Shared-tunnel TPU access shows up-to-2.7x
+    run-to-run noise on identical programs; best-of filters interference
+    from outside the program under test, which is what a throughput claim
+    is about.  The profiler, if requested, traces only the first execution.
     """
     sweep = build_sweep(clusterer, config, mesh)
     key = jax.random.PRNGKey(seed)
-    xj = jnp.asarray(x, jnp.float32)
+    xj = jnp.asarray(x, jnp.dtype(config.dtype))
 
     t0 = time.perf_counter()
     compiled = sweep.lower(xj, key).compile()
@@ -240,21 +248,29 @@ def run_sweep(
     # platforms (e.g. the axon TPU tunnel) block_until_ready returns before
     # the device has finished, so the device->host copy is the only reliable
     # completion barrier.
-    if profile_dir is not None:
-        with jax.profiler.trace(profile_dir):
+    run_times = []
+    host = None
+    for rep in range(max(1, repeats)):
+        r0 = time.perf_counter()
+        if rep == 0 and profile_dir is not None:
+            with jax.profiler.trace(profile_dir):
+                out = compiled(xj, key)
+                host = jax.tree.map(np.asarray, out)
+        else:
             out = compiled(xj, key)
-            host = jax.tree.map(np.asarray, out)
-    else:
-        out = compiled(xj, key)
-        host = jax.tree.map(np.asarray, out)
-    t2 = time.perf_counter()
+            result = jax.tree.map(np.asarray, out)
+            if host is None:
+                host = result
+        run_times.append(time.perf_counter() - r0)
+    best = min(run_times)
     total_resamples = config.n_iterations * len(config.k_values)
     from consensus_clustering_tpu.utils.metrics import device_memory_stats
 
     host["timing"] = {
         "compile_seconds": t1 - t0,
-        "run_seconds": t2 - t1,
-        "resamples_per_second": total_resamples / max(t2 - t1, 1e-9),
+        "run_seconds": best,
+        "all_run_seconds": run_times,
+        "resamples_per_second": total_resamples / max(best, 1e-9),
         "device_memory": device_memory_stats(),
     }
     return host
