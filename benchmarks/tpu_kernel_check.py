@@ -23,13 +23,14 @@ import numpy as np
 
 from consensus_clustering_tpu.ops.pallas_hist import consensus_hist_counts
 
-
-def _numpy_counts(cij, n_valid, row_offset, bins):
-    rows = row_offset + np.arange(cij.shape[0])[:, None]
-    cols = np.arange(cij.shape[1])[None, :]
-    mask = (cols > rows) & (rows < n_valid) & (cols < n_valid)
-    counts, _ = np.histogram(cij[mask], bins=bins, range=(0.0, 1.0))
-    return counts
+# The same NumPy reference the unit suite checks against — one contract.
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    ),
+)
+from oracle import oracle_block_hist_counts as _numpy_counts  # noqa: E402
 
 
 def main() -> int:

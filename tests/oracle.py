@@ -50,3 +50,25 @@ def oracle_cdf_pac(
     u1, u2 = pac_interval
     pac = cdf[int(u2 / dbin) - 1] - cdf[int(u1 / dbin)]
     return hist, cdf, edges, pac
+
+
+def oracle_block_hist_counts(
+    cij: np.ndarray, n_valid: int, row_offset: int, bins: int
+) -> np.ndarray:
+    """np.histogram of the strict-upper-triangle entries of a row BLOCK.
+
+    The reference semantics of the Pallas consensus-histogram kernel and
+    its XLA fallback (ops/pallas_hist.py): ``cij`` is rows
+    ``[row_offset, row_offset + R)`` of a (possibly padded) consensus
+    matrix whose true size is ``n_valid``; only global strict-upper
+    entries inside the real matrix count.  Shared by the unit suite and
+    the on-hardware gate (benchmarks/tpu_kernel_check.py) so both check
+    the SAME contract.
+    """
+    rows = row_offset + np.arange(cij.shape[0])[:, None]
+    cols = np.arange(cij.shape[1])[None, :]
+    mask = (cols > rows) & (rows < n_valid) & (cols < n_valid)
+    counts, _ = np.histogram(
+        np.asarray(cij)[mask], bins=bins, range=(0.0, 1.0)
+    )
+    return counts

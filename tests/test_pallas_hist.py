@@ -19,14 +19,7 @@ from consensus_clustering_tpu.ops.pallas_hist import (
 )
 
 
-def _numpy_counts(cij, n_valid, row_offset, bins):
-    rows = row_offset + np.arange(cij.shape[0])[:, None]
-    cols = np.arange(cij.shape[1])[None, :]
-    mask = (cols > rows) & (rows < n_valid) & (cols < n_valid)
-    counts, _ = np.histogram(
-        np.asarray(cij)[mask], bins=bins, range=(0.0, 1.0)
-    )
-    return counts
+from oracle import oracle_block_hist_counts as _numpy_counts
 
 
 class TestPallasHist:
