@@ -1,0 +1,151 @@
+"""Multi-host bootstrap exercised with REAL multiple processes.
+
+Two OS processes bring up the JAX distributed runtime over a local
+coordinator (the CPU/GPU-cluster path of ``parallel/distributed.py``), form
+one GLOBAL mesh spanning both processes' devices, and run the same compiled
+sweep — psum/all_gather ride the cross-process transport, the multi-host
+story SURVEY.md §2.5 requires.  Both processes must agree bitwise on the
+replicated outputs, and the result must equal a plain single-process run of
+the same config (device-count invariance extended across process
+boundaries).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from consensus_clustering_tpu.parallel import distributed
+
+coord, pid = sys.argv[1], int(sys.argv[2])
+distributed.initialize(
+    coordinator_address=coord, num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.process_index() == pid
+assert distributed.is_primary() == (pid == 0)
+devices = jax.devices()
+assert len(devices) == 4, devices  # 2 local per process, global view
+
+import numpy as np
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.sweep import build_sweep
+
+rng = np.random.default_rng(3)
+x = np.concatenate([
+    rng.normal(size=(15, 4)), rng.normal(size=(15, 4)) + 1.0
+]).astype(np.float32)
+config = SweepConfig(
+    n_samples=30, n_features=4, k_values=(2, 3), n_iterations=11,
+    store_matrices=False,
+)
+mesh = resample_mesh(devices, row_shards=2)  # ('h', 'n') across processes
+sweep = build_sweep(KMeans(n_init=2), config, mesh=mesh)
+out = jax.block_until_ready(sweep(x, jax.random.PRNGKey(0)))
+# pac/hist are replicated outputs: addressable on every process.
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "pac": np.asarray(out["pac_area"]).tolist(),
+    "hist": np.asarray(out["hist"]).tolist(),
+}), flush=True)
+"""
+
+_SINGLE = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.sweep import build_sweep
+
+rng = np.random.default_rng(3)
+x = np.concatenate([
+    rng.normal(size=(15, 4)), rng.normal(size=(15, 4)) + 1.0
+]).astype(np.float32)
+config = SweepConfig(
+    n_samples=30, n_features=4, k_values=(2, 3), n_iterations=11,
+    store_matrices=False,
+)
+mesh = resample_mesh(jax.devices()[:1])
+sweep = build_sweep(KMeans(n_init=2), config, mesh=mesh)
+out = jax.block_until_ready(sweep(x, jax.random.PRNGKey(0)))
+print("RESULT " + json.dumps({
+    "pac": np.asarray(out["pac_area"]).tolist(),
+    "hist": np.asarray(out["hist"]).tolist(),
+}), flush=True)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse_result(stdout):
+    for line in reversed(stdout.strip().splitlines()):
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(f"no RESULT line in: {stdout[-2000:]}")
+
+
+class TestTwoProcessBootstrap:
+    def test_global_mesh_spans_processes_and_matches_single(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        coord = f"127.0.0.1:{_free_port()}"
+
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER, coord, str(pid)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env, cwd=_REPO,
+            )
+            for pid in (0, 1)
+        ]
+        outs = []
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=420)
+            assert p.returncode == 0, (
+                f"worker failed rc={p.returncode}:\n{stderr[-3000:]}"
+            )
+            outs.append(_parse_result(stdout))
+
+        # Both processes see the same replicated result, bitwise.
+        assert outs[0]["pac"] == outs[1]["pac"]
+        assert outs[0]["hist"] == outs[1]["hist"]
+
+        # And the 2-process/4-device mesh reproduces the 1-device run
+        # exactly (cross-process extension of the device-count invariance
+        # the in-suite tests already prove on a fake mesh).
+        single_env = dict(env)
+        single_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        single = subprocess.run(
+            [sys.executable, "-c", _SINGLE],
+            capture_output=True, text=True, timeout=420, env=single_env,
+            cwd=_REPO,
+        )
+        assert single.returncode == 0, single.stderr[-3000:]
+        ref = _parse_result(single.stdout)
+        np.testing.assert_array_equal(
+            np.asarray(outs[0]["hist"]), np.asarray(ref["hist"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(outs[0]["pac"]), np.asarray(ref["pac"])
+        )
