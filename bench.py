@@ -200,6 +200,9 @@ def main(argv=None):
     peak = out["timing"].get("device_memory", {}).get("peak_bytes_in_use")
     if peak:
         record["peak_device_bytes"] = peak
+    static_total = out["timing"].get("compiled_memory", {}).get("total_bytes")
+    if static_total:
+        record["compiled_memory_bytes"] = static_total
     print(json.dumps(record))
 
 

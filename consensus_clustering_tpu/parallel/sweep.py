@@ -272,5 +272,38 @@ def run_sweep(
         "all_run_seconds": run_times,
         "resamples_per_second": total_resamples / max(best, 1e-9),
         "device_memory": device_memory_stats(),
+        # XLA's static memory plan for the executable.  The runtime
+        # allocator high-water (device_memory above) is unavailable on
+        # some plugin backends (the axon tunnel returns None), but the
+        # compile-time plan — arguments + outputs + peak temporaries — is
+        # the HBM commitment of the program and is always available.
+        "compiled_memory": _compiled_memory_stats(compiled),
     }
     return host
+
+
+def _compiled_memory_stats(compiled) -> Dict[str, int]:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # pragma: no cover - backend-dependent
+        return {}
+    if ma is None:
+        return {}
+    fields = (
+        "argument_size_in_bytes", "output_size_in_bytes",
+        "temp_size_in_bytes", "generated_code_size_in_bytes",
+    )
+    out = {}
+    for f in fields:
+        v = getattr(ma, f, None)
+        if v is not None:
+            out[f] = int(v)
+    if out:
+        out["total_bytes"] = sum(
+            out.get(f, 0)
+            for f in (
+                "argument_size_in_bytes", "output_size_in_bytes",
+                "temp_size_in_bytes",
+            )
+        )
+    return out

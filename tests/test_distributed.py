@@ -120,12 +120,20 @@ class TestTwoProcessBootstrap:
             for pid in (0, 1)
         ]
         outs = []
-        for p in procs:
-            stdout, stderr = p.communicate(timeout=420)
-            assert p.returncode == 0, (
-                f"worker failed rc={p.returncode}:\n{stderr[-3000:]}"
-            )
-            outs.append(_parse_result(stdout))
+        try:
+            for p in procs:
+                stdout, stderr = p.communicate(timeout=420)
+                assert p.returncode == 0, (
+                    f"worker failed rc={p.returncode}:\n{stderr[-3000:]}"
+                )
+                outs.append(_parse_result(stdout))
+        finally:
+            # One worker failing leaves its peer blocked in a collective
+            # (no timeout of its own) — never leak it.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
 
         # Both processes see the same replicated result, bitwise.
         assert outs[0]["pac"] == outs[1]["pac"]
