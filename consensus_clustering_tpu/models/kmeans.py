@@ -30,6 +30,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from consensus_clustering_tpu.ops.pallas_lloyd import (
+    lloyd_step,
+    pad_points,
+)
+
 _INF = jnp.float32(jnp.inf)
 
 
@@ -113,11 +118,23 @@ class KMeans:
     ``max_iter`` Lloyd cap, ``tol`` relative centre-shift tolerance
     (normalised by the mean per-feature variance of the subsample, like
     sklearn's ``_tolerance``).
+
+    ``use_pallas``: True opts into the fused Lloyd-step kernel
+    (ops/pallas_lloyd — one HBM pass over x per iteration instead of
+    three); default/None/False use the XLA formulation.  STRICTLY
+    explicit opt-in: at sweep shapes Mosaic's per-grid-step overhead
+    outweighs the traffic savings (benchmarks/PERF.md) — the kernel
+    exists for large single-problem fits, not the vmapped sweep — and a
+    probe-cache default would couple KMeans behavior to unrelated
+    earlier calls.  f32-only: the f64 parity path always takes the XLA
+    body.  ``pallas_interpret`` runs it in interpreter mode (CPU tests).
     """
 
     n_init: int = 1
     max_iter: int = 100
     tol: float = 1e-4
+    use_pallas: Optional[bool] = None
+    pallas_interpret: bool = False
 
     def fit_predict(
         self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
@@ -150,6 +167,57 @@ class KMeans:
 
         tol_abs = self.tol * jnp.mean(jnp.var(x, axis=0))
 
+        # Strictly explicit opt-in (see class docstring): a cached-probe
+        # default would make KMeans behavior depend on whether some other
+        # code probed the kernel earlier in the process.  f32-only.
+        use_kernel = bool(self.use_pallas) and x.dtype == jnp.float32
+        x_pad = pad_points(x) if use_kernel else None
+
+        def apply_update(centroids, sums, counts, far_idx):
+            """Shared Lloyd epilogue for BOTH bodies: mean update, empty-
+            cluster relocation onto the per-bucket far points, and the
+            squared centre shift.  Living here once is what keeps the
+            kernel and XLA paths semantically identical.
+            """
+            keep = (counts > 0) & valid
+            new_centroids = jnp.where(
+                keep[:, None],
+                sums / jnp.maximum(counts, 1.0)[:, None],
+                centroids,
+            )
+            empty = valid & (counts == 0)
+            empty_rank = jnp.clip(
+                jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, k_max - 1
+            )
+            respawn = x[far_idx[empty_rank]]
+            new_centroids = jnp.where(empty[:, None], respawn, new_centroids)
+            shift = jnp.sum((new_centroids - centroids) ** 2)
+            return new_centroids, shift
+
+        def bucket_far_points(d_min):
+            """Sort-free relocation candidates: points are partitioned
+            into k_max strided buckets (point i -> bucket i mod k_max,
+            decorrelated from generators that order points by cluster)
+            and bucket r's candidate is its farthest point — one O(n)
+            argmax, distinct picks guaranteed by construction.  (A
+            lax.top_k here lowers to a sort of the whole vmapped batch
+            on every Lloyd step: it was ~47% of sweep device time for a
+            path that almost never fires.)  The Pallas body computes the
+            same thing in-kernel (ops/pallas_lloyd).
+            """
+            n_pts = x.shape[0]
+            n_row = -(-n_pts // k_max)
+            pad = n_row * k_max - n_pts
+            d_pad = (
+                jnp.concatenate([d_min, jnp.full((pad,), -inf, d_min.dtype)])
+                if pad
+                else d_min
+            )
+            far_row = jnp.argmax(d_pad.reshape(n_row, k_max), axis=0)
+            return jnp.minimum(
+                far_row * k_max + jnp.arange(k_max), n_pts - 1
+            )
+
         def one_restart(rkey):
             centroids = _kmeanspp_init(rkey, x, k, k_max)
 
@@ -160,6 +228,19 @@ class KMeans:
             def cond(state):
                 _, shift, it = state
                 return jnp.logical_and(shift > tol_abs, it < self.max_iter)
+
+            def kernel_body(state):
+                """Fused Lloyd step: one HBM pass over x (ops/pallas_lloyd);
+                the tiny (k_max, d) epilogue stays in XLA."""
+                centroids, _, it = state
+                sums, counts, far_idx = lloyd_step(
+                    x_pad, centroids, k, x.shape[0],
+                    interpret=self.pallas_interpret,
+                )
+                new_centroids, shift = apply_update(
+                    centroids, sums, counts, far_idx
+                )
+                return new_centroids, shift, it + 1
 
             def body(state):
                 centroids, _, it = state
@@ -178,48 +259,16 @@ class KMeans:
                     # would silently degrade the f64 parity path.
                     preferred_element_type=x.dtype,
                 )
-                keep = (counts > 0) & valid
-                new_centroids = jnp.where(
-                    keep[:, None],
-                    sums / jnp.maximum(counts, 1.0)[:, None],
-                    centroids,
+                far_idx = bucket_far_points(jnp.min(d, axis=1))
+                new_centroids, shift = apply_update(
+                    centroids, sums, counts, far_idx
                 )
-                # Empty-cluster relocation (sklearn-flavoured): respawn each
-                # empty valid slot on a distinct point far from its assigned
-                # centroid.  A lax.top_k here lowers to a sort of the whole
-                # vmapped batch on every Lloyd step — it was ~47% of sweep
-                # device time for a path that almost never fires — so
-                # instead the points are partitioned into k_max strided
-                # buckets (point i -> bucket i mod k_max, decorrelated from
-                # generators that order points by cluster) and empty slot
-                # rank r takes the farthest point of bucket r: one O(n)
-                # argmax, distinct picks guaranteed by construction.
-                empty = valid & (counts == 0)
-                d_min = jnp.min(d, axis=1)
-                n_pts = x.shape[0]
-                n_row = -(-n_pts // k_max)
-                pad = n_row * k_max - n_pts
-                d_pad = (
-                    jnp.concatenate([d_min, jnp.full((pad,), -inf, d_min.dtype)])
-                    if pad
-                    else d_min
-                )
-                far_row = jnp.argmax(d_pad.reshape(n_row, k_max), axis=0)
-                far_idx = jnp.minimum(
-                    far_row * k_max + jnp.arange(k_max), n_pts - 1
-                )
-                empty_rank = jnp.clip(
-                    jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, k_max - 1
-                )
-                respawn = x[far_idx[empty_rank]]
-                new_centroids = jnp.where(
-                    empty[:, None], respawn, new_centroids
-                )
-                shift = jnp.sum((new_centroids - centroids) ** 2)
                 return new_centroids, shift, it + 1
 
             init = (centroids, inf, jnp.int32(0))
-            centroids, _, _ = jax.lax.while_loop(cond, body, init)
+            centroids, _, _ = jax.lax.while_loop(
+                cond, kernel_body if use_kernel else body, init
+            )
             d = masked_dist(centroids)
             labels = jnp.argmin(d, axis=1).astype(jnp.int32)
             inertia = jnp.sum(jnp.min(d, axis=1))

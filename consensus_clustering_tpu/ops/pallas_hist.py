@@ -138,46 +138,23 @@ def _pallas_hist(
     return out[0, :bins]
 
 
-# One-time lowering/execution probe per backend.  Round-1's bench produced
-# zero data because the default path crashed Mosaic lowering on the real
-# chip; ``use_pallas=None`` must therefore never select a kernel that has
-# not been proven to compile AND run on the active backend.
-_PROBE_CACHE: dict = {}
-
-
 def kernel_available() -> bool:
     """True iff the Pallas kernel compiles and runs on the active backend.
 
-    The probe compiles and executes the kernel once on a (264, 264) block
-    — a multi-tile grid with ragged edge tiles, the layout class where
-    Mosaic lowering bugs hide (a (1, 1)-grid probe would miss them) — and
-    caches the verdict per backend.  Any failure (lowering, compile, or
-    runtime) degrades to the XLA fallback with a logged warning instead of
-    killing the caller — a bench round must never again produce zero data
-    because of one kernel.
+    The probe (shared mechanism: ops.probe) compiles and executes the
+    kernel once on a (264, 264) block — a multi-tile grid with ragged
+    edge tiles, the layout class where Mosaic lowering bugs hide (a
+    (1, 1)-grid probe would miss them) — and caches the verdict per
+    backend, degrading ``use_pallas=None`` to the XLA fallback on any
+    failure (the round-1 bench died because the default path selected a
+    kernel that could not lower on the real chip).
     """
-    backend = jax.default_backend()
-    if backend not in _PROBE_CACHE:
-        if backend == "cpu":
-            # pallas_call on CPU requires interpret mode; the compiled
-            # kernel is a TPU artifact.  The fallback is the CPU path.
-            _PROBE_CACHE[backend] = False
-        else:
-            try:
-                out = _pallas_hist(
-                    jnp.zeros((264, 264), jnp.float32), 0, 20, 260
-                )
-                jax.block_until_ready(out)
-                _PROBE_CACHE[backend] = True
-            except Exception:  # noqa: BLE001 — any failure means fallback
-                logger.warning(
-                    "Pallas consensus-histogram kernel failed its probe on "
-                    "backend %r; using the XLA fallback",
-                    backend,
-                    exc_info=True,
-                )
-                _PROBE_CACHE[backend] = False
-    return _PROBE_CACHE[backend]
+    from consensus_clustering_tpu.ops.probe import probe_cached
+
+    return probe_cached(
+        "consensus_hist",
+        lambda: _pallas_hist(jnp.zeros((264, 264), jnp.float32), 0, 20, 260),
+    )
 
 
 def consensus_hist_counts(

@@ -1,0 +1,275 @@
+"""Pallas TPU kernel: one fused Lloyd step (assign + accumulate).
+
+The k-sweep's dominant cost is the KMeans Lloyd loop (~71% of device time
+in the headline trace, benchmarks/PERF.md): per iteration the XLA
+formulation reads the gathered subsample batch TWICE (assignment GEMM +
+one-hot update GEMM) and materialises a (batch, n, k_max) one-hot in HBM
+between them — ~3.4 GB of traffic per iteration at headline shapes against
+a ~1.2 GB irreducible minimum.  This kernel fuses the whole step so ``x``
+streams HBM -> VMEM exactly once per iteration:
+
+  per (tile_n, d) tile of x:
+    dist   = |x|^2 - 2 x.c + |c|^2            (one MXU GEMM, f32)
+    labels = argmin over valid centroid slots  (VPU)
+    sums  += onehot(labels)^T @ [x | 1]        (one MXU GEMM; the appended
+                                                ones-column makes column d
+                                                of the output the cluster
+                                                COUNTS — no second pass)
+    far_*  = per-bucket running argmax of min-distance (for the sort-free
+             empty-cluster relocation, models/kmeans.py: bucket = row mod
+             k_max, ties to the lowest row index)
+
+Everything the while-loop epilogue needs (new centroids, shift, empty-slot
+respawns) is tiny (k_max x d) and stays in XLA.  The final labels/inertia
+pass after convergence also stays in XLA: it runs once per fit vs ~40
+Lloyd iterations, and reuses the already-tested masked-distance path.
+
+Semantics match models/kmeans.py's XLA formulation exactly up to f32
+reduction order (tile-sequential accumulation here vs one flat GEMM
+there); tie-breaks (argmin first-lowest slot, relocation first-lowest row)
+are identical by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger(__name__)
+
+_LANES = 128
+_DEF_TILE_N = 512
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return -(-value // multiple) * multiple
+
+
+def _lloyd_kernel(
+    k_ref, x_ref, ct_ref, sums_ref, far_val_ref, far_idx_ref,
+    *, n_valid, k_max, d, tile_n, k_pad, d_pad,
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        far_val_ref[:] = jnp.full_like(far_val_ref, -jnp.inf)
+        far_idx_ref[:] = jnp.zeros_like(far_idx_ref)
+
+    k = k_ref[0, 0]
+    x = x_ref[:]  # (tile_n, d_pad); rows >= n_valid and lanes >= d are 0
+    ct = ct_ref[:]  # (d_pad, k_pad) centroids^T; pad rows/lanes are 0
+
+    rows = i * tile_n + jax.lax.broadcasted_iota(
+        jnp.int32, (tile_n, 1), 0
+    )  # global row index, (tile_n, 1)
+    row_valid = rows < n_valid
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (tile_n, k_pad), 1)
+
+    # Squared distances via the GEMM expansion; invalid slots -> +inf.
+    cross = jax.lax.dot_general(
+        x, ct, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # (tile_n, k_pad)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)  # (tile_n, 1)
+    c2 = jnp.sum(ct * ct, axis=0, keepdims=True)  # (1, k_pad)
+    dist = x2 - 2.0 * cross + c2
+    dist = jnp.where(lane_k < k, dist, jnp.inf)
+
+    labels = jnp.argmin(dist, axis=1).astype(jnp.int32)  # (tile_n,)
+    onehot = (labels[:, None] == lane_k).astype(jnp.float32)
+    onehot = jnp.where(row_valid, onehot, 0.0)
+
+    # Ones-column at lane d: sums_ref column d accumulates the counts.
+    lane_d = jax.lax.broadcasted_iota(jnp.int32, (tile_n, d_pad), 1)
+    x_aug = x + jnp.where(
+        (lane_d == d) & row_valid, jnp.float32(1.0), jnp.float32(0.0)
+    )
+    sums_ref[:] += jax.lax.dot_general(
+        onehot, x_aug, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # (k_pad, d_pad)
+
+    # Sort-free relocation support: per bucket (row mod k_max), the
+    # running max of the point's min-distance and its global row index,
+    # ties to the lowest row (matching models/kmeans.py's strided-bucket
+    # argmax: within-tile argmax is first-occurrence, and the strict >
+    # merge keeps the earlier tile).
+    d_min = jnp.maximum(
+        jnp.min(dist, axis=1, keepdims=True), 0.0
+    )  # (tile_n, 1), clamped like _pairwise_sqdist
+    bucket = jax.lax.rem(rows, jnp.int32(k_max))  # (tile_n, 1)
+    in_bucket = (bucket == lane_k) & row_valid
+    masked = jnp.where(in_bucket, d_min, -jnp.inf)  # (tile_n, k_pad)
+    tile_val = jnp.max(masked, axis=0, keepdims=True)  # (1, k_pad)
+    # First (lowest GLOBAL row) maximiser per bucket: min of the global
+    # row numbers among rows achieving the max.
+    tile_row = jnp.min(
+        jnp.where(masked == tile_val, rows, jnp.int32(2**30)),
+        axis=0, keepdims=True,
+    )  # (1, k_pad)
+    sub = far_val_ref.shape[0]
+    tile_val8 = jnp.broadcast_to(tile_val, (sub, k_pad))
+    tile_idx8 = jnp.broadcast_to(tile_row, (sub, k_pad))
+    better = tile_val8 > far_val_ref[:]
+    far_idx_ref[:] = jnp.where(better, tile_idx8, far_idx_ref[:])
+    far_val_ref[:] = jnp.where(better, tile_val8, far_val_ref[:])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_valid", "k_max", "d", "interpret"),
+)
+def _lloyd_step_padded(
+    x_pad: jax.Array,
+    centroids_t_pad: jax.Array,
+    k: jax.Array,
+    n_valid: int,
+    k_max: int,
+    d: int,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """(sums_aug (k_pad, d_pad), far_idx (k_pad,)) for one padded problem."""
+    n_pad, d_pad = x_pad.shape
+    d_pad_c, k_pad = centroids_t_pad.shape
+    assert d_pad_c == d_pad, (d_pad_c, d_pad)
+    tile_n = min(_DEF_TILE_N, n_pad)
+    grid = (pl.cdiv(n_pad, tile_n),)
+
+    kernel = functools.partial(
+        _lloyd_kernel,
+        n_valid=n_valid, k_max=k_max, d=d,
+        tile_n=tile_n, k_pad=k_pad, d_pad=d_pad,
+    )
+    sums, _, far_idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (tile_n, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (d_pad, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (k_pad, d_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (8, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (8, k_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((8, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((8, k_pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(k, jnp.int32).reshape(1, 1),
+        x_pad.astype(jnp.float32),
+        centroids_t_pad.astype(jnp.float32),
+    )
+    return sums, far_idx[0]
+
+
+def pad_points(x: jax.Array, d_pad: Optional[int] = None) -> jax.Array:
+    """Zero-pad (n, d) points to the kernel's (n_pad, d_pad) layout.
+
+    Done ONCE per fit (x is Lloyd-loop invariant); ``d_pad`` always leaves
+    at least one zero lane after the data so the kernel's ones-column (the
+    counts accumulator) has a home.
+    """
+    n, d = x.shape
+    if d_pad is None:
+        d_pad = _round_up(d + 1, _LANES)
+    if d_pad < d + 1:
+        raise ValueError(
+            f"d_pad={d_pad} must leave a spare lane after d={d} for the "
+            "kernel's counts column"
+        )
+    tile_n = min(_DEF_TILE_N, _round_up(n, 8))
+    n_pad = _round_up(n, tile_n)
+    return jnp.pad(
+        x.astype(jnp.float32), ((0, n_pad - n), (0, d_pad - d))
+    )
+
+
+def lloyd_step(
+    x_pad: jax.Array,
+    centroids: jax.Array,
+    k: jax.Array,
+    n_valid: int,
+    *,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fused Lloyd step on a pre-padded problem.
+
+    Args:
+      x_pad: (n_pad, d_pad) from :func:`pad_points`.
+      centroids: (k_max, d) current centroids (unpadded).
+      k: traced active-cluster count (slots >= k are masked).
+      n_valid: true number of points (rows >= n_valid are layout padding).
+      interpret: run the kernel in interpreter mode (CPU testing).
+
+    Returns:
+      (sums (k_max, d), counts (k_max,), far_idx (k_max,)): per-slot point
+      sums and member counts, plus the relocation candidates — for each
+      bucket b, the global index of the point with the largest
+      min-distance among rows == b (mod k_max).
+    """
+    k_max, d = centroids.shape
+    d_pad = x_pad.shape[1]
+    if d_pad < d + 1:
+        # The counts live in the ones-column at lane d; without a spare
+        # zero lane the kernel would silently accumulate no counts and
+        # the caller's column read would clamp onto the last feature.
+        raise ValueError(
+            f"x_pad width {d_pad} leaves no spare lane after d={d} for "
+            "the counts column; pad with pad_points (d_pad >= d + 1)"
+        )
+    k_pad = _round_up(k_max, _LANES)
+    ct = jnp.zeros((d_pad, k_pad), jnp.float32)
+    ct = ct.at[:d, :k_max].set(centroids.T.astype(jnp.float32))
+    sums_aug, far_idx = _lloyd_step_padded(
+        x_pad, ct, k, n_valid, k_max, d, interpret=interpret
+    )
+    sums = sums_aug[:k_max, :d]
+    counts = sums_aug[:k_max, d]
+    return sums, counts, jnp.clip(far_idx[:k_max], 0, n_valid - 1)
+
+
+# --- availability probe (shared mechanism, ops.probe) ------------------
+
+
+def lloyd_kernel_available() -> bool:
+    """True iff the fused Lloyd kernel compiles and runs on this backend.
+
+    Compiles and executes the kernel once on a multi-tile problem and
+    caches the verdict per backend (ops.probe); any failure means the XLA
+    Lloyd path.  Call OUTSIDE jit traces.  Note this gates availability
+    only — the kernel is still opt-in (``KMeans(use_pallas=True)``); see
+    the KMeans docstring for why it is not a default.
+    """
+    from consensus_clustering_tpu.ops.probe import probe_cached
+
+    def _probe():
+        x = pad_points(jnp.ones((_DEF_TILE_N + 40, 7)))
+        c = jnp.ones((5, 7), jnp.float32)
+        return lloyd_step(x, c, jnp.int32(4), _DEF_TILE_N + 40)
+
+    return probe_cached("lloyd_step", _probe)

@@ -1,0 +1,56 @@
+"""One-time per-backend availability probes for Pallas kernels.
+
+The round-1 bench produced zero data because a default code path selected
+a kernel that crashed Mosaic lowering on the real chip.  The rule ever
+since: no kernel is picked by default unless it has been proven to
+compile AND run on the active backend, and any probe failure degrades to
+the XLA fallback with a logged warning — a bench round must never again
+die because of one kernel.
+
+Both Pallas kernels (the consensus histogram and the fused Lloyd step)
+share this mechanism so a hardening fix lands in one place.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Tuple
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+# (kernel name, backend) -> verdict.  Module-global on purpose: the
+# verdict is a property of the backend, not of any one caller.
+_PROBE_CACHE: Dict[Tuple[str, str], bool] = {}
+
+
+def probe_cached(name: str, probe_fn: Callable[[], object]) -> bool:
+    """True iff ``probe_fn`` has compiled and run on this backend.
+
+    ``probe_fn`` should execute the kernel once on shapes that exercise a
+    multi-tile grid with ragged edge tiles (where Mosaic lowering bugs
+    hide) and return the output arrays; this helper blocks on them and
+    caches the verdict per (kernel, backend).  CPU backends are always
+    False: compiled Pallas is an accelerator artifact (interpret mode is
+    the CPU test path).  Call OUTSIDE jit traces — a jit launched during
+    tracing is inlined into the caller's program, not executed.
+    """
+    backend = jax.default_backend()
+    key = (name, backend)
+    if key not in _PROBE_CACHE:
+        if backend == "cpu":
+            _PROBE_CACHE[key] = False
+        else:
+            try:
+                jax.block_until_ready(probe_fn())
+                _PROBE_CACHE[key] = True
+            except Exception:  # noqa: BLE001 — any failure means fallback
+                logger.warning(
+                    "Pallas kernel %r failed its probe on backend %r; "
+                    "using the XLA fallback",
+                    name, backend,
+                    exc_info=True,
+                )
+                _PROBE_CACHE[key] = False
+    return _PROBE_CACHE[key]

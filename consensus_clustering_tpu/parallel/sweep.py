@@ -92,6 +92,11 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     use_pallas = config.use_pallas
     if use_pallas is None:
         use_pallas = kernel_available()
+    # The fused Lloyd kernel (ops/pallas_lloyd) is NOT probed here: it is
+    # opt-in via KMeans(use_pallas=True) only.  At sweep shapes the grid
+    # is (restarts x resamples x row-tiles) of small blocks and Mosaic's
+    # per-grid-step overhead outweighs the HBM-traffic savings — the XLA
+    # Lloyd body is already near the HBM roofline (benchmarks/PERF.md).
 
     def local_body(x, indices, key_cluster):
         """Runs per device.

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from consensus_clustering_tpu.ops.analysis import cdf_pac
-from consensus_clustering_tpu.ops import pallas_hist
+from consensus_clustering_tpu.ops import pallas_hist, probe
 from consensus_clustering_tpu.ops.pallas_hist import (
     consensus_hist_counts,
     kernel_available,
@@ -74,12 +74,12 @@ class TestPallasHist:
         np.testing.assert_array_equal(np.asarray(pallas), np.asarray(xla))
 
     def test_probe_false_on_cpu_and_cached(self):
-        pallas_hist._PROBE_CACHE.clear()
+        probe._PROBE_CACHE.clear()
         try:
             assert kernel_available() is False
-            assert pallas_hist._PROBE_CACHE == {"cpu": False}
+            assert probe._PROBE_CACHE == {("consensus_hist", "cpu"): False}
         finally:
-            pallas_hist._PROBE_CACHE.clear()
+            probe._PROBE_CACHE.clear()
 
     def test_default_use_pallas_never_crashes(self, rng, monkeypatch, caplog):
         # Simulate the round-1 failure: a non-CPU backend whose kernel dies
@@ -90,15 +90,15 @@ class TestPallasHist:
         def boom(*args, **kwargs):
             raise ValueError("Cannot store scalars to VMEM")
 
-        pallas_hist._PROBE_CACHE.clear()
+        probe._PROBE_CACHE.clear()
         monkeypatch.setattr(
-            pallas_hist.jax, "default_backend", lambda: "faketpu"
+            probe.jax, "default_backend", lambda: "faketpu"
         )
         monkeypatch.setattr(pallas_hist, "_pallas_hist", boom)
         cij = rng.random((50, 50), dtype=np.float32)
         try:
             with caplog.at_level(
-                logging.WARNING, logger=pallas_hist.logger.name
+                logging.WARNING, logger=probe.logger.name
             ):
                 got = consensus_hist_counts(jnp.asarray(cij), 50, 0, 20)
             assert any(
@@ -114,7 +114,7 @@ class TestPallasHist:
             )
             consensus_hist_counts(jnp.asarray(cij), 50, 0, 20)
         finally:
-            pallas_hist._PROBE_CACHE.clear()
+            probe._PROBE_CACHE.clear()
 
     def test_consistent_with_cdf_pac(self, rng):
         # cdf_pac's internal counts path and the kernel must agree: same

@@ -1,0 +1,160 @@
+"""Fused Lloyd-step kernel vs the XLA formulation and NumPy.
+
+Runs the kernel in interpreter mode (CPU backend, per conftest); compiled
+TPU runs are exercised by benchmarks/tpu_kernel_check.py and the bench.
+The kernel computes, in ONE pass over x: per-slot point sums, member
+counts (via an appended ones-column), and the sort-free relocation
+candidates (per-bucket argmax of min-distance) — see ops/pallas_lloyd.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.ops import probe
+from consensus_clustering_tpu.ops.pallas_lloyd import (
+    lloyd_kernel_available,
+    lloyd_step,
+    pad_points,
+)
+
+
+def _numpy_lloyd(x, c, k, k_max):
+    """Reference: assignment, sums, counts, per-bucket relocation picks."""
+    n = x.shape[0]
+    d2 = ((x[:, None, :].astype(np.float64) - c[None, :, :]) ** 2).sum(-1)
+    d2[:, k:] = np.inf
+    labels = d2.argmin(1)
+    counts = np.bincount(labels, minlength=k_max).astype(np.float64)
+    sums = np.zeros((k_max, x.shape[1]), np.float64)
+    np.add.at(sums, labels, x.astype(np.float64))
+    d_min = np.maximum(d2.min(1), 0.0)
+    far = np.zeros(k_max, np.int64)
+    for b in range(k_max):
+        idx = np.arange(n)[np.arange(n) % k_max == b]
+        far[b] = idx[np.argmax(d_min[idx])] if idx.size else 0
+    return labels, sums, counts, far
+
+
+class TestLloydStepKernel:
+    @pytest.mark.parametrize(
+        "n,d,k_max,k",
+        [(700, 7, 8, 5), (520, 50, 20, 20), (40, 3, 6, 2), (513, 129, 4, 3)],
+    )
+    def test_matches_numpy(self, rng, n, d, k_max, k):
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        c = rng.normal(size=(k_max, d)).astype(np.float32)
+        sums, counts, far = lloyd_step(
+            pad_points(jnp.asarray(x)), jnp.asarray(c), jnp.int32(k), n,
+            interpret=True,
+        )
+        _, ref_sums, ref_counts, ref_far = _numpy_lloyd(x, c, k, k_max)
+        np.testing.assert_array_equal(np.asarray(counts), ref_counts)
+        np.testing.assert_allclose(
+            np.asarray(sums), ref_sums, rtol=3e-5, atol=3e-5
+        )
+        np.testing.assert_array_equal(np.asarray(far), ref_far)
+
+    def test_quantized_data_is_exact(self, rng):
+        # Integer-valued points: every sum is exactly representable, so
+        # the kernel and NumPy must agree BITWISE, not just closely.
+        x = rng.integers(-8, 8, size=(300, 9)).astype(np.float32)
+        c = rng.integers(-8, 8, size=(5, 9)).astype(np.float32)
+        sums, counts, _ = lloyd_step(
+            pad_points(jnp.asarray(x)), jnp.asarray(c), jnp.int32(5), 300,
+            interpret=True,
+        )
+        _, ref_sums, ref_counts, _ = _numpy_lloyd(x, c, 5, 5)
+        np.testing.assert_array_equal(np.asarray(sums), ref_sums)
+        np.testing.assert_array_equal(np.asarray(counts), ref_counts)
+
+    def test_kmeans_kernel_path_matches_xla_path(self, rng):
+        # Full fits through both Lloyd bodies agree on the clustering.
+        from sklearn.metrics import adjusted_rand_score
+
+        x = jnp.asarray(
+            np.concatenate(
+                [rng.normal(size=(60, 5)) + c * 4.0 for c in range(4)]
+            ).astype(np.float32)
+        )
+        for k, k_max in [(4, 4), (3, 8)]:
+            a = KMeans(n_init=2).fit_predict(
+                jax.random.PRNGKey(0), x, jnp.int32(k), k_max
+            )
+            b = KMeans(
+                n_init=2, use_pallas=True, pallas_interpret=True
+            ).fit_predict(jax.random.PRNGKey(0), x, jnp.int32(k), k_max)
+            assert adjusted_rand_score(np.asarray(a), np.asarray(b)) == 1.0
+
+    def test_kernel_path_relocates_empty_clusters(self):
+        # Duplicate-heavy data where naive Lloyd would leave empty slots.
+        x = jnp.asarray(
+            np.concatenate([
+                np.zeros((40, 2)), np.ones((3, 2)), 2 * np.ones((3, 2)),
+                3 * np.ones((3, 2)),
+            ]).astype(np.float32)
+        )
+        labels = np.asarray(
+            KMeans(
+                n_init=1, use_pallas=True, pallas_interpret=True
+            ).fit_predict(jax.random.PRNGKey(0), x, jnp.int32(4), 4)
+        )
+        assert set(labels.tolist()) == {0, 1, 2, 3}
+
+    def test_probe_false_on_cpu(self):
+        probe._PROBE_CACHE.clear()
+        try:
+            assert lloyd_kernel_available() is False
+            assert probe._PROBE_CACHE == {("lloyd_step", "cpu"): False}
+        finally:
+            probe._PROBE_CACHE.clear()
+
+    def test_opt_in_is_strict(self, rng):
+        # A passed probe must NOT flip default KMeans onto the kernel:
+        # behavior would depend on unrelated earlier calls.
+        probe._PROBE_CACHE[("lloyd_step", "cpu")] = True
+        try:
+            x = jnp.asarray(rng.normal(size=(30, 3)).astype(np.float32))
+            # Default path must run the XLA body — on CPU the compiled
+            # kernel would raise, so not raising proves the XLA path.
+            labels = KMeans(n_init=1).fit_predict(
+                jax.random.PRNGKey(0), x, jnp.int32(3), 3
+            )
+            assert int(np.asarray(labels).max()) < 3
+        finally:
+            probe._PROBE_CACHE.clear()
+
+    def test_f64_input_takes_xla_path(self):
+        # The kernel is f32-only; use_pallas=True on f64 input must fall
+        # back to the XLA body (not crash) so the x64 parity path keeps
+        # working.  Needs real f64 arrays, hence an x64 subprocess (the
+        # in-suite backend silently downcasts f64 -> f32).
+        import os
+        import subprocess
+        import sys
+
+        script = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp, numpy as np
+from consensus_clustering_tpu.models.kmeans import KMeans
+x = jnp.asarray(np.random.default_rng(0).normal(size=(30, 3)))
+assert x.dtype == jnp.float64, x.dtype
+labels = KMeans(n_init=1, use_pallas=True).fit_predict(
+    jax.random.PRNGKey(0), x, jnp.int32(3), 3
+)
+assert int(np.asarray(labels).max()) < 3
+print("OK")
+"""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "OK" in proc.stdout
