@@ -1,15 +1,16 @@
 """Compiled (non-interpret) Pallas kernel verification on the real chip.
 
-The unit suite runs the consensus-histogram kernel in interpreter mode on a
-CPU backend (tests/conftest.py pins JAX_PLATFORMS=cpu), which cannot catch
-Mosaic lowering failures — round 1 shipped a kernel that passed every test
-and crashed on hardware ("Cannot store scalars to VMEM").  This script is
-the hardware gate: it compiles the kernel for the active accelerator and
-checks it bit-exactly against np.histogram on full matrices, offset row
-blocks and padded layouts.
+The unit suite runs BOTH Pallas kernels (consensus histogram, fused Lloyd
+step) in interpreter mode on a CPU backend (tests/conftest.py pins
+JAX_PLATFORMS=cpu), which cannot catch Mosaic lowering failures — round 1
+shipped a kernel that passed every test and crashed on hardware ("Cannot
+store scalars to VMEM").  This script is the hardware gate: it compiles
+each kernel for the active accelerator and checks it against the same
+NumPy references the unit suite uses (histogram: bit-exact; Lloyd sums:
+f32-reduction-order tolerance, counts exact).
 
 Run on TPU:  python benchmarks/tpu_kernel_check.py
-Exit code 0 = kernel proven on this backend; 1 = mismatch or crash.
+Exit code 0 = kernels proven on this backend; 1 = mismatch or crash.
 """
 
 import os
@@ -31,6 +32,43 @@ sys.path.insert(
     ),
 )
 from oracle import oracle_block_hist_counts as _numpy_counts  # noqa: E402
+
+
+def _check_lloyd(rng) -> int:
+    from consensus_clustering_tpu.ops.pallas_lloyd import (
+        lloyd_step, pad_points,
+    )
+
+    failures = 0
+    for n, d, k_max, k in [(700, 7, 8, 5), (4000, 50, 20, 20), (40, 3, 6, 2)]:
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        c = rng.normal(size=(k_max, d)).astype(np.float32)
+        try:
+            sums, counts, far = (
+                np.asarray(v) for v in lloyd_step(
+                    pad_points(jnp.asarray(x)), jnp.asarray(c),
+                    jnp.int32(k), n,
+                )
+            )
+        except Exception as exc:  # noqa: BLE001 — report, keep checking
+            print(f"FAIL lloyd n={n} d={d}: {type(exc).__name__}: {exc}")
+            failures += 1
+            continue
+        d2 = ((x[:, None, :].astype(np.float64) - c[None, :, :]) ** 2).sum(-1)
+        d2[:, k:] = np.inf
+        labels = d2.argmin(1)
+        ref_counts = np.bincount(labels, minlength=k_max)
+        ref_sums = np.zeros((k_max, d), np.float64)
+        np.add.at(ref_sums, labels, x.astype(np.float64))
+        ok = np.array_equal(counts, ref_counts) and np.allclose(
+            sums, ref_sums, rtol=3e-5, atol=3e-5
+        )
+        if ok:
+            print(f"ok   lloyd n={n} d={d} k={k}/{k_max}")
+        else:
+            print(f"FAIL lloyd n={n} d={d}: counts/sums mismatch")
+            failures += 1
+    return failures
 
 
 def main() -> int:
@@ -67,6 +105,7 @@ def main() -> int:
         else:
             print(f"FAIL {shape}: got {got} want {want}")
             failures += 1
+    failures += _check_lloyd(rng)
     print(f"kernel_check: backend={backend} failures={failures}")
     return 1 if failures else 0
 

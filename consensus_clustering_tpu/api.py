@@ -143,6 +143,12 @@ class ConsensusClustering:
         ``checkpoint_dir`` for the resume benefit).  Caps peak HBM when
         storing matrices and bounds how much work a crash can lose, at the
         cost of one compilation per batch.  None (default) = one program.
+    compute_dtype : str, keyword-only
+        Working float dtype, "float32" (default) or "float64".  f64 needs
+        ``JAX_ENABLE_X64`` and a CPU backend; it is the reference-parity
+        mode for ill-conditioned problems (e.g. full-covariance GMM when
+        the subsample size is below the feature count) — see
+        ``SweepConfig.dtype``.
 
     Attributes
     ----------

@@ -15,8 +15,26 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+
+def _honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` from the environment stick.
+
+    Some deployments register accelerator plugins from a sitecustomize
+    that sets ``jax_platforms`` programmatically, silently overriding the
+    env var — so ``JAX_PLATFORMS=cpu python -m consensus_clustering_tpu``
+    would still try to initialise the accelerator (and hang if it is
+    unreachable).  Pin the config back to whatever the environment asked
+    for before any backend initialises.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
 
 
 def _parse_k(spec: str):
@@ -70,6 +88,14 @@ def _make_clusterer(name: str):
 
 
 def cmd_run(args):
+    if args.compute_dtype == "float64":
+        # Without x64 every f64 array silently downcasts to f32 — the
+        # exact numerically-chaotic path this mode exists to avoid.  The
+        # CLI owns the process entry point, so enable it here.
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
     from consensus_clustering_tpu.api import ConsensusClustering
 
     x = _load_dataset(args.dataset, args.n_samples, args.n_features, args.seed)
@@ -88,6 +114,7 @@ def cmd_run(args):
         use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
         metrics_path=args.metrics_path,
         k_batch_size=args.k_batch_size,
+        compute_dtype=args.compute_dtype,
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -124,6 +151,7 @@ def cmd_bench(args):
 
 
 def main(argv=None):
+    _honor_platform_env()
     parser = argparse.ArgumentParser(
         prog="consensus_clustering_tpu",
         description="TPU-native consensus clustering",
@@ -148,6 +176,11 @@ def main(argv=None):
                      help="consensus-histogram kernel selection")
     run.add_argument("--metrics-path", default=None,
                      help="append JSON-lines run metrics to this file")
+    run.add_argument("--compute-dtype", choices=["float32", "float64"],
+                     default="float32",
+                     help="float64 needs JAX_ENABLE_X64 + CPU backend; "
+                     "reference-parity mode for ill-conditioned data "
+                     "(see SweepConfig.dtype)")
     run.add_argument("--k-batch-size", type=int, default=None,
                      help="compile/run the sweep in batches of this many "
                           "K values, checkpointing after each")

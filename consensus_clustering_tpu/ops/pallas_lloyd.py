@@ -81,7 +81,11 @@ def _lloyd_kernel(
     )  # (tile_n, k_pad)
     x2 = jnp.sum(x * x, axis=1, keepdims=True)  # (tile_n, 1)
     c2 = jnp.sum(ct * ct, axis=0, keepdims=True)  # (1, k_pad)
-    dist = x2 - 2.0 * cross + c2
+    # Clamp at 0 BEFORE the argmin, exactly like _pairwise_sqdist: the
+    # expansion can go slightly negative in f32, and unclamped values
+    # would break label tie-breaks on points coincident with several
+    # centroids (XLA body sees 0.0 for all of them; so must we).
+    dist = jnp.maximum(x2 - 2.0 * cross + c2, 0.0)
     dist = jnp.where(lane_k < k, dist, jnp.inf)
 
     labels = jnp.argmin(dist, axis=1).astype(jnp.int32)  # (tile_n,)
@@ -137,8 +141,8 @@ def _lloyd_step_padded(
     k_max: int,
     d: int,
     interpret: bool = False,
-) -> Tuple[jax.Array, jax.Array]:
-    """(sums_aug (k_pad, d_pad), far_idx (k_pad,)) for one padded problem."""
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(sums_aug (k_pad, d_pad), far_val (k_pad,), far_idx (k_pad,))."""
     n_pad, d_pad = x_pad.shape
     d_pad_c, k_pad = centroids_t_pad.shape
     assert d_pad_c == d_pad, (d_pad_c, d_pad)
@@ -150,7 +154,7 @@ def _lloyd_step_padded(
         n_valid=n_valid, k_max=k_max, d=d,
         tile_n=tile_n, k_pad=k_pad, d_pad=d_pad,
     )
-    sums, _, far_idx = pl.pallas_call(
+    sums, far_val, far_idx = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -184,7 +188,7 @@ def _lloyd_step_padded(
         x_pad.astype(jnp.float32),
         centroids_t_pad.astype(jnp.float32),
     )
-    return sums, far_idx[0]
+    return sums, far_val[0], far_idx[0]
 
 
 def pad_points(x: jax.Array, d_pad: Optional[int] = None) -> jax.Array:
@@ -245,12 +249,19 @@ def lloyd_step(
     k_pad = _round_up(k_max, _LANES)
     ct = jnp.zeros((d_pad, k_pad), jnp.float32)
     ct = ct.at[:d, :k_max].set(centroids.T.astype(jnp.float32))
-    sums_aug, far_idx = _lloyd_step_padded(
+    sums_aug, far_val, far_idx = _lloyd_step_padded(
         x_pad, ct, k, n_valid, k_max, d, interpret=interpret
     )
     sums = sums_aug[:k_max, :d]
     counts = sums_aug[:k_max, d]
-    return sums, counts, jnp.clip(far_idx[:k_max], 0, n_valid - 1)
+    # Buckets with no valid rows (only possible when n_valid < k_max)
+    # never take the strict-> merge and keep the -inf/0 init; the XLA
+    # bucket_far_points clamps such buckets to n_valid - 1 — match it so
+    # both paths respawn on the same point even in that degenerate case.
+    far_idx = jnp.where(
+        jnp.isneginf(far_val[:k_max]), n_valid - 1, far_idx[:k_max]
+    )
+    return sums, counts, jnp.clip(far_idx, 0, n_valid - 1)
 
 
 # --- availability probe (shared mechanism, ops.probe) ------------------

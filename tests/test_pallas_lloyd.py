@@ -103,6 +103,21 @@ class TestLloydStepKernel:
         )
         assert set(labels.tolist()) == {0, 1, 2, 3}
 
+    def test_empty_bucket_matches_xla_clamp(self, rng):
+        # n < k_max leaves buckets with no rows; both paths must clamp
+        # their relocation candidate to n-1 (the XLA bucket_far_points
+        # behavior) so degenerate fits stay path-identical.
+        n, d, k_max = 5, 3, 8
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        c = rng.normal(size=(k_max, d)).astype(np.float32)
+        _, _, far = lloyd_step(
+            pad_points(jnp.asarray(x)), jnp.asarray(c), jnp.int32(2), n,
+            interpret=True,
+        )
+        far = np.asarray(far)
+        assert (far[n:] == n - 1).all(), far
+        assert (far[:n] < n).all(), far
+
     def test_probe_false_on_cpu(self):
         probe._PROBE_CACHE.clear()
         try:
