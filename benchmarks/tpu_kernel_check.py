@@ -38,9 +38,14 @@ def _check_lloyd(rng) -> int:
     from consensus_clustering_tpu.ops.pallas_lloyd import (
         lloyd_step, pad_points,
     )
+    # The unit suite's reference implementation — same contract, one copy
+    # (it covers sums, counts AND the relocation candidates).
+    from test_pallas_lloyd import _numpy_lloyd
 
     failures = 0
-    for n, d, k_max, k in [(700, 7, 8, 5), (4000, 50, 20, 20), (40, 3, 6, 2)]:
+    for n, d, k_max, k in [
+        (700, 7, 8, 5), (4000, 50, 20, 20), (40, 3, 6, 2), (5, 3, 8, 2),
+    ]:
         x = rng.normal(size=(n, d)).astype(np.float32)
         c = rng.normal(size=(k_max, d)).astype(np.float32)
         try:
@@ -54,19 +59,16 @@ def _check_lloyd(rng) -> int:
             print(f"FAIL lloyd n={n} d={d}: {type(exc).__name__}: {exc}")
             failures += 1
             continue
-        d2 = ((x[:, None, :].astype(np.float64) - c[None, :, :]) ** 2).sum(-1)
-        d2[:, k:] = np.inf
-        labels = d2.argmin(1)
-        ref_counts = np.bincount(labels, minlength=k_max)
-        ref_sums = np.zeros((k_max, d), np.float64)
-        np.add.at(ref_sums, labels, x.astype(np.float64))
-        ok = np.array_equal(counts, ref_counts) and np.allclose(
-            sums, ref_sums, rtol=3e-5, atol=3e-5
+        _, ref_sums, ref_counts, ref_far = _numpy_lloyd(x, c, k, k_max)
+        ok = (
+            np.array_equal(counts, ref_counts)
+            and np.allclose(sums, ref_sums, rtol=3e-5, atol=3e-5)
+            and np.array_equal(far, ref_far)
         )
         if ok:
             print(f"ok   lloyd n={n} d={d} k={k}/{k_max}")
         else:
-            print(f"FAIL lloyd n={n} d={d}: counts/sums mismatch")
+            print(f"FAIL lloyd n={n} d={d}: sums/counts/far mismatch")
             failures += 1
     return failures
 

@@ -2,10 +2,19 @@
 
 The reference's execution backends are joblib threads/processes on one host
 (consensus_clustering_parallelised.py:162-199).  The TPU equivalent is a
-``jax.sharding.Mesh``: the resample axis ``'h'`` is the data-parallel axis
-(each chip owns H/D resamples and partial co-association counts ride ICI via
-``psum``), and the optional ``'n'`` axis shards the N x N consensus matrix
-rows for large-N runs (the long-context analog, SURVEY.md §5.7).
+``jax.sharding.Mesh`` over up to three axes — the three parallel dimensions
+the problem has (SURVEY.md §2.4):
+
+- ``'h'`` (resamples): the data-parallel axis, the reference's only one.
+  Each chip owns H/D resamples; partial co-association counts psum over
+  ICI.
+- ``'n'`` (consensus-matrix rows): shards the N x N matrices for large-N
+  runs (the long-context analog, SURVEY.md §5.7).
+- ``'k'`` (sweep values): the axis the reference runs SEQUENTIALLY
+  (its K loop, consensus_clustering_parallelised.py:112).  Each k-group
+  of chips runs the scan over its own slice of ``k_values``, so a pod
+  divides the sweep wall-clock by ``k_shards`` on top of the h/n
+  parallelism.
 """
 
 from __future__ import annotations
@@ -17,28 +26,38 @@ from jax.sharding import Mesh
 
 RESAMPLE_AXIS = "h"
 ROW_AXIS = "n"
+KSHARD_AXIS = "k"
 
 
 def resample_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     row_shards: int = 1,
+    k_shards: int = 1,
 ) -> Mesh:
-    """Build an ('h', 'n') mesh over the given (default: all) devices.
+    """Build a ('k', 'h', 'n') mesh over the given (default: all) devices.
 
-    ``row_shards`` devices shard consensus-matrix rows; the rest go to the
-    resample axis.  With one device this degenerates to a trivial 1x1 mesh,
-    which is also the single-chip path — there is no separate unsharded code
-    path to keep correct.
+    ``k_shards`` groups split the K sweep; within each group,
+    ``row_shards`` devices shard consensus-matrix rows and the rest go to
+    the resample axis.  With one device this degenerates to a trivial
+    1x1x1 mesh, which is also the single-chip path — there is no separate
+    unsharded code path to keep correct.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
     n_dev = len(devices)
-    if n_dev % row_shards != 0:
+    if k_shards < 1 or row_shards < 1:
         raise ValueError(
-            f"{n_dev} devices not divisible by row_shards={row_shards}"
+            f"k_shards={k_shards} and row_shards={row_shards} must be >= 1"
+        )
+    if n_dev % (row_shards * k_shards) != 0:
+        raise ValueError(
+            f"{n_dev} devices not divisible by "
+            f"k_shards*row_shards={k_shards * row_shards}"
         )
     import numpy as np
 
-    grid = np.asarray(devices).reshape(n_dev // row_shards, row_shards)
-    return Mesh(grid, (RESAMPLE_AXIS, ROW_AXIS))
+    grid = np.asarray(devices).reshape(
+        k_shards, n_dev // (row_shards * k_shards), row_shards
+    )
+    return Mesh(grid, (KSHARD_AXIS, RESAMPLE_AXIS, ROW_AXIS))

@@ -19,7 +19,12 @@ one XLA program:
   the CDF histogram reduces per block before a (bins,)-sized psum — so the
   N=10k..20k configs' O(N^2) HBM cost divides across the mesh,
 - the K sweep is a ``lax.scan`` over a traced K with padded one-hot shapes
-  (static ``k_max``), so the whole sweep costs one compilation,
+  (static ``k_max``), so the whole sweep costs one compilation — and the
+  scan shards over an optional ``'k'`` mesh axis
+  (``resample_mesh(k_shards=s)``): each k-group of chips runs its own
+  slice of ``k_values``, turning the reference's sequential K loop
+  (consensus_clustering_parallelised.py:112) into the outermost parallel
+  dimension,
 - CDF/PAC analysis runs on device; only (bins,)-sized curves (plus the N x N
   matrices if requested) ever reach the host.
 """
@@ -52,6 +57,7 @@ from consensus_clustering_tpu.ops.resample import (
     resample_indices,
 )
 from consensus_clustering_tpu.parallel.mesh import (
+    KSHARD_AXIS,
     RESAMPLE_AXIS,
     ROW_AXIS,
     resample_mesh,
@@ -69,6 +75,12 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
         mesh = resample_mesh([jax.devices()[0]])
     n_h = mesh.shape[RESAMPLE_AXIS]
     n_r = mesh.shape[ROW_AXIS]
+    # Optional third axis: k-groups each run the scan over their own
+    # slice of k_values — the reference's SEQUENTIAL K loop
+    # (consensus_clustering_parallelised.py:112) becomes the outermost
+    # parallel dimension.  Meshes without the axis (pre-'k' callers)
+    # behave as k_shards=1.
+    n_k = dict(mesh.shape).get(KSHARD_AXIS, 1)
 
     n = config.n_samples
     h_total = config.n_iterations
@@ -84,7 +96,15 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     # devices); pad H to a multiple and mark padded rows with indices = -1,
     # which every one-hot builder drops.
     h_pad = -(-h_total // (n_h * n_r)) * (n_h * n_r)
-    k_arr = jnp.asarray(config.k_values, jnp.int32)
+    # Pad the K list to a multiple of the k-groups with repeats of the
+    # last K (always a valid cluster count); padded slots are redundant
+    # compute on the padding groups and are cropped after the shard_map.
+    n_ks = len(config.k_values)
+    k_local = -(-n_ks // n_k)
+    k_values_pad = tuple(config.k_values) + (config.k_values[-1],) * (
+        k_local * n_k - n_ks
+    )
+    k_arr = jnp.asarray(k_values_pad, jnp.int32)
     # Resolve the histogram path NOW, outside the traced program: the
     # kernel-availability probe compiles and runs the Pallas kernel once on
     # the active backend (ops/pallas_hist.py), which must not happen inside
@@ -98,7 +118,7 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     # per-grid-step overhead outweighs the HBM-traffic savings — the XLA
     # Lloyd body is already near the HBM roofline (benchmarks/PERF.md).
 
-    def local_body(x, indices, key_cluster):
+    def local_body(x, indices, key_cluster, k_arr_local):
         """Runs per device.
 
         ``indices`` is this chip's (h_pad / (n_h * n_r), n_sub) resample
@@ -178,18 +198,30 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 out["cij"] = cij
             return 0, out
 
-        _, per_k_out = jax.lax.scan(per_k, 0, k_arr)
+        _, per_k_out = jax.lax.scan(per_k, 0, k_arr_local)
         return per_k_out, iij
 
-    per_k_specs = {"hist": P(), "cdf": P(), "pac_area": P()}
+    # Per-K outputs stack along the scan dim, which is sharded over the
+    # 'k' axis when the mesh has one (each group contributes its K
+    # slice); meshes built before the axis existed fall back to a
+    # replicated leading dim (n_k == 1, same values everywhere).
+    k_axis = KSHARD_AXIS if KSHARD_AXIS in mesh.axis_names else None
+    per_k_specs = {
+        "hist": P(k_axis), "cdf": P(k_axis), "pac_area": P(k_axis),
+    }
     if config.store_matrices:
-        per_k_specs["mij"] = P(None, ROW_AXIS, None)
-        per_k_specs["cij"] = P(None, ROW_AXIS, None)
+        per_k_specs["mij"] = P(k_axis, ROW_AXIS, None)
+        per_k_specs["cij"] = P(k_axis, ROW_AXIS, None)
 
     sharded_body = shard_map(
         local_body,
         mesh=mesh,
-        in_specs=(P(), P((RESAMPLE_AXIS, ROW_AXIS)), P()),
+        in_specs=(
+            P(),
+            P((RESAMPLE_AXIS, ROW_AXIS)),
+            P(),
+            P(k_axis),
+        ),
         out_specs=(per_k_specs, P(ROW_AXIS, None)),
         check_vma=False,
     )
@@ -206,8 +238,10 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                     jnp.full((h_pad - h_total, n_sub), -1, jnp.int32),
                 ]
             )
-        per_k_out, iij = sharded_body(x, indices, key_cluster)
-        # Crop row/column padding introduced by the 'n'-axis block layout.
+        per_k_out, iij = sharded_body(x, indices, key_cluster, k_arr)
+        # Crop K padding from the k-group layout, then row/column padding
+        # introduced by the 'n'-axis block layout.
+        per_k_out = {k: v[:n_ks] for k, v in per_k_out.items()}
         per_k_out["iij"] = iij[:n, :n]
         if config.store_matrices:
             per_k_out["mij"] = per_k_out["mij"][:, :n, :n]

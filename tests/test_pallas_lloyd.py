@@ -34,7 +34,9 @@ def _numpy_lloyd(x, c, k, k_max):
     far = np.zeros(k_max, np.int64)
     for b in range(k_max):
         idx = np.arange(n)[np.arange(n) % k_max == b]
-        far[b] = idx[np.argmax(d_min[idx])] if idx.size else 0
+        # Empty buckets (only when n < k_max) clamp to n-1 on BOTH real
+        # paths (XLA bucket_far_points and the kernel's -inf fixup).
+        far[b] = idx[np.argmax(d_min[idx])] if idx.size else n - 1
     return labels, sums, counts, far
 
 

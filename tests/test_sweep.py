@@ -163,6 +163,54 @@ class TestSweepSharded:
         )
 
 
+class TestKShardedSweep:
+    @pytest.mark.parametrize(
+        "k_shards,h_shards,row_shards", [(2, 4, 1), (2, 2, 2), (4, 2, 1)]
+    )
+    def test_k_sharding_invariance(self, blobs, k_shards, h_shards, row_shards):
+        # The K sweep sharded over the 'k' mesh axis (each k-group runs
+        # its slice of k_values) must be bit-identical to the 1-device
+        # run, for every (k, h, n) mesh factorisation.
+        x, _ = blobs
+        config = _sweep_config(x, n_iterations=16)
+        km = KMeans(n_init=2)
+        ref = run_sweep(
+            km, config, x, seed=5, mesh=resample_mesh(jax.devices()[:1])
+        )
+        mesh = resample_mesh(
+            jax.devices()[: k_shards * h_shards * row_shards],
+            row_shards=row_shards, k_shards=k_shards,
+        )
+        sharded = run_sweep(km, config, x, seed=5, mesh=mesh)
+        np.testing.assert_array_equal(ref["iij"], sharded["iij"])
+        np.testing.assert_array_equal(ref["mij"], sharded["mij"])
+        np.testing.assert_array_equal(ref["cij"], sharded["cij"])
+        np.testing.assert_array_equal(ref["hist"], sharded["hist"])
+        np.testing.assert_array_equal(ref["cdf"], sharded["cdf"])
+        np.testing.assert_array_equal(ref["pac_area"], sharded["pac_area"])
+
+    def test_k_padding_when_groups_exceed_k_values(self, blobs):
+        # 3 K values over 8 k-groups: padded K slots (repeats of the last
+        # K) are redundant compute, cropped from every per-K output.
+        x, _ = blobs
+        config = _sweep_config(x, n_iterations=9)
+        km = KMeans(n_init=2)
+        ref = run_sweep(
+            km, config, x, seed=4, mesh=resample_mesh(jax.devices()[:1])
+        )
+        sharded = run_sweep(
+            km, config, x, seed=4, mesh=resample_mesh(k_shards=8)
+        )
+        assert sharded["pac_area"].shape == ref["pac_area"].shape
+        assert sharded["mij"].shape == ref["mij"].shape
+        np.testing.assert_array_equal(ref["mij"], sharded["mij"])
+        np.testing.assert_array_equal(ref["pac_area"], sharded["pac_area"])
+
+    def test_mesh_rejects_indivisible_k_shards(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            resample_mesh(jax.devices(), k_shards=3)
+
+
 class TestSweepConfigValidation:
     def test_rejects_bad_subsampling(self):
         with pytest.raises(ValueError):
