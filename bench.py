@@ -124,30 +124,51 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
 
-    # Fail fast if backend init hangs (e.g. a wedged TPU tunnel): a clear
-    # error beats an indefinite hang under the driver.  Compile/run time is
-    # NOT under this watchdog — only device discovery.
+    # Make JAX_PLATFORMS from the environment stick (the accelerator
+    # sitecustomize sets jax_platforms programmatically, which silently
+    # overrides the env var — JAX_PLATFORMS=cpu python bench.py would
+    # otherwise still dial the tunnel).
+    want_platform = os.environ.get("JAX_PLATFORMS")
+    if want_platform:
+        import jax
+
+        jax.config.update("jax_platforms", want_platform)
+
+    # Two watchdogs: a shared TPU tunnel can hang at device discovery OR
+    # wedge mid-run (observed: a killed client leaves the remote claim
+    # stuck and subsequent device ops block forever).  A bounded failure
+    # with a clear message beats hanging the driver either way.
     import threading
 
-    try:
-        init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
-    except ValueError:
-        init_timeout = 240.0
-    ready = threading.Event()
+    def _arm_watchdog(env_var, default, message, exit_code):
+        """Daemon thread that os._exit(exit_code)s unless the returned
+        event is set within the env-configured timeout (<= 0 disables)."""
+        try:
+            timeout = float(os.environ.get(env_var, str(default)))
+        except ValueError:
+            timeout = float(default)
+        event = threading.Event()
 
-    def _watchdog():
-        if not ready.wait(timeout=init_timeout):
-            import sys
+        def _watch():
+            if not event.wait(timeout=timeout):
+                import sys
 
-            print(
-                f"bench: backend init exceeded {init_timeout:.0f}s "
-                "(tunnel wedged?); aborting",
-                file=sys.stderr, flush=True,
-            )
-            os._exit(3)
+                print(
+                    f"bench: {message} after {timeout:.0f}s; aborting",
+                    file=sys.stderr, flush=True,
+                )
+                os._exit(exit_code)
 
-    if init_timeout > 0:  # <= 0 disables the watchdog
-        threading.Thread(target=_watchdog, daemon=True).start()
+        if timeout > 0:
+            threading.Thread(target=_watch, daemon=True).start()
+        return event
+
+    ready = _arm_watchdog(
+        "BENCH_INIT_TIMEOUT", 240, "backend init hung (tunnel wedged?)", 3
+    )
+    done = _arm_watchdog(
+        "BENCH_TOTAL_TIMEOUT", 1800, "run wedged mid-flight", 4
+    )
 
     import jax
 
@@ -203,6 +224,7 @@ def main(argv=None):
     static_total = out["timing"].get("compiled_memory", {}).get("total_bytes")
     if static_total:
         record["compiled_memory_bytes"] = static_total
+    done.set()
     print(json.dumps(record))
 
 

@@ -39,8 +39,9 @@ def _check_lloyd(rng) -> int:
         lloyd_step, pad_points,
     )
     # The unit suite's reference implementation — same contract, one copy
-    # (it covers sums, counts AND the relocation candidates).
-    from test_pallas_lloyd import _numpy_lloyd
+    # (it covers sums, counts AND the relocation candidates).  Lives in
+    # the pytest-free oracle module so this script has no test deps.
+    from oracle import oracle_lloyd_step as _numpy_lloyd
 
     failures = 0
     for n, d, k_max, k in [

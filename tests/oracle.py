@@ -72,3 +72,26 @@ def oracle_block_hist_counts(
         np.asarray(cij)[mask], bins=bins, range=(0.0, 1.0)
     )
     return counts
+
+
+def oracle_lloyd_step(x, c, k, k_max):
+    """One Lloyd step in f64: labels, per-slot sums/counts, relocation picks.
+
+    The shared reference for the fused Pallas Lloyd kernel
+    (ops/pallas_lloyd.py) used by both the unit suite and the on-hardware
+    gate.  Empty buckets (only when n < k_max) clamp to n-1, matching both
+    real paths (XLA bucket_far_points and the kernel's -inf fixup).
+    """
+    n = x.shape[0]
+    d2 = ((x[:, None, :].astype(np.float64) - c[None, :, :]) ** 2).sum(-1)
+    d2[:, k:] = np.inf
+    labels = d2.argmin(1)
+    counts = np.bincount(labels, minlength=k_max).astype(np.float64)
+    sums = np.zeros((k_max, x.shape[1]), np.float64)
+    np.add.at(sums, labels, x.astype(np.float64))
+    d_min = np.maximum(d2.min(1), 0.0)
+    far = np.zeros(k_max, np.int64)
+    for b in range(k_max):
+        idx = np.arange(n)[np.arange(n) % k_max == b]
+        far[b] = idx[np.argmax(d_min[idx])] if idx.size else n - 1
+    return labels, sums, counts, far

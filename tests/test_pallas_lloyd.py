@@ -21,23 +21,7 @@ from consensus_clustering_tpu.ops.pallas_lloyd import (
 )
 
 
-def _numpy_lloyd(x, c, k, k_max):
-    """Reference: assignment, sums, counts, per-bucket relocation picks."""
-    n = x.shape[0]
-    d2 = ((x[:, None, :].astype(np.float64) - c[None, :, :]) ** 2).sum(-1)
-    d2[:, k:] = np.inf
-    labels = d2.argmin(1)
-    counts = np.bincount(labels, minlength=k_max).astype(np.float64)
-    sums = np.zeros((k_max, x.shape[1]), np.float64)
-    np.add.at(sums, labels, x.astype(np.float64))
-    d_min = np.maximum(d2.min(1), 0.0)
-    far = np.zeros(k_max, np.int64)
-    for b in range(k_max):
-        idx = np.arange(n)[np.arange(n) % k_max == b]
-        # Empty buckets (only when n < k_max) clamp to n-1 on BOTH real
-        # paths (XLA bucket_far_points and the kernel's -inf fixup).
-        far[b] = idx[np.argmax(d_min[idx])] if idx.size else n - 1
-    return labels, sums, counts, far
+from oracle import oracle_lloyd_step as _numpy_lloyd
 
 
 class TestLloydStepKernel:
