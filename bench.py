@@ -124,15 +124,9 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
 
-    # Make JAX_PLATFORMS from the environment stick (the accelerator
-    # sitecustomize sets jax_platforms programmatically, which silently
-    # overrides the env var — JAX_PLATFORMS=cpu python bench.py would
-    # otherwise still dial the tunnel).
-    want_platform = os.environ.get("JAX_PLATFORMS")
-    if want_platform:
-        import jax
+    from consensus_clustering_tpu.utils.platform import pin_platform_from_env
 
-        jax.config.update("jax_platforms", want_platform)
+    pin_platform_from_env()
 
     # Two watchdogs: a shared TPU tunnel can hang at device discovery OR
     # wedge mid-run (observed: a killed client leaves the remote claim

@@ -15,26 +15,8 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 import time
-
-
-def _honor_platform_env() -> None:
-    """Make ``JAX_PLATFORMS`` from the environment stick.
-
-    Some deployments register accelerator plugins from a sitecustomize
-    that sets ``jax_platforms`` programmatically, silently overriding the
-    env var — so ``JAX_PLATFORMS=cpu python -m consensus_clustering_tpu``
-    would still try to initialise the accelerator (and hang if it is
-    unreachable).  Pin the config back to whatever the environment asked
-    for before any backend initialises.
-    """
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        import jax
-
-        jax.config.update("jax_platforms", want)
 
 
 def _parse_k(spec: str):
@@ -151,7 +133,9 @@ def cmd_bench(args):
 
 
 def main(argv=None):
-    _honor_platform_env()
+    from consensus_clustering_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
     parser = argparse.ArgumentParser(
         prog="consensus_clustering_tpu",
         description="TPU-native consensus clustering",

@@ -1,0 +1,28 @@
+"""Backend-selection helper for entry points.
+
+Some deployments register accelerator plugins from a ``sitecustomize``
+that sets ``jax_platforms`` programmatically, which silently overrides the
+``JAX_PLATFORMS`` environment variable — so ``JAX_PLATFORMS=cpu python
+<anything>`` would still try to initialise the accelerator (and hang if
+its transport is unreachable).  Every entry point (bench.py, the CLI,
+examples, the driver graft) calls :func:`pin_platform_from_env` before any
+backend initialises so the env var means what it says.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    """Make ``JAX_PLATFORMS`` from the environment stick.
+
+    No-op when the variable is unset (the deployment default — e.g. the
+    plugin-registered accelerator — stays in charge).  Safe to call
+    repeatedly; must run before the first device query of the process.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)

@@ -48,6 +48,18 @@ def test_gmm_sharded_matches_single_device(blobs):
     np.testing.assert_array_equal(
         a.cdf_at_K_data[2]["mij"], b.cdf_at_K_data[2]["mij"]
     )
+    # And through the full 3-axis ('k', 'h', 'n') mesh: the plugin
+    # clusterers run inside the k-sharded scan like the native KMeans.
+    c = ConsensusClustering(
+        mesh=resample_mesh(row_shards=2, k_shards=2), **common
+    ).fit(x)
+    np.testing.assert_array_equal(
+        a.cdf_at_K_data[2]["mij"], c.cdf_at_K_data[2]["mij"]
+    )
+    np.testing.assert_array_equal(
+        [a.cdf_at_K_data[k]["pac_area"] for k in (2, 3)],
+        [c.cdf_at_K_data[k]["pac_area"] for k in (2, 3)],
+    )
 
 
 def test_gmm_parity_native_vs_sklearn_wellposed():
