@@ -13,8 +13,9 @@ Prints exactly one JSON line:
   {"metric": ..., "value": <resamples/sec>, "unit": "resamples/sec",
    "vs_baseline": <speedup>, ...}
 
-The other BASELINE.json configs run via --config (corr / blobs10k / agglo /
-spectral); shapes scaled down to one chip are marked in the metric string.
+The other BASELINE.json configs run via --config (corr / blobs10k /
+blobs20k / agglo / spectral); shapes scaled down to one chip are marked in
+the metric string.
 """
 
 import argparse
@@ -76,6 +77,22 @@ def _build(config_name, small):
         )
         return (KMeans(n_init=3), cfg, x,
                 f"large-N blobs N={n} KMeans H={h} K=2..20", False)
+    if config_name == "blobs20k":
+        # BASELINE config #5's N (20000) with the KMeans hot path on ONE
+        # chip: validates the O(N^2) row-block accumulation + O(tile)
+        # histogram at the largest baseline scale (SURVEY.md §7.3).  The
+        # full H=2000/K<=30 shape assumes a pod; H is scaled to keep the
+        # single-chip run bounded.  store_matrices=False keeps every
+        # N x N array on device — only the (bins,) curves come home.
+        n, h, k_hi = (2000, 20, 5) if small else (20000, 100, 10)
+        x = _blobs(n, 50)
+        cfg = SweepConfig(
+            n_samples=n, n_features=50, k_values=tuple(range(2, k_hi + 1)),
+            n_iterations=h, store_matrices=False, chunk_size=4,
+        )
+        return (KMeans(n_init=3), cfg, x,
+                f"large-N blobs N={n} KMeans H={h} K=2..{k_hi} [scaled H]",
+                False)
     if config_name == "agglo":
         # BASELINE config #4: agglomerative inner clusterer on corr, H=500.
         x = load_corr(transform=True)
@@ -107,7 +124,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--config", default="headline",
-        choices=["headline", "corr", "blobs10k", "agglo", "spectral"],
+        choices=[
+            "headline", "corr", "blobs10k", "blobs20k", "agglo", "spectral",
+        ],
     )
     parser.add_argument(
         "--small", action="store_true",

@@ -30,9 +30,10 @@ class SweepConfig:
       pac_interval: (u1, u2) for the PAC score (reference ``PAC_interval``).
       parity_zeros: reproduce the reference's zero-inflated histogram
         (quirk Q6); False gives the corrected pairs-only density.
-      store_matrices: keep per-K Mij/Cij in the result (the reference always
-        does; for large N these are the dominant HBM/host cost, so the
-        facade may auto-disable).
+      store_matrices: keep Iij and per-K Mij/Cij in the result (the
+        reference always does; for large N these are the dominant HBM /
+        host-transfer cost, so the facade may auto-disable).  When False,
+        only the (bins,)-sized curves ever leave the device.
       chunk_size: resamples per accumulation GEMM (see ops.coassoc).
       reseed_clusterer_per_resample: False (default) re-seeds the inner
         clusterer identically for every resample — the reference's semantics

@@ -125,7 +125,10 @@ def run_host_sweep(
 
     result = {name: np.stack(vals) for name, vals in out.items()}
     result["pac_area"] = np.asarray(out["pac_area"], np.float32)
-    result["iij"] = np.asarray(iij_dev)
+    if config.store_matrices:
+        # Same schema as the device path: without store_matrices no N x N
+        # array is returned (or copied off device).
+        result["iij"] = np.asarray(iij_dev)
     elapsed = time.perf_counter() - t0
     total = config.n_iterations * len(config.k_values)
     result["timing"] = {

@@ -68,8 +68,12 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     """Return a jitted ``sweep(x, key) -> dict`` over the given mesh.
 
     The returned callable computes, for every K in ``config.k_values``:
-    ``pac_area`` (nK,), ``hist``/``cdf`` (nK, bins), plus ``iij`` (N, N) and,
-    if ``config.store_matrices``, stacked ``mij``/``cij`` (nK, N, N).
+    ``pac_area`` (nK,), ``hist``/``cdf`` (nK, bins), plus — only when
+    ``config.store_matrices`` — ``iij`` (N, N) and stacked ``mij``/``cij``
+    (nK, N, N).  Without the flag no N x N array leaves the device: at
+    N=20000 the ``iij`` device->host copy alone is 1.6 GB, which through a
+    tunnelled PJRT backend costs ~60 s — an order of magnitude more than
+    the whole curves-only sweep it would ride along with.
     """
     if mesh is None:
         mesh = resample_mesh([jax.devices()[0]])
@@ -242,8 +246,8 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
         # Crop K padding from the k-group layout, then row/column padding
         # introduced by the 'n'-axis block layout.
         per_k_out = {k: v[:n_ks] for k, v in per_k_out.items()}
-        per_k_out["iij"] = iij[:n, :n]
         if config.store_matrices:
+            per_k_out["iij"] = iij[:n, :n]
             per_k_out["mij"] = per_k_out["mij"][:, :n, :n]
             per_k_out["cij"] = per_k_out["cij"][:, :n, :n]
         return per_k_out

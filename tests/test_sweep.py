@@ -77,7 +77,7 @@ class TestSweepSingleDevice:
         x, _ = blobs
         config = _sweep_config(x, store_matrices=False)
         out = run_sweep(KMeans(), config, x, seed=0)
-        assert "mij" not in out and "cij" not in out
+        assert "mij" not in out and "cij" not in out and "iij" not in out
         assert out["pac_area"].shape == (3,)
 
     def test_deterministic(self, blobs):
