@@ -1,9 +1,15 @@
-"""CDF figure: information parity with the reference figure semantics
-(consensus_clustering_parallelised.py:389-410) under an owned visual design."""
+"""Consensus figures: CDF (information parity with the reference figure
+semantics, consensus_clustering_parallelised.py:389-410, under an owned
+visual design), Δ(K) elbow and consensus-matrix heatmap (no reference
+analog — the reference stores the ingredients but never draws them)."""
 
 import numpy as np
 
-from consensus_clustering_tpu.utils.plotting import plot_cdf
+from consensus_clustering_tpu.utils.plotting import (
+    plot_cdf,
+    plot_consensus_matrix,
+    plot_delta_k,
+)
 
 
 def _fake_data(ks, bins=20):
@@ -55,3 +61,50 @@ class TestPlotCdf:
         lines = fig.axes[0].get_lines()
         lum = [sum(line.get_color()[:3]) for line in lines]
         assert lum[0] > lum[1] > lum[2]
+
+
+class TestPlotDeltaK:
+    def test_two_panels_with_computed_deltas(self, tmp_path):
+        ks = [2, 3, 4, 5, 6]
+        areas = [0.10, 0.30, 0.42, 0.45, 0.46]
+        fig = plot_delta_k(
+            ks, areas, show=False, save_path=str(tmp_path / "dk.png"),
+        )
+        assert len(fig.axes) == 2
+        (xa, ya), (xd, yd) = (ax.get_lines()[0].get_data() for ax in fig.axes)
+        np.testing.assert_array_equal(xa, ks)
+        np.testing.assert_allclose(ya, areas)
+        # Deltas computed per Monti when omitted: first entry is A(K_min).
+        from consensus_clustering_tpu.ops.analysis import delta_k
+
+        np.testing.assert_allclose(yd, delta_k(np.asarray(areas)))
+        assert (tmp_path / "dk.png").exists()
+
+    def test_explicit_deltas_pass_through(self):
+        deltas = [0.5, 0.2, 0.1]
+        fig = plot_delta_k([2, 3, 4], [0.5, 0.6, 0.66], deltas, show=False)
+        _, yd = fig.axes[1].get_lines()[0].get_data()
+        np.testing.assert_allclose(yd, deltas)
+
+
+class TestPlotConsensusMatrix:
+    def test_label_ordering_makes_blocks(self, tmp_path):
+        # Two perfect clusters interleaved in input order: after the stable
+        # label sort the image must be a 2x2 block matrix.
+        labels = np.array([0, 1, 0, 1, 0, 1])
+        cij = (labels[:, None] == labels[None, :]).astype(float)
+        fig = plot_consensus_matrix(
+            cij, labels, show=False, save_path=str(tmp_path / "cm.png"),
+        )
+        img = fig.axes[0].get_images()[0].get_array()
+        expect = np.zeros((6, 6))
+        expect[:3, :3] = expect[3:, 3:] = 1.0
+        np.testing.assert_array_equal(np.asarray(img), expect)
+        assert (tmp_path / "cm.png").exists()
+
+    def test_unordered_when_labels_omitted(self):
+        rng = np.random.default_rng(0)
+        cij = rng.random((5, 5))
+        fig = plot_consensus_matrix(cij, show=False)
+        img = np.asarray(fig.axes[0].get_images()[0].get_array())
+        np.testing.assert_array_equal(img, cij)

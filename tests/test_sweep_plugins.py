@@ -152,3 +152,27 @@ def test_host_backend_n_jobs_parity(blobs):
             serial.cdf_at_K_data[k]["pac_area"]
             == threaded.cdf_at_K_data[k]["pac_area"]
         )
+
+
+def test_host_backend_store_matrices_false_omits_matrices(blobs):
+    # Same schema contract as the device path (tests/test_sweep.py):
+    # without store_matrices no N x N array is returned by the host
+    # backend either — iij included.
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.sklearn_adapter import (
+        SklearnClusterer,
+    )
+    from consensus_clustering_tpu.parallel.host import run_host_sweep
+    from sklearn.cluster import KMeans as SkKMeans
+
+    x, _ = blobs
+    config = SweepConfig(
+        n_samples=x.shape[0], n_features=x.shape[1], k_values=(2, 3),
+        n_iterations=6, store_matrices=False,
+    )
+    out = run_host_sweep(
+        SklearnClusterer(SkKMeans(n_init=2)), config,
+        x, seed=0, progress=False,
+    )
+    assert "iij" not in out and "mij" not in out and "cij" not in out
+    assert out["pac_area"].shape == (2,)
