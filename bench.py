@@ -241,5 +241,54 @@ def main(argv=None):
     print(json.dumps(record))
 
 
+def _supervise() -> int:
+    """Run the bench in a child process, retrying on watchdog exits.
+
+    A wedged TPU tunnel (a killed client leaves the remote claim stuck)
+    poisons the whole process — the watchdogs in :func:`main` turn the
+    hang into rc=3/4, but only a FRESH process can try again.  The
+    driver invokes ``python bench.py`` exactly once per round, so this
+    wrapper is what stands between one transient wedge and a round with
+    no benchmark record at all.  Watchdog exits retry (bounded, with a
+    pause for the stale claim to expire); any other rc — including 0 —
+    passes straight through, as does every byte of the child's output.
+    """
+    import subprocess
+    import sys
+    import time
+
+    try:
+        attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "3")))
+    except ValueError:
+        attempts = 3
+    try:
+        retry_pause = float(os.environ.get("BENCH_RETRY_PAUSE", "120"))
+    except ValueError:
+        retry_pause = 120.0
+    env = dict(os.environ, BENCH_SUPERVISED="1")
+    rc = 0
+    for attempt in range(attempts):
+        rc = subprocess.call([sys.executable, __file__] + sys.argv[1:],
+                             env=env)
+        if rc < 0:
+            # Child died on a signal: report the conventional 128+signum
+            # (SystemExit(-9) would exit 247, masking the SIGKILL).
+            return 128 - rc
+        if rc not in (3, 4):
+            return rc
+        if attempt < attempts - 1:
+            print(
+                f"bench: watchdog exit rc={rc} (attempt {attempt + 1}/"
+                f"{attempts}); retrying in {retry_pause:.0f}s with a "
+                "fresh process",
+                file=sys.stderr, flush=True,
+            )
+            time.sleep(retry_pause)
+    return rc
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_SUPERVISED"):
+        main()
+    else:
+        raise SystemExit(_supervise())
