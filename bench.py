@@ -262,7 +262,9 @@ def _supervise() -> int:
     except ValueError:
         attempts = 3
     try:
-        retry_pause = float(os.environ.get("BENCH_RETRY_PAUSE", "120"))
+        retry_pause = max(
+            0.0, float(os.environ.get("BENCH_RETRY_PAUSE", "120"))
+        )
     except ValueError:
         retry_pause = 120.0
     env = dict(os.environ, BENCH_SUPERVISED="1")

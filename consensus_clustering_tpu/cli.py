@@ -81,6 +81,13 @@ def cmd_run(args):
     from consensus_clustering_tpu.api import ConsensusClustering
 
     x = _load_dataset(args.dataset, args.n_samples, args.n_features, args.seed)
+    # The heatmap needs Cij, so --plot-dir implies keeping matrices
+    # unless they were explicitly switched off — in which case only the
+    # curve figures are written.  Labels for ordering the heatmap are
+    # extracted lazily for the best K alone (consensus_labels_from_cij),
+    # not computed per swept K.
+    store_matrices = {"on": True, "off": False}[args.store_matrices] \
+        if args.store_matrices != "auto" else bool(args.plot_dir)
     cc = ConsensusClustering(
         clusterer=_make_clusterer(args.clusterer),
         clusterer_options={} if args.clusterer != "kmeans" else {"n_init": 3},
@@ -89,7 +96,7 @@ def cmd_run(args):
         subsampling=args.subsampling,
         random_state=args.seed,
         plot_cdf=False,
-        store_matrices=False,
+        store_matrices=store_matrices,
         checkpoint_dir=args.checkpoint_dir,
         compute_consensus_labels=False,
         profile_dir=args.profile_dir,
@@ -106,7 +113,9 @@ def cmd_run(args):
         "dataset": args.dataset,
         "shape": list(x.shape),
         "clusterer": args.clusterer,
-        "K": sorted(cc.cdf_at_K_data),
+        # Constructor order (not sorted): "areas"/"delta_k" are parallel
+        # arrays and a comma --k list may be unsorted.
+        "K": [int(k) for k in cc.K_range],
         "pac_area": {k: v["pac_area"] for k, v in cc.cdf_at_K_data.items()},
         "areas": cc.areas_.tolist(),
         "delta_k": cc.delta_k_.tolist(),
@@ -121,6 +130,56 @@ def cmd_run(args):
         print(f"best_k={cc.best_k_}  -> {args.out}")
     else:
         print(payload)
+
+    # After the JSON: a plotting failure (missing matplotlib extra,
+    # unwritable dir) must not discard a completed sweep's results.
+    if args.plot_dir:
+        _write_figures(cc, args.plot_dir)
+
+
+def _write_figures(cc, plot_dir: str) -> None:
+    """Save the CDF fan, the Δ(K) elbow and — when Cij was kept — the
+    best-K consensus-matrix heatmap into ``plot_dir``."""
+    import os
+
+    from consensus_clustering_tpu.utils.plotting import (
+        plot_cdf,
+        plot_consensus_matrix,
+        plot_delta_k,
+    )
+
+    os.makedirs(plot_dir, exist_ok=True)
+    plot_cdf(
+        cc.cdf_at_K_data, pac_interval=cc.PAC_interval, show=False,
+        save_path=os.path.join(plot_dir, "cdf.png"),
+    )
+    # areas_/delta_k_ follow the constructor's K_range order, which a
+    # comma --k list may leave unsorted: keep x and y aligned.
+    plot_delta_k(
+        list(cc.K_range), cc.areas_, cc.delta_k_, show=False,
+        save_path=os.path.join(plot_dir, "delta_k.png"),
+    )
+    best = cc.cdf_at_K_data[cc.best_k_]
+    if best.get("cij") is not None:
+        from consensus_clustering_tpu.models.agglomerative import (
+            consensus_labels_from_cij,
+        )
+
+        # Best-K labels only (one agglomeration), not per swept K.
+        labels = best["consensus_labels"]
+        if not len(labels):
+            labels = consensus_labels_from_cij(
+                best["cij"], cc.best_k_,
+                linkage=cc.agg_clustering_linkage,
+            )
+        plot_consensus_matrix(
+            best["cij"],
+            labels,
+            show=False,
+            save_path=os.path.join(
+                plot_dir, f"consensus_matrix_K{cc.best_k_}.png"
+            ),
+        )
 
 
 def cmd_bench(args):
@@ -168,6 +227,13 @@ def main(argv=None):
     run.add_argument("--k-batch-size", type=int, default=None,
                      help="compile/run the sweep in batches of this many "
                           "K values, checkpointing after each")
+    run.add_argument("--store-matrices", choices=["auto", "on", "off"],
+                     default="auto",
+                     help="keep Iij/Mij/Cij in results (auto: only when "
+                     "--plot-dir needs the heatmap)")
+    run.add_argument("--plot-dir", default=None,
+                     help="write cdf.png, delta_k.png and (with matrices) "
+                     "the best-K consensus-matrix heatmap here")
     run.add_argument("--out", default=None)
     run.set_defaults(fn=cmd_run)
 

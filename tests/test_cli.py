@@ -34,3 +34,32 @@ class TestCli:
 
         with pytest.raises(SystemExit):
             main(["run", "--clusterer", "nope", "--k", "2:3"])
+
+    def test_plot_dir_writes_figures(self, tmp_path):
+        import pytest
+
+        pytest.importorskip("matplotlib")
+        plots = tmp_path / "figs"
+        main([
+            "run", "--dataset", "corr", "--k", "2:3",
+            "--iterations", "6", "--seed", "3",
+            "--plot-dir", str(plots),
+            "--out", str(tmp_path / "r.json"),
+        ])
+        names = {p.name for p in plots.iterdir()}
+        assert "cdf.png" in names and "delta_k.png" in names
+        assert any(n.startswith("consensus_matrix_K") for n in names)
+
+    def test_plot_dir_without_matrices_skips_heatmap(self, tmp_path):
+        import pytest
+
+        pytest.importorskip("matplotlib")
+        plots = tmp_path / "figs"
+        main([
+            "run", "--dataset", "corr", "--k", "2:3",
+            "--iterations", "6", "--seed", "3",
+            "--store-matrices", "off", "--plot-dir", str(plots),
+            "--out", str(tmp_path / "r.json"),
+        ])
+        names = {p.name for p in plots.iterdir()}
+        assert names == {"cdf.png", "delta_k.png"}
