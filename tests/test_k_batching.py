@@ -5,6 +5,16 @@ import numpy as np
 from consensus_clustering_tpu import ConsensusClustering
 
 
+def _assert_parity(whole, batched):
+    # Same resample plan per K (quirk Q8 holds across batches), so
+    # counts are bit-identical however the sweep was split or sharded.
+    for k in (2, 3, 4, 5):
+        a, b = whole.cdf_at_K_data[k], batched.cdf_at_K_data[k]
+        np.testing.assert_array_equal(a["mij"], b["mij"])
+        np.testing.assert_array_equal(a["iij"], b["iij"])
+        assert a["pac_area"] == b["pac_area"]
+
+
 def _fit(x, **kw):
     cc = ConsensusClustering(
         K_range=(2, 3, 4, 5), n_iterations=10, random_state=3,
@@ -19,13 +29,7 @@ class TestKBatching:
         x, _ = blobs
         whole = _fit(x)
         batched = _fit(x, k_batch_size=2)
-        for k in (2, 3, 4, 5):
-            a, b = whole.cdf_at_K_data[k], batched.cdf_at_K_data[k]
-            # Same resample plan per K (quirk Q8 holds across batches),
-            # so counts are bit-identical.
-            np.testing.assert_array_equal(a["mij"], b["mij"])
-            np.testing.assert_array_equal(a["iij"], b["iij"])
-            assert a["pac_area"] == b["pac_area"]
+        _assert_parity(whole, batched)
         assert batched.metrics_["n_batches"] == 2
         assert batched.best_k_ == whole.best_k_
 
@@ -73,3 +77,19 @@ class TestKBatching:
 
         with pytest.raises(ValueError):
             ConsensusClustering(k_batch_size=0)
+
+    def test_k_batches_on_three_axis_mesh(self, blobs):
+        # Composition not covered elsewhere: each k-batch compiles its
+        # own sweep over a mesh that ALSO shards K (plus resamples and
+        # rows).  Batch 2's chunk (5,) has fewer Ks than the 2 k-groups,
+        # exercising the repeat-padding path inside a batched fit.
+        import jax
+
+        from consensus_clustering_tpu.parallel.mesh import resample_mesh
+
+        x, _ = blobs
+        mesh = resample_mesh(jax.devices()[:8], row_shards=2, k_shards=2)
+        whole = _fit(x)
+        batched = _fit(x, k_batch_size=3, mesh=mesh)
+        _assert_parity(whole, batched)
+        assert batched.metrics_["n_batches"] == 2
