@@ -3,11 +3,13 @@
 Headline config (the default, what the driver records) is BASELINE.json #2:
 make_blobs N=5000 d=50, KMeans(n_init=3) inner clusterer, H=500 resamples,
 K in [2, 20] — run as ONE compiled XLA program on the available device(s).
-The CPU baseline (benchmarks/baseline_cpu.json) was measured by running the
-actual reference implementation on this machine (serially: single-core box,
-and n_jobs=1 is the reference's only race-free mode), steady-state
-resamples/sec per K, extrapolated linearly in H (per-resample work is
-H-independent).
+CPU baselines were measured by running the actual reference implementation
+on this machine (serially: single-core box, and n_jobs=1 is the
+reference's only race-free mode), extrapolated linearly in H
+(per-resample work is H-independent): per-config rates live in
+benchmarks/baseline_cpu_configs.json (headline per-K details in
+baseline_cpu.json), and vs_baseline is reported for every run whose
+shape matches its measured baseline.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": <resamples/sec>, "unit": "resamples/sec",
@@ -35,7 +37,16 @@ def _blobs(n, d, seed=0):
 
 
 def _build(config_name, small):
-    """Returns (clusterer, SweepConfig, x, metric string, is_headline)."""
+    """Returns (clusterer, SweepConfig, x, metric string, baseline_key).
+
+    ``baseline_key`` names this run's entry in
+    ``benchmarks/baseline_cpu_configs.json`` (reference implementation,
+    serial CPU, measured at the same shape) — or None when the shapes
+    differ from the measured ones (``--small`` variants of configs that
+    actually shrink) or no reference run exists (blobs10k/blobs20k:
+    days of serial CPU at those N).  corr and agglo ignore ``small`` —
+    their shapes are fixed — so their baselines apply on any backend.
+    """
     from consensus_clustering_tpu.config import SweepConfig
     from consensus_clustering_tpu.data import load_corr
     from consensus_clustering_tpu.models.agglomerative import (
@@ -56,7 +67,7 @@ def _build(config_name, small):
             n_iterations=h, store_matrices=False, chunk_size=4,
         )
         # KMeans(n_init=3) mirrors the reference's default clusterer_options.
-        return KMeans(n_init=3), cfg, x, metric, not small
+        return KMeans(n_init=3), cfg, x, metric, "headline" if not small else None
     if config_name == "corr":
         # BASELINE config #1: bundled dataset, H=100, k in [2, 10].
         x = load_corr(transform=True)
@@ -66,7 +77,7 @@ def _build(config_name, small):
             store_matrices=False,
         )
         return (KMeans(n_init=3), cfg, x,
-                "corr.csv KMeans H=100 K=2..10", False)
+                "corr.csv KMeans H=100 K=2..10", "corr")
     if config_name == "blobs10k":
         # BASELINE config #3 (large-N consensus matrix): N=10000, H=1000.
         n, h = (1000, 100) if small else (10000, 1000)
@@ -76,7 +87,7 @@ def _build(config_name, small):
             n_iterations=h, store_matrices=False, chunk_size=8,
         )
         return (KMeans(n_init=3), cfg, x,
-                f"large-N blobs N={n} KMeans H={h} K=2..20", False)
+                f"large-N blobs N={n} KMeans H={h} K=2..20", None)
     if config_name == "blobs20k":
         # BASELINE config #5's N (20000) with the KMeans hot path on ONE
         # chip: validates the O(N^2) row-block accumulation + O(tile)
@@ -92,7 +103,7 @@ def _build(config_name, small):
         )
         return (KMeans(n_init=3), cfg, x,
                 f"large-N blobs N={n} KMeans H={h} K=2..{k_hi} [scaled H]",
-                False)
+                None)
     if config_name == "agglo":
         # BASELINE config #4: agglomerative inner clusterer on corr, H=500.
         x = load_corr(transform=True)
@@ -102,7 +113,7 @@ def _build(config_name, small):
             store_matrices=False,
         )
         return (AgglomerativeClustering(linkage="average"), cfg, x,
-                "corr.csv Agglomerative H=500 K=2..10", False)
+                "corr.csv Agglomerative H=500 K=2..10", "agglo")
     if config_name == "spectral":
         # BASELINE config #5 scaled to one chip (the full N=20000 H=2000
         # k<=30 shape assumes a v4-32 pod).
@@ -115,7 +126,7 @@ def _build(config_name, small):
         return (
             SpectralClustering(gamma=0.02, solver="lobpcg"), cfg, x,
             f"spectral(lobpcg) blobs N={n} H={h} K=2..{k_hi} [scaled-down]",
-            False,
+            "spectral" if not small else None,
         )
     raise SystemExit(f"unknown --config {config_name!r}")
 
@@ -191,7 +202,7 @@ def main(argv=None):
 
     from consensus_clustering_tpu.parallel.sweep import run_sweep
 
-    clusterer, config, x, metric, is_headline = _build(args.config, small)
+    clusterer, config, x, metric, baseline_key = _build(args.config, small)
     repeats = 1 if backend == "cpu" else max(1, args.repeats)
     out = run_sweep(
         clusterer, config, x, seed=23,
@@ -202,20 +213,20 @@ def main(argv=None):
     rate = out["timing"]["resamples_per_second"]
     wall = out["timing"]["run_seconds"]
 
+    # One baseline store for every config: the reference implementation
+    # measured serially at the same shape as this run (see _build's
+    # baseline_key contract; benchmarks/baseline_cpu_configs.json).
     vs_baseline = None
-    if is_headline:
-        baseline_path = os.path.join(
+    if baseline_key is not None:
+        per_config = os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
-            "benchmarks", "baseline_cpu.json",
+            "benchmarks", "baseline_cpu_configs.json",
         )
-        if os.path.exists(baseline_path):
-            with open(baseline_path) as f:
-                base = json.load(f)
-            base_total = 500 * len(range(2, 21))
-            base_rate = (
-                base_total / base["sweep_wall_seconds_extrapolated_H500"]
-            )
-            vs_baseline = rate / base_rate
+        if os.path.exists(per_config):
+            with open(per_config) as f:
+                base = json.load(f)["configs"].get(baseline_key)
+            if base:
+                vs_baseline = rate / base["resamples_per_sec"]
 
     record = {
         "metric": metric,
