@@ -106,7 +106,14 @@ def _kmeanspp_init(
         d2 = jnp.where(take, pooled[best], d2)
         return centroids, d2
 
-    centroids, _ = jax.lax.fori_loop(1, k_max, body, (centroids0, d2_0))
+    # Trip count is the TRACED k, not static k_max: steps j >= k are
+    # pure no-ops (take above is False and each step's RNG is an
+    # independent fold_in, not a consumed stream), so skipping them is
+    # bit-identical and saves (k_max - k) candidate-distance GEMMs per
+    # restart — half the init work averaged over a K=2..k_max sweep.
+    centroids, _ = jax.lax.fori_loop(
+        1, jnp.minimum(k, k_max), body, (centroids0, d2_0)
+    )
     return centroids
 
 
