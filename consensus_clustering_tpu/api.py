@@ -118,6 +118,11 @@ class ConsensusClustering:
         Histogram bins (reference hard-codes 20).
     chunk_size : int, keyword-only
         Resamples per accumulation GEMM.
+    cluster_batch : int, keyword-only, optional
+        Resamples per clustering sub-batch (None: one batch).  Smaller
+        groups let each sub-batch's Lloyd loop stop at its own slowest
+        member instead of the sweep-wide slowest — bit-identical labels,
+        less lockstep waste, serialised groups (see SweepConfig).
     compute_consensus_labels : bool, keyword-only
         Opt-in consensus labels via agglomerative clustering on 1 - Cij
         (the reference's dead code path Q5, done properly).
@@ -185,6 +190,7 @@ class ConsensusClustering:
         parity_zeros: bool = True,
         bins: int = 20,
         chunk_size: int = 8,
+        cluster_batch: Optional[int] = None,
         compute_consensus_labels: bool = False,
         reseed_clusterer_per_resample: bool = False,
         checkpoint_dir: Optional[str] = None,
@@ -239,6 +245,7 @@ class ConsensusClustering:
         self.parity_zeros = parity_zeros
         self.bins = bins
         self.chunk_size = chunk_size
+        self.cluster_batch = cluster_batch
         self.compute_consensus_labels = compute_consensus_labels
         self.reseed_clusterer_per_resample = reseed_clusterer_per_resample
         self.checkpoint_dir = checkpoint_dir
@@ -353,6 +360,7 @@ class ConsensusClustering:
             parity_zeros=self.parity_zeros,
             store_matrices=self._resolve_store_matrices(n),
             chunk_size=self.chunk_size,
+            cluster_batch=self.cluster_batch,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
             use_pallas=self.use_pallas,
             dtype=self.compute_dtype,

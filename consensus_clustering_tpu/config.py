@@ -35,6 +35,13 @@ class SweepConfig:
         host-transfer cost, so the facade may auto-disable).  When False,
         only the (bins,)-sized curves ever leave the device.
       chunk_size: resamples per accumulation GEMM (see ops.coassoc).
+      cluster_batch: resamples per clustering sub-batch (None: one batch).
+        A vmapped ``while_loop`` freezes converged lanes with selects but
+        still iterates until the SLOWEST lane converges; sub-batching via
+        ``lax.map`` lets each group stop at its own slowest member —
+        bit-identical labels (frozen lanes never change), less lockstep
+        waste, at the cost of serialising groups.  Tune on chip; keep
+        cluster_batch * n_init problems large enough to fill the MXU.
       reseed_clusterer_per_resample: False (default) re-seeds the inner
         clusterer identically for every resample — the reference's semantics
         (a fixed integer ``random_state`` makes every sklearn fit draw the
@@ -65,6 +72,7 @@ class SweepConfig:
     parity_zeros: bool = True
     store_matrices: bool = True
     chunk_size: int = 8
+    cluster_batch: Optional[int] = None
     reseed_clusterer_per_resample: bool = False
     use_pallas: Optional[bool] = None
     dtype: str = "float32"
@@ -73,6 +81,14 @@ class SweepConfig:
         if self.dtype not in ("float32", "float64"):
             raise ValueError(
                 f"dtype must be 'float32' or 'float64', got {self.dtype!r}"
+            )
+        if self.cluster_batch is not None and (
+            not isinstance(self.cluster_batch, int)
+            or self.cluster_batch < 1
+        ):
+            raise ValueError(
+                f"cluster_batch must be an int >= 1, got "
+                f"{self.cluster_batch!r}"
             )
         if not self.k_values:
             raise ValueError("k_values must be non-empty")

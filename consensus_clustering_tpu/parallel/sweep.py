@@ -171,9 +171,36 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 # (fixed random_state per estimator), correlating inits
                 # across resamples — see SweepConfig docs.
                 keys = jnp.broadcast_to(key_k, (local_h,) + key_k.shape)
-            labels = jax.vmap(
+            fit_batch = jax.vmap(
                 lambda kk, xs: clusterer.fit_predict(kk, xs, k, k_max)
-            )(keys, x_sub)
+            )
+            batch = config.cluster_batch
+            if batch is None or batch >= local_h:
+                labels = fit_batch(keys, x_sub)
+            else:
+                # Sub-batch the clustering: a vmapped while_loop freezes
+                # converged lanes (selects) but iterates until the batch's
+                # slowest lane converges, so one big batch pays the global
+                # worst case on every lane.  lax.map over groups lets each
+                # group stop at ITS slowest member — labels bit-identical,
+                # lockstep waste reduced, groups serialised.  Group-count
+                # padding repeats row 0 (clustered redundantly, cropped).
+                n_groups = -(-local_h // batch)
+                pad = n_groups * batch - local_h
+                keys_g = jnp.concatenate(
+                    [keys, jnp.broadcast_to(keys[:1], (pad,) + keys.shape[1:])]
+                ) if pad else keys
+                x_g = jnp.concatenate(
+                    [x_sub, jnp.broadcast_to(
+                        x_sub[:1], (pad,) + x_sub.shape[1:])]
+                ) if pad else x_sub
+                labels = jax.lax.map(
+                    lambda args: fit_batch(*args),
+                    (
+                        keys_g.reshape((n_groups, batch) + keys.shape[1:]),
+                        x_g.reshape((n_groups, batch) + x_sub.shape[1:]),
+                    ),
+                ).reshape((n_groups * batch,) + (x_sub.shape[1],))[:local_h]
             labels = jnp.where(h_valid[:, None], labels, -1)
             labels_row = jax.lax.all_gather(
                 labels, ROW_AXIS, tiled=True, axis=0

@@ -80,6 +80,23 @@ class TestSweepSingleDevice:
         assert "mij" not in out and "cij" not in out and "iij" not in out
         assert out["pac_area"].shape == (3,)
 
+    def test_cluster_batch_bit_identical(self, blobs):
+        # Sub-batched clustering (lax.map over groups of the vmapped
+        # while_loop) must be bit-identical to the single batch: a
+        # vmapped while_loop freezes converged lanes with selects, so
+        # group composition cannot change any lane's result.  Batch 7
+        # does not divide H=10: exercises the group padding crop.
+        x, _ = blobs
+        config = _sweep_config(x)
+        ref = run_sweep(KMeans(n_init=2), config, x, seed=3)
+        for batch in (3, 7):
+            out = run_sweep(
+                KMeans(n_init=2),
+                _sweep_config(x, cluster_batch=batch), x, seed=3,
+            )
+            for name in ("mij", "iij", "cij", "pac_area"):
+                np.testing.assert_array_equal(ref[name], out[name])
+
     def test_deterministic(self, blobs):
         x, _ = blobs
         config = _sweep_config(x)
