@@ -1,14 +1,24 @@
-"""Chunk-size / knob tuning sweep for the accumulation GEMMs.
+"""Knob-tuning sweeps for the compiled consensus k-sweep.
 
-Runs the headline config at several ``chunk_size`` values (resamples per
-accumulation GEMM: bigger chunks = fewer passes over the N x N accumulator
-in HBM, at (B, k_max, N) one-hot cost) and prints one JSON line per point.
-Run on the real chip when tuning; results guide the bench.py default —
-pass ``--out benchmarks/tuning_results.json`` to record them in the repo.
+Runs the headline config across the values of ONE knob and prints one
+JSON line per point.  Two knobs exist:
+
+- ``--chunks 8,16,32``: ``chunk_size``, resamples per accumulation GEMM
+  (bigger chunks = fewer passes over the N x N accumulator in HBM, at
+  (B, k_max, N) one-hot cost).
+- ``--cluster-batches 64,128,256``: ``cluster_batch``, resamples per
+  clustering sub-batch (smaller groups stop at their own slowest Lloyd
+  lane instead of the sweep-wide slowest — bit-identical results, less
+  lockstep waste, serialised groups; 0 means None/one batch).
+
+Run on the real chip when tuning; results guide the bench.py defaults —
+pass ``--out benchmarks/tuning_results.json`` (or
+``benchmarks/tuning_cluster_batch.json``) to record them in the repo.
 
     python benchmarks/tune.py [--n 5000] [--h 200] [--chunks 8,16,32,64]
+    python benchmarks/tune.py --cluster-batches 0,32,64,128,250
 
-``use_pallas`` is left at None, which now resolves through the one-time
+``use_pallas`` is left at None, which resolves through the one-time
 kernel-availability probe (ops/pallas_hist.py) — a broken kernel degrades
 to the XLA fallback instead of killing the tuning run; force a path with
 --use-pallas on|off to tune a specific one.
@@ -22,13 +32,35 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _parse_int_list(parser, text, flag, minimum):
+    try:
+        values = [int(c) for c in text.split(",") if c.strip()]
+    except ValueError:
+        parser.error(f"{flag} must be comma-separated ints: {text!r}")
+    if not values:
+        parser.error(f"{flag} parsed to an empty list")
+    if any(v < minimum for v in values):
+        parser.error(f"{flag} values must be >= {minimum}: {values}")
+    return values
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--n", type=int, default=5000)
     parser.add_argument("--d", type=int, default=50)
     parser.add_argument("--h", type=int, default=200)
     parser.add_argument("--k-hi", type=int, default=20)
-    parser.add_argument("--chunks", default="8,16,32,64")
+    parser.add_argument("--chunks", default=None,
+                        help="chunk_size sweep values (default 8,16,32,64)")
+    parser.add_argument(
+        "--cluster-batches", default=None,
+        help="tune cluster_batch instead of chunk_size (comma list; 0 = "
+        "None, i.e. one batch); chunk_size is pinned at --chunk-size",
+    )
+    parser.add_argument(
+        "--chunk-size", type=int, default=4,
+        help="fixed chunk_size while tuning --cluster-batches",
+    )
     parser.add_argument("--seed", type=int, default=23)
     parser.add_argument(
         "--use-pallas", choices=("auto", "on", "off"), default="auto",
@@ -42,16 +74,28 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
 
-    try:
-        chunks = [int(c) for c in args.chunks.split(",") if c.strip()]
-    except ValueError:
-        parser.error(f"--chunks must be comma-separated ints: {args.chunks!r}")
-    if not chunks:
-        parser.error("--chunks parsed to an empty list")
-    if any(c < 1 for c in chunks):
-        # coassoc clamps chunk_size to >= 1, which would silently mislabel
-        # the tuning record.
-        parser.error(f"--chunks values must be >= 1: {chunks}")
+    if args.cluster_batches is not None:
+        if args.chunks is not None:
+            parser.error(
+                "--chunks and --cluster-batches tune different knobs; "
+                "pass one of them (pin chunk_size with --chunk-size)"
+            )
+        knob = "cluster_batch"
+        points = _parse_int_list(
+            parser, args.cluster_batches, "--cluster-batches", 0
+        )
+    else:
+        knob = "chunk_size"
+        points = _parse_int_list(
+            parser, args.chunks or "8,16,32,64", "--chunks", 1
+        )
+
+    # Honor JAX_PLATFORMS from the environment (the axon sitecustomize
+    # overrides the env var programmatically; a CPU-pinned tuning run must
+    # not dial the TPU tunnel) — same helper as bench.py/__graft_entry__.
+    from consensus_clustering_tpu.utils.platform import pin_platform_from_env
+
+    pin_platform_from_env()
 
     import numpy as np
     from sklearn.datasets import make_blobs
@@ -68,19 +112,25 @@ def main(argv=None):
 
     best = None
     records = []
-    for chunk in chunks:
-        config = SweepConfig(
+    for value in points:
+        kwargs = dict(
             n_samples=args.n, n_features=args.d,
             k_values=tuple(range(2, args.k_hi + 1)),
-            n_iterations=args.h, store_matrices=False, chunk_size=chunk,
+            n_iterations=args.h, store_matrices=False,
             use_pallas={"auto": None, "on": True, "off": False}[
                 args.use_pallas
             ],
         )
+        if knob == "chunk_size":
+            kwargs["chunk_size"] = value
+        else:
+            kwargs["chunk_size"] = args.chunk_size
+            kwargs["cluster_batch"] = value or None
+        config = SweepConfig(**kwargs)
         out = run_sweep(KMeans(n_init=3), config, x, seed=args.seed)
         t = out["timing"]
         rec = {
-            "chunk_size": chunk,
+            knob: value,
             "resamples_per_second": round(t["resamples_per_second"], 2),
             "run_seconds": round(t["run_seconds"], 4),
             "compile_seconds": round(t["compile_seconds"], 2),
@@ -88,8 +138,8 @@ def main(argv=None):
         print(json.dumps(rec), flush=True)
         records.append(rec)
         if best is None or rec["resamples_per_second"] > best[1]:
-            best = (chunk, rec["resamples_per_second"])
-    summary = {"best_chunk_size": best[0], "rps": best[1]}
+            best = (value, rec["resamples_per_second"])
+    summary = {f"best_{knob}": best[0], "rps": best[1]}
     print(json.dumps(summary))
     if args.out:
         import jax
@@ -99,7 +149,12 @@ def main(argv=None):
             "config": {
                 "n": args.n, "d": args.d, "h": args.h, "k_hi": args.k_hi,
                 "seed": args.seed, "use_pallas": args.use_pallas,
+                **(
+                    {"chunk_size": args.chunk_size}
+                    if knob == "cluster_batch" else {}
+                ),
             },
+            "knob": knob,
             "points": records,
             **summary,
         }

@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+import numpy as np
+
 from consensus_clustering_tpu.ops.analysis import pac_indices
 from consensus_clustering_tpu.ops.resample import subsample_size
 
@@ -83,7 +85,8 @@ class SweepConfig:
                 f"dtype must be 'float32' or 'float64', got {self.dtype!r}"
             )
         if self.cluster_batch is not None and (
-            not isinstance(self.cluster_batch, int)
+            isinstance(self.cluster_batch, bool)
+            or not isinstance(self.cluster_batch, (int, np.integer))
             or self.cluster_batch < 1
         ):
             raise ValueError(

@@ -194,13 +194,16 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                     [x_sub, jnp.broadcast_to(
                         x_sub[:1], (pad,) + x_sub.shape[1:])]
                 ) if pad else x_sub
-                labels = jax.lax.map(
+                labels_g = jax.lax.map(
                     lambda args: fit_batch(*args),
                     (
                         keys_g.reshape((n_groups, batch) + keys.shape[1:]),
                         x_g.reshape((n_groups, batch) + x_sub.shape[1:]),
                     ),
-                ).reshape((n_groups * batch,) + (x_sub.shape[1],))[:local_h]
+                )
+                labels = labels_g.reshape(
+                    (n_groups * batch,) + labels_g.shape[2:]
+                )[:local_h]
             labels = jnp.where(h_valid[:, None], labels, -1)
             labels_row = jax.lax.all_gather(
                 labels, ROW_AXIS, tiled=True, axis=0

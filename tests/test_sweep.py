@@ -85,7 +85,7 @@ class TestSweepSingleDevice:
         # while_loop) must be bit-identical to the single batch: a
         # vmapped while_loop freezes converged lanes with selects, so
         # group composition cannot change any lane's result.  Batch 7
-        # does not divide H=10: exercises the group padding crop.
+        # does not divide H=12: exercises the group padding crop.
         x, _ = blobs
         config = _sweep_config(x)
         ref = run_sweep(KMeans(n_init=2), config, x, seed=3)
