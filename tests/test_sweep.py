@@ -159,6 +159,28 @@ class TestSweepSharded:
             ref["pac_area"], sharded["pac_area"], atol=1e-7
         )
 
+    def test_cluster_batch_on_sharded_mesh(self, blobs):
+        # Sub-batched clustering composes with mesh sharding: each chip
+        # groups ITS local resamples (local_h=2 here, batch 3 clamps to
+        # the single-batch path on-chip only when batch >= local_h — use
+        # batch 1 to force real grouping per chip) and the result stays
+        # bit-identical to the unsharded, unbatched run.
+        x, _ = blobs
+        km = KMeans(n_init=2)
+        ref = run_sweep(
+            km, _sweep_config(x, n_iterations=16), x, seed=5,
+            mesh=resample_mesh(jax.devices()[:1]),
+        )
+        sharded = run_sweep(
+            km, _sweep_config(x, n_iterations=16, cluster_batch=1), x,
+            seed=5, mesh=resample_mesh(),
+        )
+        np.testing.assert_array_equal(ref["mij"], sharded["mij"])
+        np.testing.assert_array_equal(ref["iij"], sharded["iij"])
+        np.testing.assert_allclose(
+            ref["pac_area"], sharded["pac_area"], atol=1e-7
+        )
+
     def test_row_sharding_uneven_rows(self, blobs):
         # N=119 over 8 row shards: 15-row blocks, one row of padding —
         # padded rows/cols must be cropped and contribute nothing.
