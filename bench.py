@@ -228,6 +228,16 @@ def main(argv=None):
             if base:
                 vs_baseline = rate / base["resamples_per_sec"]
 
+    fallback_note = os.environ.get("BENCH_FALLBACK_NOTE")
+    if fallback_note in ("unreachable", "timeout"):
+        # Set by the supervisor's CPU fallback (exact sentinel values
+        # only — a stray export must not mislabel a real run): this
+        # record must not read as an accelerator result.
+        reason = (
+            "TPU UNREACHABLE" if fallback_note == "unreachable"
+            else "TPU RUN TIMED OUT"
+        )
+        metric += f" [{reason} - CPU FALLBACK]"
     record = {
         "metric": metric,
         "value": round(rate, 2),
@@ -263,6 +273,9 @@ def _supervise() -> int:
     no benchmark record at all.  Watchdog exits retry (bounded, with a
     pause for the stale claim to expire); any other rc — including 0 —
     passes straight through, as does every byte of the child's output.
+    If every attempt ends in a watchdog exit, a labelled small-shape CPU
+    fallback record is emitted and the supervisor exits rc=5 — data for
+    stdout parsers, an explicit failure for rc gates.
     """
     import subprocess
     import sys
@@ -297,6 +310,36 @@ def _supervise() -> int:
                 file=sys.stderr, flush=True,
             )
             time.sleep(retry_pause)
+    # Last resort: the accelerator attempts are exhausted (rc=3: device
+    # discovery hung; rc=4: run exceeded the total watchdog).  Emit a
+    # clearly-labelled SMALL-shape CPU record — backend=cpu plus a
+    # metric-string marker naming which failure occurred — but still
+    # return a distinct NONZERO rc (5), so a harness gating on rc sees
+    # the accelerator failure while one that parses stdout still gets a
+    # labelled data point instead of nothing.  Disable with
+    # BENCH_CPU_FALLBACK=0.
+    if os.environ.get("BENCH_CPU_FALLBACK", "1") != "0":
+        note = "unreachable" if rc == 3 else "timeout"
+        print(
+            f"bench: accelerator attempts exhausted (last rc={rc}); "
+            "running the clearly-labelled small-shape CPU fallback",
+            file=sys.stderr, flush=True,
+        )
+        env_cpu = dict(
+            env, JAX_PLATFORMS="cpu", BENCH_FALLBACK_NOTE=note,
+        )
+        argv = sys.argv[1:]
+        if "--small" not in argv:
+            # Fixed-shape configs (corr/agglo) would otherwise run their
+            # full shape on the CPU against the same 1800s watchdog.
+            argv = argv + ["--small"]
+        rc_cpu = subprocess.call(
+            [sys.executable, __file__] + argv, env=env_cpu
+        )
+        if rc_cpu < 0:
+            return 128 - rc_cpu
+        if rc_cpu == 0:
+            return 5
     return rc
 
 
