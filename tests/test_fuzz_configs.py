@@ -1,0 +1,79 @@
+"""Config-space fuzz: the compiled sweep's invariants across random shapes.
+
+Each case compiles the full sweep at a randomly drawn (N, d, H, K-set,
+subsampling, chunk/cluster batching) point and checks the structural
+invariants that hold for ANY valid configuration — the broad net for
+padding/masking interactions that targeted tests might miss.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.sweep import build_sweep
+
+
+def _draw_case(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(12, 90))
+    d = int(rng.integers(2, 9))
+    h = int(rng.integers(3, 21))
+    subsampling = float(rng.uniform(0.5, 1.0))
+    n_sub = max(1, int(subsampling * n))
+    k_max_cap = min(8, n_sub)
+    n_ks = int(rng.integers(1, 4))
+    ks = tuple(sorted(rng.choice(
+        np.arange(2, k_max_cap + 1), size=min(n_ks, k_max_cap - 1),
+        replace=False,
+    ).tolist())) or (2,)
+    chunk = int(rng.integers(1, 9))
+    cluster_batch = [None, 1, 3, 7][int(rng.integers(0, 4))]
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    config = SweepConfig(
+        n_samples=n, n_features=d, k_values=ks, n_iterations=h,
+        subsampling=subsampling, chunk_size=chunk,
+        cluster_batch=cluster_batch,
+    )
+    return x, config
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33, 44])
+def test_sweep_invariants_random_config(seed):
+    x, config = _draw_case(seed)
+    n, h = config.n_samples, config.n_iterations
+    devices = jax.devices()
+    mesh = resample_mesh(devices[: [1, 2, 4][seed % 3]])
+    out = jax.tree.map(
+        np.asarray,
+        build_sweep(KMeans(n_init=2), config, mesh)(
+            x, jax.random.PRNGKey(seed)
+        ),
+    )
+    iij = out["iij"].astype(np.int64)
+    nk = len(config.k_values)
+    # Co-sampling structure: symmetric, bounded by H, diagonal = per-point
+    # inclusion count, total inclusion mass = H * n_sub exactly.
+    np.testing.assert_array_equal(iij, iij.T)
+    assert iij.max() <= h
+    assert iij.trace() == h * config.n_sub
+    for i in range(nk):
+        mij = out["mij"][i].astype(np.int64)
+        np.testing.assert_array_equal(mij, mij.T)
+        # Co-clustering never exceeds co-sampling; self-pairs always
+        # co-cluster.
+        assert (mij <= iij).all()
+        np.testing.assert_array_equal(np.diag(mij), np.diag(iij))
+        cij = out["cij"][i]
+        assert np.isfinite(cij).all()
+        assert cij.min() >= 0.0 and cij.max() <= 1.0 + 1e-6
+        np.testing.assert_allclose(np.diag(cij), 1.0)
+    # CDF structure: monotone per K, terminal value 1.
+    cdf = out["cdf"]
+    assert (np.diff(cdf, axis=1) >= -1e-6).all()
+    np.testing.assert_allclose(cdf[:, -1], 1.0, atol=1e-5)
+    assert out["pac_area"].shape == (nk,)
+    assert (out["pac_area"] >= -1e-6).all()
+    assert (out["pac_area"] <= 1.0).all()
