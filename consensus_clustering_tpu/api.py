@@ -498,9 +498,12 @@ class ConsensusClustering:
 
         The sklearn-style convenience the reference's disabled
         ``_get_consensus_labels`` path never delivered (quirk Q5): runs
-        ``fit(X)``, then extracts labels by agglomerating ``1 - Cij`` at
-        the selected K.  Requires the consensus matrices
-        (``store_matrices`` must not resolve to False).
+        ``fit(X)``, then extracts labels at the selected K — exact
+        agglomeration of ``1 - Cij`` up to
+        :data:`~consensus_clustering_tpu.models.agglomerative.AGGLOMERATION_LIMIT`
+        items, spectral embedding (LOBPCG) + KMeans on ``Cij``-as-affinity
+        beyond that (see :func:`consensus_labels_from_cij`).  Requires the
+        consensus matrices (``store_matrices`` must not resolve to False).
         """
         X = np.asarray(X)
         if X.ndim == 2 and not self._resolve_store_matrices(X.shape[0]):
@@ -525,8 +528,14 @@ class ConsensusClustering:
             consensus_labels_from_cij,
         )
 
+        # "auto": exact agglomeration of 1 - Cij up to
+        # AGGLOMERATION_LIMIT items, spectral embedding (LOBPCG) + KMeans
+        # beyond — so best-K labels exist at N = 10000-20000 too.
         labels = consensus_labels_from_cij(
-            entry["cij"], self.best_k_, linkage=self.agg_clustering_linkage
+            entry["cij"], self.best_k_,
+            linkage=self.agg_clustering_linkage,
+            method="auto",
+            seed=0 if self.random_state is None else int(self.random_state),
         )
         # Keep the reference-schema result dict consistent with what was
         # just computed.
@@ -606,8 +615,15 @@ class ConsensusClustering:
 
             for k, entry in entries.items():
                 if entry["cij"] is not None:
+                    # Same method/seed threading as fit_predict: on the
+                    # large-N spectral path the labels must follow the
+                    # run's random_state.
                     labels = consensus_labels_from_cij(
-                        entry["cij"], k, linkage=self.agg_clustering_linkage
+                        entry["cij"], k,
+                        linkage=self.agg_clustering_linkage,
+                        method="auto",
+                        seed=(0 if self.random_state is None
+                              else int(self.random_state)),
                     )
                     entry["consensus_labels"] = labels
                     # Monti's per-cluster / per-item consensus statistics

@@ -165,12 +165,16 @@ def _write_figures(cc, plot_dir: str) -> None:
             consensus_labels_from_cij,
         )
 
-        # Best-K labels only (one agglomeration), not per swept K.
+        # Best-K labels only (one extraction), not per swept K; the
+        # seed matters on the large-N spectral path (method="auto"),
+        # where labels must follow the run's --seed like api.fit_predict.
         labels = best["consensus_labels"]
         if not len(labels):
             labels = consensus_labels_from_cij(
                 best["cij"], cc.best_k_,
                 linkage=cc.agg_clustering_linkage,
+                method="auto",
+                seed=int(cc.random_state),
             )
         plot_consensus_matrix(
             best["cij"],

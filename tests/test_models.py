@@ -127,6 +127,45 @@ class TestAgglomerative:
         assert len(np.unique(labels)) == 2
         assert len(set(labels[:3])) == 1 and len(set(labels[3:])) == 1
 
+    def test_consensus_labels_spectral_matches_agglomerative(self):
+        # A noisy 3-block consensus matrix: both scale regimes must
+        # recover the same partition (up to label permutation).
+        rng = np.random.default_rng(7)
+        n, k = 90, 3
+        truth = np.repeat(np.arange(k), n // k)
+        cij = 0.9 * (truth[:, None] == truth[None, :]).astype(np.float32)
+        cij += rng.uniform(0.0, 0.1, (n, n)).astype(np.float32)
+        cij = ((cij + cij.T) / 2).clip(0.0, 1.0)
+        np.fill_diagonal(cij, 1.0)
+
+        agg = consensus_labels_from_cij(cij, k, method="agglomerative")
+        spec = consensus_labels_from_cij(cij, k, method="spectral", seed=3)
+        from sklearn.metrics import adjusted_rand_score
+
+        assert adjusted_rand_score(truth, agg) == 1.0
+        assert adjusted_rand_score(agg, spec) == 1.0
+
+    def test_consensus_labels_auto_switches_on_limit(self):
+        cij = np.eye(8, dtype=np.float32)
+        cij[:4, :4] = 1.0
+        cij[4:, 4:] = 1.0
+        # auto below the limit: exact agglomeration (deterministic).
+        lo = consensus_labels_from_cij(cij, 2, method="auto", limit=8)
+        # auto above the limit: the spectral path (still a 2-partition).
+        hi = consensus_labels_from_cij(cij, 2, method="auto", limit=7)
+        from sklearn.metrics import adjusted_rand_score
+
+        assert adjusted_rand_score(lo, hi) == 1.0
+
+    def test_consensus_labels_exact_path_refuses_above_limit(self):
+        import pytest
+
+        cij = np.eye(9, dtype=np.float32)
+        with pytest.raises(ValueError, match="exceed the exact-path"):
+            consensus_labels_from_cij(
+                cij, 2, method="agglomerative", limit=8
+            )
+
 
 class TestSpectral:
     def test_recovers_blobs(self, blobs):
