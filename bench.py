@@ -15,9 +15,9 @@ Prints exactly one JSON line:
   {"metric": ..., "value": <resamples/sec>, "unit": "resamples/sec",
    "vs_baseline": <speedup>, ...}
 
-The other BASELINE.json configs run via --config (corr / blobs10k /
-blobs20k / agglo / spectral); shapes scaled down to one chip are marked in
-the metric string.
+The other configs run via --config (corr / blobs10k / blobs20k /
+agglo / spectral / gmm — the last is the reference's second demo
+family); shapes scaled down to one chip are marked in the metric string.
 """
 
 import argparse
@@ -34,6 +34,22 @@ def _blobs(n, d, seed=0):
         random_state=seed,
     )
     return x.astype(np.float32)
+
+
+# Full (non ``--small``) problem shapes and estimator options per config,
+# shared with benchmarks/measure_baseline.py: the reference baseline is
+# only meaningful if it was measured at EXACTLY the shape the on-chip
+# run uses, so both sides read this one table (k ranges start at 2;
+# corr/agglo run on the bundled 29 x 29 dataset, hence no n/d here).
+FULL_SHAPES = {
+    "headline": {"n": 5000, "d": 50, "h": 500, "k_hi": 20, "n_init": 3},
+    "corr": {"h": 100, "k_hi": 10, "n_init": 3},
+    "blobs10k": {"n": 10000, "d": 50, "h": 1000, "k_hi": 20, "n_init": 3},
+    "blobs20k": {"n": 20000, "d": 50, "h": 100, "k_hi": 10, "n_init": 3},
+    "agglo": {"h": 500, "k_hi": 10, "linkage": "average"},
+    "spectral": {"n": 2000, "d": 30, "h": 50, "k_hi": 10, "gamma": 0.02},
+    "gmm": {"n": 2000, "d": 16, "h": 100, "k_hi": 10, "n_init": 2},
+}
 
 
 def _build(config_name, small):
@@ -55,39 +71,54 @@ def _build(config_name, small):
     from consensus_clustering_tpu.models.kmeans import KMeans
     from consensus_clustering_tpu.models.spectral import SpectralClustering
 
+    fs = FULL_SHAPES.get(config_name)
+    if fs is None:
+        raise SystemExit(f"unknown --config {config_name!r}")
     if config_name == "headline":
-        n, d, h, k_hi = (500, 20, 50, 10) if small else (5000, 50, 500, 20)
+        n, d, h, k_hi = ((500, 20, 50, 10) if small
+                         else (fs["n"], fs["d"], fs["h"], fs["k_hi"]))
         x = _blobs(n, d)
         metric = (f"consensus k-sweep throughput (N={n} d={d} H={h} "
                   f"K=2..{k_hi}, KMeans n_init=3)")
         # chunk_size=4 per the on-chip sweep in benchmarks/tuning_results.json
         # (chunks 2..8 are within noise, 16+ consistently slower).
+        # cluster_batch=16 per the on-chip sweep in
+        # benchmarks/tuning_cluster_batch_tpu.json (1992.6 r/s vs 1422.3
+        # unbatched, same session: sub-batching lets each group of 16
+        # Lloyd problems stop at its own slowest member instead of the
+        # sweep-wide slowest).  Single-chip tuning point: on a sharded
+        # mesh this applies per device's LOCAL resample shard (see
+        # SweepConfig docs).
         cfg = SweepConfig(
             n_samples=n, n_features=d, k_values=tuple(range(2, k_hi + 1)),
             n_iterations=h, store_matrices=False, chunk_size=4,
+            cluster_batch=16 if not small else None,
         )
         # KMeans(n_init=3) mirrors the reference's default clusterer_options.
-        return KMeans(n_init=3), cfg, x, metric, "headline" if not small else None
+        return (KMeans(n_init=fs["n_init"]), cfg, x, metric,
+                "headline" if not small else None)
     if config_name == "corr":
         # BASELINE config #1: bundled dataset, H=100, k in [2, 10].
         x = load_corr(transform=True)
         cfg = SweepConfig(
             n_samples=x.shape[0], n_features=x.shape[1],
-            k_values=tuple(range(2, 11)), n_iterations=100,
-            store_matrices=False,
+            k_values=tuple(range(2, fs["k_hi"] + 1)),
+            n_iterations=fs["h"], store_matrices=False,
         )
-        return (KMeans(n_init=3), cfg, x,
-                "corr.csv KMeans H=100 K=2..10", "corr")
+        return (KMeans(n_init=fs["n_init"]), cfg, x,
+                f"corr.csv KMeans H={fs['h']} K=2..{fs['k_hi']}", "corr")
     if config_name == "blobs10k":
         # BASELINE config #3 (large-N consensus matrix): N=10000, H=1000.
-        n, h = (1000, 100) if small else (10000, 1000)
-        x = _blobs(n, 50)
+        n, h = (1000, 100) if small else (fs["n"], fs["h"])
+        x = _blobs(n, fs["d"])
         cfg = SweepConfig(
-            n_samples=n, n_features=50, k_values=tuple(range(2, 21)),
+            n_samples=n, n_features=fs["d"],
+            k_values=tuple(range(2, fs["k_hi"] + 1)),
             n_iterations=h, store_matrices=False, chunk_size=8,
         )
-        return (KMeans(n_init=3), cfg, x,
-                f"large-N blobs N={n} KMeans H={h} K=2..20", None)
+        return (KMeans(n_init=fs["n_init"]), cfg, x,
+                f"large-N blobs N={n} KMeans H={h} K=2..{fs['k_hi']}",
+                None)
     if config_name == "blobs20k":
         # BASELINE config #5's N (20000) with the KMeans hot path on ONE
         # chip: validates the O(N^2) row-block accumulation + O(tile)
@@ -95,40 +126,206 @@ def _build(config_name, small):
         # full H=2000/K<=30 shape assumes a pod; H is scaled to keep the
         # single-chip run bounded.  store_matrices=False keeps every
         # N x N array on device — only the (bins,) curves come home.
-        n, h, k_hi = (2000, 20, 5) if small else (20000, 100, 10)
-        x = _blobs(n, 50)
+        n, h, k_hi = ((2000, 20, 5) if small
+                      else (fs["n"], fs["h"], fs["k_hi"]))
+        x = _blobs(n, fs["d"])
         cfg = SweepConfig(
-            n_samples=n, n_features=50, k_values=tuple(range(2, k_hi + 1)),
+            n_samples=n, n_features=fs["d"],
+            k_values=tuple(range(2, k_hi + 1)),
             n_iterations=h, store_matrices=False, chunk_size=4,
         )
-        return (KMeans(n_init=3), cfg, x,
+        return (KMeans(n_init=fs["n_init"]), cfg, x,
                 f"large-N blobs N={n} KMeans H={h} K=2..{k_hi} [scaled H]",
                 None)
+    if config_name == "gmm":
+        # The reference's second demo sweep (consensus clustering.ipynb
+        # cells 12-14) is GaussianMixture; this is that family at a
+        # bench-friendly shape: well-conditioned full-covariance EM
+        # (n_sub = 1600 >> d = 16, so f32 on the MXU is stable —
+        # unlike corr.csv where n_sub < d forces the f64 parity path).
+        from consensus_clustering_tpu.models.gmm import GaussianMixture
+
+        n, d, h, k_hi = ((500, 8, 20, 5) if small
+                         else (fs["n"], fs["d"], fs["h"], fs["k_hi"]))
+        x = _blobs(n, d)
+        cfg = SweepConfig(
+            n_samples=n, n_features=d, k_values=tuple(range(2, k_hi + 1)),
+            n_iterations=h, store_matrices=False,
+        )
+        return (
+            GaussianMixture(n_init=fs["n_init"]), cfg, x,
+            f"gmm(full-cov) blobs N={n} d={d} H={h} K=2..{k_hi}",
+            "gmm" if not small else None,
+        )
     if config_name == "agglo":
         # BASELINE config #4: agglomerative inner clusterer on corr, H=500.
         x = load_corr(transform=True)
         cfg = SweepConfig(
             n_samples=x.shape[0], n_features=x.shape[1],
-            k_values=tuple(range(2, 11)), n_iterations=500,
-            store_matrices=False,
+            k_values=tuple(range(2, fs["k_hi"] + 1)),
+            n_iterations=fs["h"], store_matrices=False,
         )
-        return (AgglomerativeClustering(linkage="average"), cfg, x,
-                "corr.csv Agglomerative H=500 K=2..10", "agglo")
+        return (AgglomerativeClustering(linkage=fs["linkage"]), cfg, x,
+                f"corr.csv Agglomerative H={fs['h']} K=2..{fs['k_hi']}",
+                "agglo")
     if config_name == "spectral":
         # BASELINE config #5 scaled to one chip (the full N=20000 H=2000
         # k<=30 shape assumes a v4-32 pod).
-        n, h, k_hi = (512, 10, 6) if small else (2000, 50, 10)
-        x = _blobs(n, 30)
+        n, h, k_hi = ((512, 10, 6) if small
+                      else (fs["n"], fs["h"], fs["k_hi"]))
+        x = _blobs(n, fs["d"])
         cfg = SweepConfig(
-            n_samples=n, n_features=30, k_values=tuple(range(2, k_hi + 1)),
+            n_samples=n, n_features=fs["d"],
+            k_values=tuple(range(2, k_hi + 1)),
             n_iterations=h, store_matrices=False,
         )
         return (
-            SpectralClustering(gamma=0.02, solver="lobpcg"), cfg, x,
+            SpectralClustering(gamma=fs["gamma"], solver="lobpcg"),
+            cfg, x,
             f"spectral(lobpcg) blobs N={n} H={h} K=2..{k_hi} [scaled-down]",
             "spectral" if not small else None,
         )
-    raise SystemExit(f"unknown --config {config_name!r}")
+
+
+_RECORDS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+)
+
+
+def _records_path():
+    """Where successful accelerator runs are preserved for posterity.
+
+    The shared TPU tunnel can wedge for hours after any client dies
+    mid-claim, so the round's official (driver-invoked) bench run may
+    find the device unreachable even though real on-chip runs happened
+    earlier the same day.  Every accelerator success is therefore
+    appended here, and the CPU fallback embeds the newest matching
+    entry (clearly labelled) so the parsed payload never carries less
+    evidence than the repo does.
+    """
+    return os.environ.get(
+        "BENCH_RECORDS_FILE",
+        os.path.join(_RECORDS_DIR, "onchip_records_r03.json"),
+    )
+
+
+def _append_onchip_record(record, config_name):
+    import datetime
+
+    path = _records_path()
+    entry = dict(
+        record,
+        config=config_name,
+        ran_at=datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%MZ"
+        ),
+    )
+    try:
+        if os.path.exists(path):
+            with open(path) as f:
+                payload = json.load(f)
+            if (not isinstance(payload, dict)
+                    or not isinstance(payload.get("records"), list)):
+                # Wrong-shaped JSON (hand-edited, or BENCH_RECORDS_FILE
+                # pointing at some other artifact): leave it alone.
+                return
+        else:
+            payload = {
+                "note": (
+                    "Verbatim bench.py records from successful "
+                    "accelerator runs, appended automatically because "
+                    "the shared tunnel can wedge for hours (see "
+                    "PERF.md); if the end-of-round driver bench hits "
+                    "such a wedge, these are the round's real "
+                    "accelerator measurements."
+                ),
+                "records": [],
+            }
+        payload["records"].append(entry)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    except Exception:
+        # Preservation is best-effort; NO records-file problem (corrupt
+        # JSON, permissions, unexpected structure) may fail the bench
+        # whose measurement it was about to preserve.
+        pass
+
+
+def _newest_onchip_record(config_name):
+    """Newest preserved accelerator record for ``config_name``.
+
+    Returns ``(record, source_path, match)`` where ``match`` is how the
+    record was found: ``"config"`` (its config field matches),
+    ``"prefix"`` (legacy round-2 record matched by metric-string
+    prefix — same config, field predates it), or ``"any"`` (no match
+    for this config at all; the newest record of ANY config — callers
+    must disclose the mismatch).  Scans every
+    ``benchmarks/onchip_records_*.json``; within the strongest match
+    tier, recency is decided by each record's ``ran_at`` timestamp
+    (ISO-8601, lexicographically ordered), NOT by filename — appends
+    are pinned to one file, so a newer-named file must not shadow a
+    newer-in-time record in an older-named one.
+    """
+    import glob
+
+    files = glob.glob(os.path.join(_RECORDS_DIR, "onchip_records_*.json"))
+    explicit = os.environ.get("BENCH_RECORDS_FILE")
+    if explicit and os.path.exists(explicit) and explicit not in files:
+        files.append(explicit)
+    # Metric-string prefixes as emitted by _build at FULL shape, per
+    # config (round-2 records carry no "config" field, only the metric
+    # string; the N in the large-N prefixes keeps blobs10k/blobs20k
+    # from cross-matching).
+    prefix = {
+        "headline": "consensus k-sweep throughput",
+        "corr": "corr.csv KMeans",
+        "blobs10k": "large-N blobs N=10000",
+        "blobs20k": "large-N blobs N=20000",
+        "agglo": "corr.csv Agglomerative",
+        "spectral": "spectral",
+        "gmm": "gmm",
+    }.get(config_name)
+    # Best candidate per match tier: (ran_at, file order, record order)
+    # keys make "newest" mean newest-in-time, with in-file position as
+    # the tiebreak for records missing ran_at.
+    best = {"config": None, "prefix": None, "any": None}
+
+    def consider(tier, key, rec, path):
+        if best[tier] is None or key > best[tier][0]:
+            best[tier] = (key, rec, path)
+
+    for file_idx, path in enumerate(files):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            records = (payload.get("records", [])
+                       if isinstance(payload, dict) else [])
+        except (OSError, ValueError):
+            continue
+        if not isinstance(records, list):
+            continue
+        for rec_idx, rec in enumerate(records):
+            if not isinstance(rec, dict):
+                continue
+            ran_at = rec.get("ran_at")
+            metric = rec.get("metric")
+            key = (ran_at if isinstance(ran_at, str) else "",
+                   file_idx, rec_idx)
+            if rec.get("config") == config_name:
+                consider("config", key, rec, path)
+            elif (prefix is not None and isinstance(metric, str)
+                    and metric.startswith(prefix)):
+                consider("prefix", key, rec, path)
+            else:
+                consider("any", key, rec, path)
+    for tier in ("config", "prefix", "any"):
+        if best[tier] is not None:
+            _, rec, path = best[tier]
+            return rec, path, tier
+    return None, None, None
 
 
 def main(argv=None):
@@ -137,6 +334,7 @@ def main(argv=None):
         "--config", default="headline",
         choices=[
             "headline", "corr", "blobs10k", "blobs20k", "agglo", "spectral",
+            "gmm",
         ],
     )
     parser.add_argument(
@@ -258,6 +456,29 @@ def main(argv=None):
     static_total = out["timing"].get("compiled_memory", {}).get("total_bytes")
     if static_total:
         record["compiled_memory_bytes"] = static_total
+    if fallback_note in ("unreachable", "timeout"):
+        # The CPU fallback must not be LESS informative than the repo:
+        # carry the newest preserved accelerator record in the parsed
+        # payload, explicitly labelled as evidence from an earlier run.
+        preserved, source, match = _newest_onchip_record(args.config)
+        if preserved is not None:
+            provenance = (
+                f"preserved on-chip record from "
+                f"{preserved.get('ran_at', 'an earlier run')} "
+                f"({os.path.basename(source)}), not this run"
+            )
+            if match == "any":
+                provenance += (
+                    f"; NOTE: no preserved record matches config "
+                    f"{args.config!r} — this is the newest accelerator "
+                    "record of a DIFFERENT config"
+                )
+            record["last_onchip"] = dict(preserved, provenance=provenance)
+    elif backend != "cpu" and not small:
+        # Full-shape accelerator runs only: a --small smoke run would
+        # otherwise become the "newest" record for its config and
+        # shadow the real measurement in a later fallback payload.
+        _append_onchip_record(record, args.config)
     done.set()
     print(json.dumps(record))
 
@@ -282,9 +503,9 @@ def _supervise() -> int:
     import time
 
     try:
-        attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "3")))
+        attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "5")))
     except ValueError:
-        attempts = 3
+        attempts = 5
     try:
         retry_pause = max(
             0.0, float(os.environ.get("BENCH_RETRY_PAUSE", "120"))
@@ -303,13 +524,22 @@ def _supervise() -> int:
         if rc not in (3, 4):
             return rc
         if attempt < attempts - 1:
+            # Observed tunnel wedges last tens of minutes to hours, not
+            # the seconds a flat pause assumes: back off exponentially
+            # (120/240/480/960s by default) so the 5-attempt window
+            # spans ~50 min of wall clock — long enough to outlive a
+            # short wedge, still bounded for the driver.  The cap only
+            # limits the growth: an operator-set BENCH_RETRY_PAUSE
+            # above it is honored as a flat pause.
+            pause = min(retry_pause * (2 ** attempt),
+                        max(960.0, retry_pause))
             print(
                 f"bench: watchdog exit rc={rc} (attempt {attempt + 1}/"
-                f"{attempts}); retrying in {retry_pause:.0f}s with a "
+                f"{attempts}); retrying in {pause:.0f}s with a "
                 "fresh process",
                 file=sys.stderr, flush=True,
             )
-            time.sleep(retry_pause)
+            time.sleep(pause)
     # Last resort: the accelerator attempts are exhausted (rc=3: device
     # discovery hung; rc=4: run exceeded the total watchdog).  Emit a
     # clearly-labelled SMALL-shape CPU record — backend=cpu plus a
@@ -325,16 +555,14 @@ def _supervise() -> int:
             "running the clearly-labelled small-shape CPU fallback",
             file=sys.stderr, flush=True,
         )
+        # No argv changes needed: main() already implies --small on a
+        # CPU backend for every config that scales down; corr and agglo
+        # have fixed (small) shapes and ignore the flag entirely.
         env_cpu = dict(
             env, JAX_PLATFORMS="cpu", BENCH_FALLBACK_NOTE=note,
         )
-        argv = sys.argv[1:]
-        if "--small" not in argv:
-            # Fixed-shape configs (corr/agglo) would otherwise run their
-            # full shape on the CPU against the same 1800s watchdog.
-            argv = argv + ["--small"]
         rc_cpu = subprocess.call(
-            [sys.executable, __file__] + argv, env=env_cpu
+            [sys.executable, __file__] + sys.argv[1:], env=env_cpu
         )
         if rc_cpu < 0:
             return 128 - rc_cpu

@@ -42,8 +42,13 @@ class SweepConfig:
         still iterates until the SLOWEST lane converges; sub-batching via
         ``lax.map`` lets each group stop at its own slowest member —
         bit-identical labels (frozen lanes never change), less lockstep
-        waste, at the cost of serialising groups.  Tune on chip; keep
-        cluster_batch * n_init problems large enough to fill the MXU.
+        waste, at the cost of serialising groups.  Applies to each
+        device's LOCAL resample shard (H divided over the 'h' and
+        replica mesh axes), so any value >= the local shard size is
+        equivalent to None — a value tuned on one device layout can
+        silently stop sub-batching on a wider mesh.  Tune on chip at
+        the deployment mesh; keep cluster_batch * n_init problems large
+        enough to fill the MXU.
       reseed_clusterer_per_resample: False (default) re-seeds the inner
         clusterer identically for every resample — the reference's semantics
         (a fixed integer ``random_state`` makes every sklearn fit draw the
