@@ -1,0 +1,177 @@
+"""Measure the REFERENCE implementation's serial CPU throughput.
+
+Every ``vs_baseline`` in bench.py divides by a number produced by this
+script: the reference (trioxane/consensus_clustering) run serially
+(``n_jobs=1`` — its only race-free mode, SURVEY.md §4) at the same shape
+as the corresponding bench.py config, on this machine.  Rates extrapolate
+linearly in H (per-resample work is H-independent), so a small
+``--h-measured`` bounds the wall clock at the slow configs:
+
+    python benchmarks/measure_baseline.py --config gmm --h-measured 6 \\
+        --reference /root/reference
+
+merges the measured entry into ``baseline_cpu_configs.json``.
+
+Config shapes mirror bench.py's ``_build`` exactly; the inner clusterer
+is the SKLEARN estimator the reference would use (bench.py runs our
+native JAX equivalent — the comparison is framework vs framework at the
+same statistical task, per BASELINE.md).  blobs10k/blobs20k have no
+entries: serial reference at those N is days of CPU.
+
+The agglomerative config needs a seed shim: the reference calls
+``set_params(random_state=...)`` on every clusterer
+(consensus_clustering_parallelised.py:212), which modern sklearn rejects
+for AgglomerativeClustering; the shim swallows that one kwarg — timing is
+unaffected (agglomerative clustering is deterministic, no seed exists to
+set).  This is documented in baseline_cpu_configs.json's note.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          os.pardir)
+sys.path.insert(0, os.path.join(_REPO_ROOT, "tests", "fixtures"))
+sys.path.insert(0, _REPO_ROOT)
+from make_goldens import (  # noqa: E402
+    corr_after_powertransform,
+    load_reference,
+)
+
+# The shared shape table + blob generator: the baseline is only
+# meaningful at EXACTLY the shape the on-chip run uses, so both sides
+# read bench.py's FULL_SHAPES instead of keeping copies in sync by hand.
+from bench import FULL_SHAPES, _blobs  # noqa: E402
+
+CONFIGS_JSON = os.path.join(os.path.dirname(__file__),
+                            "baseline_cpu_configs.json")
+SEED = 23
+
+
+def _blobs64(n, d):
+    # sklearn computes in f64; the f32 cast in bench._blobs is a
+    # framework choice, not a reference behavior.
+    return _blobs(n, d).astype("float64")
+
+
+def _seed_tolerant_agglomerative(linkage):
+    from sklearn.cluster import AgglomerativeClustering
+
+    class SeedTolerantAgglomerative(AgglomerativeClustering):
+        """Swallows the reference's unconditional random_state kwarg."""
+
+        def set_params(self, random_state=None, **params):
+            return super().set_params(**params)
+
+    return SeedTolerantAgglomerative(linkage=linkage)
+
+
+def build(config_name):
+    """(clusterer, clusterer_options, X, k_values, h_full) per config.
+
+    Every shape/option comes from bench.py's ``FULL_SHAPES`` so the
+    measured rate divides cleanly into the on-chip number by
+    construction.
+    """
+    from sklearn.cluster import KMeans, SpectralClustering
+    from sklearn.mixture import GaussianMixture
+
+    if config_name in ("blobs10k", "blobs20k"):
+        raise SystemExit(
+            f"no reference baseline for {config_name!r} (serial "
+            "reference at those N is days of CPU; see BASELINE.md)"
+        )
+    fs = FULL_SHAPES[config_name]
+    k_values = list(range(2, fs["k_hi"] + 1))
+    if config_name == "headline":
+        return (KMeans(), {"n_init": fs["n_init"]},
+                _blobs64(fs["n"], fs["d"]), k_values, fs["h"])
+    if config_name == "corr":
+        return (KMeans(), {"n_init": fs["n_init"]},
+                corr_after_powertransform(), k_values, fs["h"])
+    if config_name == "agglo":
+        return (_seed_tolerant_agglomerative(fs["linkage"]), {},
+                corr_after_powertransform(), k_values, fs["h"])
+    if config_name == "spectral":
+        return (SpectralClustering(gamma=fs["gamma"]), {},
+                _blobs64(fs["n"], fs["d"]), k_values, fs["h"])
+    if config_name == "gmm":
+        return (GaussianMixture(), {"n_init": fs["n_init"]},
+                _blobs64(fs["n"], fs["d"]), k_values, fs["h"])
+    raise SystemExit(f"unknown config {config_name!r}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--config", required=True,
+        choices=["headline", "corr", "agglo", "spectral", "gmm"],
+    )
+    parser.add_argument(
+        "--h-measured", type=int, default=10,
+        help="resamples per K actually timed (rate extrapolates in H)",
+    )
+    parser.add_argument(
+        "--reference", default=os.environ.get("REFERENCE_PATH",
+                                              "/root/reference"),
+        help="path to a trioxane/consensus_clustering checkout",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="print the measured entry without touching the json",
+    )
+    args = parser.parse_args(argv)
+
+    ref = load_reference(args.reference)
+    clusterer, options, x, k_values, h_full = build(args.config)
+
+    cc = ref.ConsensusClustering(
+        clusterer=clusterer,
+        clusterer_options=options,
+        K_range=k_values,
+        n_iterations=args.h_measured,
+        subsampling=0.8,
+        random_state=SEED,
+        plot_cdf=False,
+        n_jobs=1,
+    )
+    print(
+        f"timing serial reference: {args.config} "
+        f"(H={args.h_measured} x {len(k_values)} K values)...",
+        file=sys.stderr,
+    )
+    t0 = time.perf_counter()
+    cc.fit(x)
+    wall = time.perf_counter() - t0
+
+    total = args.h_measured * len(k_values)
+    rate = total / wall
+    entry = {
+        "h_measured": args.h_measured,
+        "h_full": h_full,
+        "k_values": k_values,
+        "resamples_per_sec": rate,
+        "sweep_wall_seconds_extrapolated_full_H": wall
+        * (h_full / args.h_measured),
+    }
+    print(json.dumps({args.config: entry}, indent=1))
+    if args.dry_run:
+        return 0
+
+    with open(CONFIGS_JSON) as f:
+        payload = json.load(f)
+    payload["configs"][args.config] = entry
+    tmp = CONFIGS_JSON + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, CONFIGS_JSON)
+    print(f"merged into {CONFIGS_JSON}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

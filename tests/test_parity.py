@@ -4,7 +4,9 @@ Fixtures in tests/fixtures/reference_goldens.json were produced by running
 the reference (trioxane/consensus_clustering) serially (n_jobs=1) on this
 machine's sklearn — the deterministic path, per SURVEY.md §4 (the notebook's
 published numbers came from racy multiprocessing on an older sklearn and are
-not reproducible).
+not reproducible).  Regenerate (or verify) the fixture with
+``python tests/fixtures/make_goldens.py [--check]`` against a reference
+checkout whenever sklearn bumps.
 
 Two layers of parity:
 
