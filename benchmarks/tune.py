@@ -11,12 +11,18 @@ JSON line per point.  Two knobs exist:
   lane instead of the sweep-wide slowest — bit-identical results, less
   lockstep waste, serialised groups; 0 means None/one batch).
 
+The knobs interact (sub-batched clustering changes the accumulation
+cadence), so pin the one you are not sweeping: ``--chunk-size`` fixes
+chunk_size during a ``--cluster-batches`` sweep, and ``--cluster-batch``
+fixes cluster_batch during a ``--chunks`` sweep.
+
 Run on the real chip when tuning; results guide the bench.py defaults —
 pass ``--out benchmarks/tuning_results.json`` (or
 ``benchmarks/tuning_cluster_batch.json``) to record them in the repo.
 
     python benchmarks/tune.py [--n 5000] [--h 200] [--chunks 8,16,32,64]
     python benchmarks/tune.py --cluster-batches 0,32,64,128,250
+    python benchmarks/tune.py --chunks 4,16 --cluster-batch 16
 
 ``use_pallas`` is left at None, which resolves through the one-time
 kernel-availability probe (ops/pallas_hist.py) — a broken kernel degrades
@@ -60,6 +66,12 @@ def main(argv=None):
     parser.add_argument(
         "--chunk-size", type=int, default=4,
         help="fixed chunk_size while tuning --cluster-batches",
+    )
+    parser.add_argument(
+        "--cluster-batch", type=int, default=0,
+        help="fixed cluster_batch while tuning --chunks (0 = None; the "
+        "knobs interact, so re-tune chunk_size after pinning a "
+        "cluster_batch)",
     )
     parser.add_argument("--seed", type=int, default=23)
     parser.add_argument(
@@ -123,6 +135,7 @@ def main(argv=None):
         )
         if knob == "chunk_size":
             kwargs["chunk_size"] = value
+            kwargs["cluster_batch"] = args.cluster_batch or None
         else:
             kwargs["chunk_size"] = args.chunk_size
             kwargs["cluster_batch"] = value or None
@@ -151,7 +164,8 @@ def main(argv=None):
                 "seed": args.seed, "use_pallas": args.use_pallas,
                 **(
                     {"chunk_size": args.chunk_size}
-                    if knob == "cluster_batch" else {}
+                    if knob == "cluster_batch"
+                    else {"cluster_batch": args.cluster_batch}
                 ),
             },
             "knob": knob,
