@@ -474,10 +474,13 @@ def main(argv=None):
                     "record of a DIFFERENT config"
                 )
             record["last_onchip"] = dict(preserved, provenance=provenance)
-    elif backend != "cpu" and not small:
-        # Full-shape accelerator runs only: a --small smoke run would
-        # otherwise become the "newest" record for its config and
-        # shadow the real measurement in a later fallback payload.
+    elif (backend != "cpu" and not small
+            and args.profile_dir is None):
+        # Full-shape, unprofiled accelerator runs only: a --small smoke
+        # run or a profiler-instrumented run (trace capture is a ~5x
+        # slowdown through the tunnel) would otherwise become the
+        # "newest" record for its config and shadow the real
+        # measurement in a later fallback payload.
         _append_onchip_record(record, args.config)
     done.set()
     print(json.dumps(record))

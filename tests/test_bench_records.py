@@ -61,6 +61,41 @@ class TestAppend:
         assert _read(target)["records"][0]["value"] == 5.0
 
 
+class TestFullShapesTable:
+    """FULL_SHAPES is the single source of truth for full-shape runs;
+    both bench._build and measure_baseline.build read it.  These tests
+    pin the contract so a one-sided edit cannot silently desynchronize
+    the measured baseline from the on-chip shape."""
+
+    def test_build_uses_table_shapes(self, bench):
+        for config, fs in bench.FULL_SHAPES.items():
+            _, cfg, x, metric, _ = bench._build(config, small=False)
+            assert cfg.n_iterations == fs["h"], config
+            assert cfg.k_values[-1] == fs["k_hi"], config
+            if "n" in fs:
+                assert x.shape == (fs["n"], fs["d"]), config
+
+    def test_measure_baseline_matches_table(self, bench):
+        mb_path = os.path.join(os.path.dirname(_BENCH_PATH),
+                               "benchmarks", "measure_baseline.py")
+        spec = importlib.util.spec_from_file_location(
+            "measure_baseline_under_test", mb_path)
+        mb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mb)
+        # The script re-imports bench from the repo root, so its table
+        # must be (at minimum) equal to the one under test here.
+        assert mb.FULL_SHAPES == bench.FULL_SHAPES
+        for config in ("corr", "gmm", "spectral"):
+            fs = bench.FULL_SHAPES[config]
+            clusterer, options, x, k_values, h_full = mb.build(config)
+            assert h_full == fs["h"], config
+            assert k_values == list(range(2, fs["k_hi"] + 1)), config
+            if "n" in fs:
+                assert x.shape == (fs["n"], fs["d"]), config
+            if "n_init" in fs:
+                assert options == {"n_init": fs["n_init"]}, config
+
+
 class TestNewest:
     def test_matches_config_field_and_prefers_last_entry(self, bench):
         bench._append_onchip_record({"value": 1.0}, "headline")
