@@ -109,12 +109,18 @@ def _build(config_name, small):
                 f"corr.csv KMeans H={fs['h']} K=2..{fs['k_hi']}", "corr")
     if config_name == "blobs10k":
         # BASELINE config #3 (large-N consensus matrix): N=10000, H=1000.
+        # cluster_batch=8 per the on-chip full-shape sweep in
+        # benchmarks/tuning_cluster_batch_blobs10k_tpu.json (1047.7 vs
+        # 745.2 r/s unbatched, same session; H=1000 gives the lockstep
+        # while_loop 1000 lanes, so per-group early stopping pays even
+        # more than at the headline shape).
         n, h = (1000, 100) if small else (fs["n"], fs["h"])
         x = _blobs(n, fs["d"])
         cfg = SweepConfig(
             n_samples=n, n_features=fs["d"],
             k_values=tuple(range(2, fs["k_hi"] + 1)),
             n_iterations=h, store_matrices=False, chunk_size=8,
+            cluster_batch=8 if not small else None,
         )
         return (KMeans(n_init=fs["n_init"]), cfg, x,
                 f"large-N blobs N={n} KMeans H={h} K=2..{fs['k_hi']}",
