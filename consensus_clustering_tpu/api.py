@@ -100,6 +100,11 @@ class ConsensusClustering:
         K-selection criterion for ``best_k_`` — live config here (the
         reference stores it and never reads it): PAC argmin, or Monti's
         Delta(K) elbow.
+    delta_k_threshold : float, keyword-only
+        Noise floor for the 'delta_k' criterion: ``best_k_`` is the
+        largest K whose relative CDF-area gain Delta(K) still exceeds
+        this (default 0.05 — parity-mode areas wobble ~3% per K on
+        small inputs).  Ignored under 'PAC'.
     n_jobs : int
         Thread count for the host-backend (sklearn clusterer) labelling
         loop, race-free (per-fit estimator clones, per-task label rows).
@@ -200,6 +205,7 @@ class ConsensusClustering:
         metrics_path: Optional[str] = None,
         k_batch_size: Optional[int] = None,
         compute_dtype: str = "float32",
+        delta_k_threshold: float = _DELTA_K_THRESHOLD,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -219,6 +225,11 @@ class ConsensusClustering:
                 "not supported (choose 'PAC' or 'delta_k')"
             )
         self.consensus_matrix_analysis = consensus_matrix_analysis
+        if not 0.0 <= delta_k_threshold:
+            raise ValueError(
+                f"delta_k_threshold must be >= 0, got {delta_k_threshold}"
+            )
+        self.delta_k_threshold = float(delta_k_threshold)
         self.PAC_interval = tuple(PAC_interval)
         self.plot_cdf = plot_cdf
         self.agg_clustering_linkage = agg_clustering_linkage
@@ -456,13 +467,13 @@ class ConsensusClustering:
         dead config): 'PAC' (default, argmin PAC with near-ties broken
         toward the largest stable K), or 'delta_k' (Monti's elbow: the
         largest K whose relative area gain Delta(K) still exceeds
-        ``_DELTA_K_THRESHOLD``).
+        ``delta_k_threshold``).
         """
         mode = self.consensus_matrix_analysis
         ks = list(config.k_values)
         if mode == "delta_k":
             # Monti's elbow, exactly as documented: the largest K whose
-            # relative area gain Delta(K) still exceeds _DELTA_K_THRESHOLD.
+            # relative area gain Delta(K) still exceeds delta_k_threshold.
             # Gains are floored at 0 (noise can dip the CDF area); no
             # meaningful gain anywhere selects the smallest K.  A gain that
             # resurges after a flat (sub-threshold) stretch is honoured
@@ -473,7 +484,7 @@ class ConsensusClustering:
             gains = np.maximum(np.asarray(self.delta_k_, float), 0.0)
             chosen = ks[0]
             for i in range(1, len(ks)):
-                if gains[i] > _DELTA_K_THRESHOLD:
+                if gains[i] > self.delta_k_threshold:
                     chosen = ks[i]
             return int(chosen)
         if mode != "PAC":

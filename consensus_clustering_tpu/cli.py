@@ -196,7 +196,10 @@ def cmd_bench(args):
 
 
 def main(argv=None):
-    from consensus_clustering_tpu.utils.platform import pin_platform_from_env
+    from consensus_clustering_tpu.utils.platform import (
+        enable_compilation_cache,
+        pin_platform_from_env,
+    )
 
     pin_platform_from_env()
     parser = argparse.ArgumentParser(
@@ -245,6 +248,9 @@ def main(argv=None):
     bench_p.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
+    # After parsing: --help / argument errors must not pay the jax
+    # import this call needs (it only has to precede the first compile).
+    enable_compilation_cache()
     args.fn(args)
 
 

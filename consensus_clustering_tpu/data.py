@@ -3,6 +3,15 @@
 The reference ships a 29x29 correlation matrix (`corr.csv`) used by its demo
 notebook as the feature matrix after a PowerTransform
 (consensus clustering.ipynb cells 2-3).  The same file is bundled here.
+
+Provenance and licensing of ``data/corr.csv``: copied byte-for-byte from
+the trioxane/consensus_clustering repository, whose code is distributed
+under GPL-2.0.  We believe the file — a table of measured correlation
+values — is factual data without copyrightable expression, so it
+carries no license of its own; see NOTICE at the repo root for the full
+statement, including the conservative fallback (the file is a separable
+test/demo asset) if that assessment is doubted.  All code in this
+repository is original and Apache-2.0.
 """
 
 from __future__ import annotations

@@ -85,7 +85,9 @@ class TestFullShapesTable:
         # The script re-imports bench from the repo root, so its table
         # must be (at minimum) equal to the one under test here.
         assert mb.FULL_SHAPES == bench.FULL_SHAPES
-        for config in ("corr", "gmm", "spectral"):
+        # blobs10k/blobs20k joined in round 4: the large-N baselines are
+        # now measured (small --h-measured, linear-in-H extrapolation).
+        for config in ("corr", "gmm", "spectral", "blobs10k", "blobs20k"):
             fs = bench.FULL_SHAPES[config]
             clusterer, options, x, k_values, h_full = mb.build(config)
             assert h_full == fs["h"], config
@@ -142,16 +144,40 @@ class TestNewest:
         assert bench._newest_onchip_record("corr")[0]["value"] == 4.0
         assert bench._newest_onchip_record("blobs10k")[2] == "prefix"
 
-    def test_any_record_beats_nothing(self, bench, tmp_path):
+    def test_mismatched_config_returns_none(self, bench, tmp_path):
+        # A record that matches neither the config field nor the metric
+        # prefix must NOT be embedded: a fallback payload carrying a
+        # different benchmark's number as this config's evidence would
+        # mislead any parser reading last_onchip.value (round-3 advisor
+        # finding: the old "any" tier did exactly that).
         with open(tmp_path / "onchip_records_r02.json", "w") as f:
-            json.dump({"records": [{"metric": "weird", "value": 3.0}]}, f)
-        rec, _, match = bench._newest_onchip_record("gmm")
-        assert rec["value"] == 3.0
-        assert match == "any"
+            json.dump({"records": [
+                {"metric": "weird", "value": 3.0},
+                {"config": "headline", "metric":
+                 "consensus k-sweep throughput (...)", "value": 2000.0},
+            ]}, f)
+        rec, source, match = bench._newest_onchip_record("gmm")
+        assert rec is None and source is None and match is None
 
     def test_no_files_returns_none(self, bench):
         rec, source, match = bench._newest_onchip_record("headline")
         assert rec is None and source is None and match is None
+
+    def test_legacy_minute_ran_at_loses_to_newer_seconds_format(
+            self, bench, tmp_path):
+        # Same minute, two formats: '...T12:34Z' (legacy) vs
+        # '...T12:34:50Z' (current).  Raw lexicographic compare would
+        # rank the LEGACY one newer ('Z' > ':'); the normalised key
+        # must pick the record that is actually newer in time.
+        with open(tmp_path / "onchip_records_r02.json", "w") as f:
+            json.dump({"records": [
+                {"config": "headline", "value": 1.0,
+                 "ran_at": "2026-07-30T12:34Z"},
+                {"config": "headline", "value": 2.0,
+                 "ran_at": "2026-07-30T12:34:50Z"},
+            ]}, f)
+        rec, _, _ = bench._newest_onchip_record("headline")
+        assert rec["value"] == 2.0
 
     def test_ran_at_beats_filename_order(self, bench, tmp_path):
         # Appends are pinned to one file; a newer-NAMED file holding an

@@ -16,6 +16,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -104,6 +106,7 @@ def _parse_result(stdout):
 
 
 class TestTwoProcessBootstrap:
+    @pytest.mark.slow
     def test_global_mesh_spans_processes_and_matches_single(self):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"

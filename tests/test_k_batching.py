@@ -1,6 +1,7 @@
 """K-batched sweeps: result parity and incremental checkpointing."""
 
 import numpy as np
+import pytest
 
 from consensus_clustering_tpu import ConsensusClustering
 
@@ -25,6 +26,7 @@ def _fit(x, **kw):
 
 
 class TestKBatching:
+    @pytest.mark.slow
     def test_batched_equals_unbatched(self, blobs):
         x, _ = blobs
         whole = _fit(x)
@@ -33,6 +35,7 @@ class TestKBatching:
         assert batched.metrics_["n_batches"] == 2
         assert batched.best_k_ == whole.best_k_
 
+    @pytest.mark.slow
     def test_batch_size_one(self, blobs):
         x, _ = blobs
         cc = _fit(x, k_batch_size=1)

@@ -1,0 +1,54 @@
+"""Row sharding must shrink the per-device O(N^2) memory plan.
+
+The design claim (parallel/sweep.py module docstring) is that the 'n'
+mesh axis divides the N x N consensus state across devices — the
+long-context analog (SURVEY.md §5.7).  Round 3 shipped the axis and its
+bit-exactness tests but no measurement of the plan actually shrinking;
+this test pins it via XLA's compile-time memory analysis (the same
+per-device plan bench.py records as ``compiled_memory_bytes``), without
+executing anything.  The auditor-facing sweep over 1/2/4/8 shards is
+``benchmarks/memory_scaling.py``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.sweep import (
+    _compiled_memory_stats,
+    build_sweep,
+)
+
+N = 2048  # N^2 f32 = 16.8 MB per matrix: dominates the small-H workspace
+
+
+def _plan(row_shards):
+    config = SweepConfig(
+        n_samples=N, n_features=16, k_values=(2, 3), n_iterations=8,
+        store_matrices=False,
+    )
+    mesh = resample_mesh(jax.devices()[:8], row_shards=row_shards)
+    sweep = build_sweep(KMeans(n_init=1), config, mesh)
+    x = jax.numpy.zeros((N, 16), jax.numpy.float32)
+    compiled = sweep.lower(x, jax.random.PRNGKey(0)).compile()
+    return _compiled_memory_stats(compiled)
+
+
+@pytest.mark.slow
+def test_row_sharding_divides_the_n_squared_plan():
+    full = _plan(row_shards=1)
+    sharded = _plan(row_shards=4)
+    assert full.get("temp_size_in_bytes", 0) > 0, full
+    # The N x N terms are (N/row_shards, N) blocks per device; at this
+    # shape they dominate the plan, so 4-way row sharding must cut the
+    # per-device temp commitment by well over 2x (linear would be 4x;
+    # the 'h'-sharded clustering workspace and fixed-size curves keep
+    # it from being exactly linear).
+    ratio = sharded["temp_size_in_bytes"] / full["temp_size_in_bytes"]
+    assert ratio < 0.5, (
+        f"temp plan only shrank to {ratio:.2f}x with row_shards=4 "
+        f"(full={full}, sharded={sharded})"
+    )

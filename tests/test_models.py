@@ -145,6 +145,29 @@ class TestAgglomerative:
         assert adjusted_rand_score(truth, agg) == 1.0
         assert adjusted_rand_score(agg, spec) == 1.0
 
+    def test_consensus_labels_spectral_kwargs_pass_through(self,
+                                                           monkeypatch):
+        # Round-3 advisor finding: n_init/lobpcg_iters were hard-coded
+        # in the spectral path; callers tuning the documented
+        # PAC-equivalent lobpcg_iters=32 had to bypass the function.
+        import consensus_clustering_tpu.models.spectral as spectral_mod
+
+        seen = {}
+        real = spectral_mod.SpectralClustering
+
+        def capture(**kwargs):
+            seen.update(kwargs)
+            return real(**kwargs)
+
+        monkeypatch.setattr(spectral_mod, "SpectralClustering", capture)
+        cij = np.eye(8, dtype=np.float32)
+        cij[:4, :4] = 1.0
+        cij[4:, 4:] = 1.0
+        consensus_labels_from_cij(
+            cij, 2, method="spectral", n_init=2, lobpcg_iters=32
+        )
+        assert seen["n_init"] == 2 and seen["lobpcg_iters"] == 32
+
     def test_consensus_labels_auto_switches_on_limit(self):
         cij = np.eye(8, dtype=np.float32)
         cij[:4, :4] = 1.0
@@ -215,6 +238,7 @@ class TestSpectral:
         )
         assert adjusted_rand_score(y, labels) > 0.99
 
+    @pytest.mark.slow
     def test_lobpcg_solver_matches_dense(self, blobs):
         # The large-subsample eigensolver (top-k block power iteration)
         # must recover the same clustering as the exact dense eigh path,

@@ -95,6 +95,7 @@ class TestGMMStatisticalParity:
     x64 must be set before JAX initialises, hence the subprocess.
     """
 
+    @pytest.mark.slow
     def test_gmm_pac_tracks_goldens_f64(self, goldens):
         import subprocess
         import sys

@@ -42,6 +42,7 @@ class TestSweepSingleDevice:
         assert np.all(out["pac_area"] >= -1e-6)
         assert out["timing"]["run_seconds"] > 0
 
+    @pytest.mark.slow
     def test_matches_oracle_end_to_end(self, blobs):
         # Given the engine's own labels/indices, Mij/Cij/PAC must equal the
         # NumPy oracle exactly (integer counts) / to f32 tolerance.
@@ -80,6 +81,7 @@ class TestSweepSingleDevice:
         assert "mij" not in out and "cij" not in out and "iij" not in out
         assert out["pac_area"].shape == (3,)
 
+    @pytest.mark.slow
     def test_cluster_batch_bit_identical(self, blobs):
         # Sub-batched clustering (lax.map over groups of the vmapped
         # while_loop) must be bit-identical to the single batch: a
