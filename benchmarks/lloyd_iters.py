@@ -1,0 +1,133 @@
+"""Count the sweep's lockstep Lloyd iterations for the roofline model.
+
+``roofline.py`` turns bytes/iteration into bytes via the number of
+lockstep Lloyd steps the compiled sweep actually executes — a
+data-dependent count that round 3 could only get from an xplane trace
+(headline: 753).  This script measures it directly: it rebuilds the
+EXACT lanes the sweep runs (same ``resample_indices`` plan, same
+``fold_in(key_cluster, k)`` re-seeding, same ``cluster_batch`` grouping
+— parallel/sweep.py:164-204, single-device path) and uses
+``KMeans.fit(..., return_stats=True)`` to read each lane's iteration
+count out of the while_loop state.
+
+A vmapped group of fits runs until its slowest lane converges (frozen
+lanes burn the same HBM traffic), so the number the traffic model needs
+per group is max(per-lane iterations) — summed over groups and K:
+
+    python benchmarks/lloyd_iters.py --config blobs10k
+
+Counts are exact for the backend they run on; across backends they can
+drift by a few steps (bf16-pass rounding differences shift convergence)
+— the output records the backend so roofline.py's provenance can say
+which kind of number it is.  On CPU the full blobs10k count is ~20-40
+minutes of compute (it is the sweep's whole clustering workload).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _REPO)
+
+
+def count(config_name, h_override=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # All shapes/tuning come from the SAME _build the bench runs (the
+    # cluster_batch grouping, n_sub, k range, n_init): a retuned knob
+    # in bench.py cannot silently desynchronise this count from the
+    # program it models (round-4 review finding).
+    from bench import _build
+    from consensus_clustering_tpu.ops.resample import resample_indices
+
+    km, config, x, _, _ = _build(config_name, small=False)
+    h = h_override or config.n_iterations
+    n_sub = config.n_sub
+    k_values = list(config.k_values)
+    k_max = config.k_max
+    batch = config.cluster_batch or h
+
+    xj = jnp.asarray(x)
+    key = jax.random.PRNGKey(23)                  # bench.py's seed
+    key_resample, key_cluster = jax.random.split(key)
+    indices = resample_indices(key_resample, config.n_samples, h, n_sub)
+    x_sub = xj[indices]                           # (h, n_sub, d)
+    # Group-count padding repeats lane 0, exactly like the sweep
+    # (parallel/sweep.py lax.map grouping): the padded lanes are REAL
+    # compute there (clustered redundantly, cropped after), so they
+    # join both the group max and the traffic-lane count here.
+    n_groups = -(-h // batch)
+    pad = n_groups * batch - h
+    if pad:
+        x_sub = jnp.concatenate(
+            [x_sub, jnp.broadcast_to(x_sub[:1], (pad,) + x_sub.shape[1:])]
+        )
+
+    @jax.jit
+    def group_iters(xs, k):
+        # (batch, n_init) iteration counts for one cluster_batch group;
+        # every lane shares the same key (reference re-seeding
+        # semantics, reseed_clusterer_per_resample=False).
+        key_k = jax.random.fold_in(key_cluster, k)
+        keys = jnp.broadcast_to(key_k, (xs.shape[0],) + key_k.shape)
+        _, _, iters = jax.vmap(
+            lambda kk, xg: km.fit(kk, xg, k, k_max, return_stats=True)
+        )(keys, xs)
+        return iters
+
+    totals = {}
+    grand = 0
+    lane_steps = 0   # sum of group_max * lanes_in_group: what traffic scales with
+    for k in k_values:
+        steps_k = 0
+        for g0 in range(0, n_groups * batch, batch):
+            iters = np.asarray(group_iters(
+                x_sub[g0:g0 + batch], jnp.int32(k)
+            ))
+            gmax = int(iters.max())               # lockstep: group max
+            steps_k += gmax
+            lane_steps += gmax * iters.size       # lanes incl. restarts
+        totals[k] = steps_k
+        grand += steps_k
+        print(f"K={k}: {steps_k} lockstep steps", file=sys.stderr)
+    return {
+        "config": config_name, "h": h, "cluster_batch": batch,
+        "backend": jax.default_backend(),
+        "lockstep_steps_per_k": totals,
+        "total_lockstep_steps": grand,
+        # Per-lane-equivalent step count: total bytes = lane_steps x
+        # (per-lane bytes/iteration); comparable to roofline.py's
+        # B_l x iters product for the ungrouped case.
+        "lane_steps": lane_steps,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="blobs10k",
+                   choices=["headline", "blobs10k"])
+    p.add_argument("--h", type=int, default=None,
+                   help="override H (full-H is the roofline-relevant "
+                        "count; smaller H underestimates group maxima)")
+    p.add_argument("--cpu", action="store_true",
+                   help="pin the CPU backend (avoids a wedged tunnel)")
+    args = p.parse_args(argv)
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    t0 = time.time()
+    out = count(args.config, args.h)
+    out["wall_seconds"] = round(time.time() - t0, 1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# One-shot on-chip evidence session for a recovered TPU tunnel.
+#
+# Runs, in an order that maximises value if the tunnel wedges again
+# mid-session:
+#   1. corr with a COLD persistent compilation cache, then again warm —
+#      the on-chip before/after PERF.md's cache section still lacks;
+#   2. the headline and blobs10k full benches (the two driver-facing
+#      throughput numbers; records append to onchip_records_r04.json);
+#   3. the remaining configs (blobs20k, agglo, spectral, gmm);
+#   4. a profiler trace of blobs10k (excluded from the records file by
+#      bench.py) for the Lloyd iteration count roofline.py's blobs10k
+#      phase model needs.
+#
+# Every bench.py invocation already self-arms init/run watchdogs and
+# preserves successful records, so a mid-session wedge loses only the
+# steps not yet reached.  Usage:  bash benchmarks/onchip_session.sh
+
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+OUT=benchmarks/onchip_session_${STAMP}
+mkdir -p "$OUT"
+CACHE="$OUT/xla-cache-cold"
+
+run() {
+  name=$1; shift
+  echo "=== $name: $*" | tee -a "$OUT/session.log"
+  # timeout(1) backstops steps that have no self-arming watchdogs
+  # (lloyd_iters.py): a re-wedged tunnel must cost one step, not the
+  # whole session.
+  BENCH_SUPERVISED=1 BENCH_INIT_TIMEOUT=240 BENCH_TOTAL_TIMEOUT=1500 \
+    timeout 1800 "$@" > "$OUT/$name.json" 2>> "$OUT/session.log"
+  rc=$?
+  echo "=== $name rc=$rc" | tee -a "$OUT/session.log"
+  tail -c 400 "$OUT/$name.json" | tee -a "$OUT/session.log"
+}
+
+# 1. cache before/after on chip (cold dir private to this session)
+CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_cold python bench.py --config corr
+CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_warm python bench.py --config corr
+
+# 2. driver-facing throughput numbers
+run headline python bench.py
+run blobs10k python bench.py --config blobs10k
+
+# 3. the rest
+run blobs20k python bench.py --config blobs20k
+run agglo    python bench.py --config agglo
+run spectral python bench.py --config spectral
+run gmm      python bench.py --config gmm
+
+# 4. blobs10k phase trace (slower through the tunnel; records untouched)
+run blobs10k_trace python bench.py --config blobs10k --repeats 1 \
+    --profile-dir "$OUT/blobs10k_trace"
+
+# 5. exact on-chip Lloyd lockstep counts for roofline.py
+run lloyd_iters_blobs10k python benchmarks/lloyd_iters.py --config blobs10k
+run lloyd_iters_headline python benchmarks/lloyd_iters.py --config headline
+
+echo "session artifacts in $OUT"

@@ -155,8 +155,17 @@ class KMeans:
         x: jax.Array,
         k: jax.Array,
         k_max: Optional[int] = None,
+        return_stats: bool = False,
     ):
-        """Run best-of-n_init KMeans; returns (labels, centroids)."""
+        """Run best-of-n_init KMeans; returns (labels, centroids).
+
+        ``return_stats=True`` appends the per-restart Lloyd iteration
+        counts ((n_init,) int32; scalar shape () for n_init=1) — the
+        observability hook the roofline model's traffic accounting
+        needs (benchmarks/lloyd_iters.py): under vmap a group of fits
+        runs lockstep for max(iterations) steps, so the counts, not the
+        wall-clock, are what turns bytes/iteration into bytes.
+        """
         if k_max is None:
             k_max = int(k)
         # Work in the input's float dtype (f32 default; f64 for the
@@ -273,19 +282,25 @@ class KMeans:
                 return new_centroids, shift, it + 1
 
             init = (centroids, inf, jnp.int32(0))
-            centroids, _, _ = jax.lax.while_loop(
+            centroids, _, iters = jax.lax.while_loop(
                 cond, kernel_body if use_kernel else body, init
             )
             d = masked_dist(centroids)
             labels = jnp.argmin(d, axis=1).astype(jnp.int32)
             inertia = jnp.sum(jnp.min(d, axis=1))
-            return labels, centroids, inertia
+            return labels, centroids, inertia, iters
 
         if self.n_init == 1:
-            labels, centroids, _ = one_restart(key)
+            labels, centroids, _, iters = one_restart(key)
+            if return_stats:
+                return labels, centroids, iters
             return labels, centroids
 
         keys = jax.random.split(key, self.n_init)
-        labels_b, centroids_b, inertia_b = jax.vmap(one_restart)(keys)
+        labels_b, centroids_b, inertia_b, iters_b = jax.vmap(one_restart)(
+            keys
+        )
         best = jnp.argmin(inertia_b)
+        if return_stats:
+            return labels_b[best], centroids_b[best], iters_b
         return labels_b[best], centroids_b[best]

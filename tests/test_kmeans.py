@@ -126,3 +126,37 @@ class TestKMeans:
             return total
 
         assert inertia(ours) <= inertia(sk.labels_) * 1.05
+
+
+class TestFitStats:
+    def test_return_stats_counts_iterations(self, blobs):
+        import jax.numpy as jnp
+
+        x, _ = blobs
+        xj = jnp.asarray(x)
+        km = KMeans(n_init=3, max_iter=50)
+        labels, centroids, iters = km.fit(
+            jax.random.PRNGKey(0), xj, 3, 3, return_stats=True
+        )
+        iters = np.asarray(iters)
+        assert iters.shape == (3,)
+        assert np.all(iters >= 1) and np.all(iters <= 50)
+        # The stats channel must not perturb the fit itself.
+        base_labels, base_centroids = KMeans(n_init=3, max_iter=50).fit(
+            jax.random.PRNGKey(0), xj, 3, 3
+        )
+        np.testing.assert_array_equal(np.asarray(labels),
+                                      np.asarray(base_labels))
+        np.testing.assert_array_equal(np.asarray(centroids),
+                                      np.asarray(base_centroids))
+
+    def test_single_init_scalar_stats(self, blobs):
+        import jax.numpy as jnp
+
+        x, _ = blobs
+        _, _, iters = KMeans(n_init=1).fit(
+            jax.random.PRNGKey(1), jnp.asarray(x), 3, 3,
+            return_stats=True,
+        )
+        assert np.asarray(iters).shape == ()
+        assert 1 <= int(iters) <= 100
