@@ -42,10 +42,19 @@ def count(config_name, h_override=None):
     # cluster_batch grouping, n_sub, k range, n_init): a retuned knob
     # in bench.py cannot silently desynchronise this count from the
     # program it models (round-4 review finding).
-    from bench import _build
+    from bench import SEED, _build
     from consensus_clustering_tpu.ops.resample import resample_indices
+    from consensus_clustering_tpu.parallel.sweep import pad_to_lane_groups
 
     km, config, x, _, _ = _build(config_name, small=False)
+    # The broadcast-key replication below encodes the reference
+    # re-seeding semantics; a config built with per-resample streams
+    # would make these counts describe different lanes than the sweep's.
+    assert not config.reseed_clusterer_per_resample, (
+        "lloyd_iters replicates the broadcast-key (reference) semantics "
+        "only; teach it the fold_in-per-lane branch before counting a "
+        "reseed_clusterer_per_resample config"
+    )
     h = h_override or config.n_iterations
     n_sub = config.n_sub
     k_values = list(config.k_values)
@@ -53,20 +62,16 @@ def count(config_name, h_override=None):
     batch = config.cluster_batch or h
 
     xj = jnp.asarray(x)
-    key = jax.random.PRNGKey(23)                  # bench.py's seed
+    key = jax.random.PRNGKey(SEED)                # bench.py's seed
     key_resample, key_cluster = jax.random.split(key)
     indices = resample_indices(key_resample, config.n_samples, h, n_sub)
     x_sub = xj[indices]                           # (h, n_sub, d)
-    # Group-count padding repeats lane 0, exactly like the sweep
-    # (parallel/sweep.py lax.map grouping): the padded lanes are REAL
+    # Group-count padding repeats lane 0 via the sweep's OWN helper
+    # (parallel/sweep.py pad_to_lane_groups): the padded lanes are REAL
     # compute there (clustered redundantly, cropped after), so they
     # join both the group max and the traffic-lane count here.
     n_groups = -(-h // batch)
-    pad = n_groups * batch - h
-    if pad:
-        x_sub = jnp.concatenate(
-            [x_sub, jnp.broadcast_to(x_sub[:1], (pad,) + x_sub.shape[1:])]
-        )
+    x_sub = pad_to_lane_groups(x_sub, batch)
 
     @jax.jit
     def group_iters(xs, k):

@@ -46,11 +46,10 @@ from make_goldens import (  # noqa: E402
 # The shared shape table + blob generator: the baseline is only
 # meaningful at EXACTLY the shape the on-chip run uses, so both sides
 # read bench.py's FULL_SHAPES instead of keeping copies in sync by hand.
-from bench import FULL_SHAPES, _blobs  # noqa: E402
+from bench import FULL_SHAPES, SEED, _blobs  # noqa: E402
 
 CONFIGS_JSON = os.path.join(os.path.dirname(__file__),
                             "baseline_cpu_configs.json")
-SEED = 23
 
 
 def _blobs64(n, d):

@@ -9,8 +9,11 @@
 #      throughput numbers; records append to onchip_records_r04.json);
 #   3. the remaining configs (blobs20k, agglo, spectral, gmm);
 #   4. a profiler trace of blobs10k (excluded from the records file by
-#      bench.py) for the Lloyd iteration count roofline.py's blobs10k
-#      phase model needs.
+#      bench.py) for the PHASE-second split roofline.py still lacks at
+#      this shape (the Lloyd iteration count itself comes from step 5,
+#      which is faster and more exact);
+#   5. exact on-chip Lloyd lockstep counts (lloyd_iters.py), replacing
+#      the CPU-derived estimate in lloyd_iters_blobs10k_cpu.json.
 #
 # Every bench.py invocation already self-arms init/run watchdogs and
 # preserves successful records, so a mid-session wedge loses only the

@@ -75,10 +75,11 @@ HIGHEST_PASSES = 6      # f32-accurate matmul = 6 bf16 MXU passes
 
 # Measured, with provenance.  Phase seconds: xplane trace of the
 # round-3 headline run (PERF.md "Where the time goes"; bench.py
-# --profile-dir).  lloyd_iters: fixed-point iteration count from the
-# same trace (data-dependent — it is the sweep-wide total of while_loop
-# steps across all K values and cluster_batch groups).  Walls: the
-# round-3/4 bench records (onchip_records_*.json).
+# --profile-dir).  lloyd_lane_steps: the lane-weighted fixed-point step
+# count (sum over lockstep steps of how many lanes move in that step) —
+# from the same trace for headline, from benchmarks/lloyd_iters.py for
+# grouped configs.  Walls: the round-3/4 bench records
+# (onchip_records_*.json).
 MEASURED = {
     "headline": {
         # Phase times and the 5.33 s device total are from ONE run: the
@@ -92,7 +93,13 @@ MEASURED = {
             "coassoc+hist": 0.58,
         },
         "traced_device_total": 5.33,
-        "lloyd_iters": 753,
+        # The r3 trace predates cluster_batch: one vmapped batch of
+        # B_l = H*n_init = 1500 lanes per K, 753 lockstep steps across
+        # the sweep -> lane-weighted steps = 753 * 1500.  (With
+        # cluster_batch=16 the lockstep step count is higher but each
+        # step moves only a group's worth of lanes; benchmarks/
+        # lloyd_iters.py measures that case directly.)
+        "lloyd_lane_steps": 753 * 1500,
         # Separate run, separate use: the fastest UNinstrumented wall
         # (onchip_records_r03.json best-of-3).  Only compared against
         # the shape-derived floor band, never against phase times.
@@ -101,20 +108,30 @@ MEASURED = {
                       "onchip_records_r03.json (best-of-3 record wall)",
     },
     "blobs10k": {
-        # No phase trace captured at this shape yet (tunnel-budget);
-        # the model still bounds the total from below.
+        # No phase trace at this shape yet; the Lloyd count instead
+        # comes from benchmarks/lloyd_iters.py on the CPU backend
+        # (exact lane replication of the compiled sweep): H=200 all-K
+        # measurement x 5.052 empirical full-H scaling, validated on
+        # the K<=9 full-H overlap (lloyd_iters_blobs10k_cpu.json).
+        # CPU-derived: on-chip counts can differ by a few steps/group
+        # (bf16-pass rounding); onchip_session.sh step 5 refreshes it.
         "phase_seconds": {},
         "traced_device_total": None,
-        "lloyd_iters": None,
+        "lloyd_lane_steps": 2_119_603,
         "record_wall": 19000 / 1060.3,
-        "provenance": "onchip_records_r03.json (no phase trace)",
+        "provenance": "onchip_records_r03.json (wall) + "
+                      "lloyd_iters_blobs10k_cpu.json (CPU-derived "
+                      "Lloyd count)",
     },
 }
 
 
-def phases(config_name, lloyd_iters):
+def phases(config_name, lloyd_lane_steps):
     """Returns [(phase, flops_math, mxu_passes_mult, bytes_lo, bytes_hi,
-    formula_note)] from shapes alone (+ the measured iteration count)."""
+    formula_note)] from shapes alone (+ the measured lane-weighted Lloyd
+    step count: sum over lockstep steps of the lanes moving in that
+    step — B_l * iters for an ungrouped batch, lloyd_iters.py's
+    ``lane_steps`` under cluster_batch grouping)."""
     fs = FULL_SHAPES[config_name]
     n, d, h = fs["n"], fs["d"], fs["h"]
     n_init = fs["n_init"]
@@ -129,18 +146,19 @@ def phases(config_name, lloyd_iters):
     chunk = fs["chunk"]
 
     out = []
-    if lloyd_iters is not None:
-        # Assign + update per iteration; iteration count is measured.
-        flops = 2 * 2 * b_l * n_sub * d * k_max * lloyd_iters
-        x_bytes = b_l * n_sub * d * 4
-        dist_bytes = b_l * n_sub * k_max * 4
-        lo = 2 * x_bytes * lloyd_iters          # x streamed twice/iter
-        hi = (2 * x_bytes + 2 * dist_bytes) * lloyd_iters
+    if lloyd_lane_steps is not None:
+        # Assign + update per lane-step; the count is measured.
+        flops = 2 * 2 * n_sub * d * k_max * lloyd_lane_steps
+        x_lane = n_sub * d * 4
+        dist_lane = n_sub * k_max * 4
+        lo = 2 * x_lane * lloyd_lane_steps      # x streamed twice/step
+        hi = (2 * x_lane + 2 * dist_lane) * lloyd_lane_steps
         out.append((
             "lloyd (assign+update)", flops, HIGHEST_PASSES, lo, hi,
-            f"2 GEMMs x 2*B_l*n_sub*d*k_max x {lloyd_iters} iters; "
-            f"lo: 2 x-reads ({x_bytes/1e9:.2f} GB)/iter; hi: + dist "
-            f"block ({dist_bytes/1e9:.2f} GB) RW if unfused",
+            f"2 GEMMs x 2*n_sub*d*k_max x {lloyd_lane_steps} "
+            f"lane-steps; lo: 2 x-reads ({x_lane/1e6:.1f} MB/lane)/"
+            f"step; hi: + dist block ({dist_lane/1e6:.2f} MB/lane) RW "
+            "if unfused",
         ))
     # k-means++: steps = B_l * sum(K-1) over the sweep (traced-K loop).
     steps = b_l * sum(k - 1 for k in k_values)
@@ -175,7 +193,7 @@ def phases(config_name, lloyd_iters):
 
 def report(config_name):
     meas = MEASURED[config_name]
-    rows = phases(config_name, meas["lloyd_iters"])
+    rows = phases(config_name, meas["lloyd_lane_steps"])
     ph_secs = meas["phase_seconds"]
     print(f"\n### {config_name} (measured: {meas['provenance']})\n")
     print("| phase | math FLOPs | MXU-pass FLOPs | bytes lo-hi | "
@@ -238,7 +256,7 @@ def report(config_name):
              if floor_lo_total <= wall <= floor_hi_total else
              f"{100 * floor_lo_total / wall:.0f}% of the irreducible-"
              "traffic floor")
-          + ("" if meas["lloyd_iters"] else
+          + ("" if meas["lloyd_lane_steps"] else
              " (Lloyd phase unmodelled: no iteration count without a "
              "trace, so the floor here covers init+coassoc+hist only)"))
 

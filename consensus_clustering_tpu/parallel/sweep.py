@@ -64,6 +64,25 @@ from consensus_clustering_tpu.parallel.mesh import (
 )
 
 
+def pad_to_lane_groups(arr: jax.Array, batch: int) -> jax.Array:
+    """Pad axis 0 to a multiple of ``batch`` by repeating lane 0.
+
+    The ``cluster_batch`` grouping's padding rule, shared with
+    ``benchmarks/lloyd_iters.py`` (which replicates the sweep's lanes to
+    count Lloyd iterations): the padded lanes are REAL compute in the
+    ``lax.map`` grouping — clustered redundantly and cropped after — so
+    any tool modelling the sweep's work must pad the same way, and
+    having one implementation makes silent divergence impossible.
+    """
+    n = arr.shape[0]
+    pad = -(-n // batch) * batch - n
+    if not pad:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.broadcast_to(arr[:1], (pad,) + arr.shape[1:])]
+    )
+
+
 def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mesh] = None):
     """Return a jitted ``sweep(x, key) -> dict`` over the given mesh.
 
@@ -186,14 +205,8 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 # lockstep waste reduced, groups serialised.  Group-count
                 # padding repeats row 0 (clustered redundantly, cropped).
                 n_groups = -(-local_h // batch)
-                pad = n_groups * batch - local_h
-                keys_g = jnp.concatenate(
-                    [keys, jnp.broadcast_to(keys[:1], (pad,) + keys.shape[1:])]
-                ) if pad else keys
-                x_g = jnp.concatenate(
-                    [x_sub, jnp.broadcast_to(
-                        x_sub[:1], (pad,) + x_sub.shape[1:])]
-                ) if pad else x_sub
+                keys_g = pad_to_lane_groups(keys, batch)
+                x_g = pad_to_lane_groups(x_sub, batch)
                 labels_g = jax.lax.map(
                     lambda args: fit_batch(*args),
                     (
