@@ -1,0 +1,139 @@
+"""A/B probe: does capping Lloyd's max_iter pay at the blobs10k shape?
+
+The round-4 iteration counts (lloyd_iters_blobs10k_cpu.json) show 94%
+of the sweep's Lloyd lane-steps are spent at K>=8 — past the generated
+data's 8 true clusters, where convergence slows ~7x.  The
+``--cpu-experiment`` mode (runnable anywhere, pins the CPU backend)
+reproduces the sensitivity study behind PERF.md's "Remaining headroom"
+entry: at a related shape (blobs N=1500 d=20, 8 centers, K=2..12,
+H=60) PAC is BIT-IDENTICAL with max_iter=25 vs the default 100, and
+best_k stable even at max_iter=10 — late Lloyd iterations move
+centroids within tol without changing labels, and the consensus counts
+only see labels.
+
+This probe runs the full blobs10k sweep with ``KMeans(max_iter=<cap>)``
+so the tradeoff can be measured ON CHIP at the real shape before anyone
+pins a cap: compare the printed rate and pac_head against the default
+run's preserved record (onchip_records_*.json: 1060.3 r/s, pac_head
+0.156/0.156/0.130).  The cap stays a user knob
+(``clusterer_options={'max_iter': ...}``) unless that comparison shows
+identical PAC — never a silent bench default, because the measured
+serial baseline ran sklearn's own default (max_iter=300).
+
+    python benchmarks/maxiter_probe.py --max-iter 25 [--config blobs10k]
+    python benchmarks/maxiter_probe.py --cpu-experiment
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _REPO)
+
+
+def _arm_watchdogs():
+    """The same env-driven init/total watchdogs bench.py arms: this
+    script calls run_sweep directly, and on a wedged tunnel a bounded
+    abort (rc=3/4, matching bench.py's codes) beats hanging the
+    on-chip session's step budget."""
+    import threading
+
+    def arm(env_var, default, message, code):
+        try:
+            t = float(os.environ.get(env_var, str(default)))
+        except ValueError:
+            t = float(default)
+        ev = threading.Event()
+        if t > 0:
+            def watch():
+                if not ev.wait(timeout=t):
+                    print(f"maxiter_probe: {message} after {t:.0f}s",
+                          file=sys.stderr, flush=True)
+                    os._exit(code)
+            threading.Thread(target=watch, daemon=True).start()
+        return ev
+
+    ready = arm("BENCH_INIT_TIMEOUT", 240, "backend init hung", 3)
+    done = arm("BENCH_TOTAL_TIMEOUT", 1500, "run wedged mid-flight", 4)
+    return ready, done
+
+
+def cpu_experiment():
+    """PAC sensitivity to the Lloyd max_iter cap, CPU-reproducible."""
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from sklearn.datasets import make_blobs
+
+    from consensus_clustering_tpu import ConsensusClustering, KMeans
+
+    # Same generator family as the bench configs (8 centers, std 3.0)
+    # at a CPU-tractable shape; K sweeps past the true cluster count
+    # like blobs10k's K=2..20 does.
+    x, _ = make_blobs(n_samples=1500, n_features=20, centers=8,
+                      cluster_std=3.0, random_state=0)
+    x = x.astype(np.float32)
+    out = {}
+    for max_iter in (100, 50, 25, 10):
+        t0 = time.perf_counter()
+        cc = ConsensusClustering(
+            clusterer=KMeans(max_iter=max_iter),
+            clusterer_options={"n_init": 3},
+            K_range=range(2, 13), random_state=23, n_iterations=60,
+            plot_cdf=False, progress=False)
+        cc.fit(x)
+        pac = [round(float(cc.cdf_at_K_data[k]["pac_area"]), 5)
+               for k in range(2, 13)]
+        out[max_iter] = {"pac": pac, "best_k": cc.best_k_,
+                         "wall_seconds": round(time.perf_counter() - t0, 1)}
+        print(f"max_iter={max_iter}: best_k={cc.best_k_}",
+              file=sys.stderr, flush=True)
+    base = out[100]["pac"]
+    for mi in out:
+        out[mi]["max_pac_delta_vs_100"] = round(
+            max(abs(a - b) for a, b in zip(out[mi]["pac"], base)), 5)
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="blobs10k",
+                   choices=["headline", "blobs10k"])
+    p.add_argument("--max-iter", type=int, default=25)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--cpu-experiment", action="store_true",
+                   help="run the small-shape PAC-sensitivity study "
+                        "instead of the full-shape probe")
+    args = p.parse_args(argv)
+    if args.cpu_experiment:
+        return cpu_experiment()
+
+    _arm_watchdogs()
+
+    from bench import SEED, _build
+    from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+    km, config, x, metric, _ = _build(args.config, small=False)
+    km_capped = dataclasses.replace(km, max_iter=args.max_iter)
+    out = run_sweep(km_capped, config, x, seed=SEED,
+                    repeats=max(1, args.repeats))
+    print(json.dumps({
+        "metric": f"{metric} [max_iter={args.max_iter} probe]",
+        "value": round(out["timing"]["resamples_per_second"], 2),
+        "unit": "resamples/sec",
+        "compile_seconds": round(out["timing"]["compile_seconds"], 2),
+        "pac_head": [round(float(v), 5) for v in out["pac_area"][:3]],
+        "pac_all": [round(float(v), 5) for v in out["pac_area"]],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,7 +30,8 @@ run() {
   name=$1; shift
   echo "=== $name: $*" | tee -a "$OUT/session.log"
   # timeout(1) backstops steps that have no self-arming watchdogs
-  # (lloyd_iters.py): a re-wedged tunnel must cost one step, not the
+  # (lloyd_iters.py; bench.py and maxiter_probe.py arm their own from
+  # the BENCH_* vars): a re-wedged tunnel must cost one step, not the
   # whole session.
   BENCH_SUPERVISED=1 BENCH_INIT_TIMEOUT=240 BENCH_TOTAL_TIMEOUT=1500 \
     timeout 1800 "$@" > "$OUT/$name.json" 2>> "$OUT/session.log"
@@ -60,5 +61,10 @@ run blobs10k_trace python bench.py --config blobs10k --repeats 1 \
 # 5. exact on-chip Lloyd lockstep counts for roofline.py
 run lloyd_iters_blobs10k python benchmarks/lloyd_iters.py --config blobs10k
 run lloyd_iters_headline python benchmarks/lloyd_iters.py --config headline
+
+# 6. the max_iter cap A/B at the real shape (94% of blobs10k Lloyd
+#    steps are beyond-elbow; a CPU experiment found PAC bit-identical
+#    at max_iter=25 — benchmarks/maxiter_probe.py docstring)
+run maxiter25_blobs10k python benchmarks/maxiter_probe.py --max-iter 25
 
 echo "session artifacts in $OUT"
