@@ -208,6 +208,39 @@ def _build(config_name, small):
         )
 
 
+def _arm_watchdog(env_var, default, message, exit_code, prog="bench"):
+    """Daemon thread that os._exit(exit_code)s unless the returned event
+    is set within the env-configured timeout (<= 0 disables).
+
+    Module-level so harness-side scripts that call run_sweep directly
+    (benchmarks/maxiter_probe.py) arm the SAME watchdogs with the same
+    env contract instead of keeping drifted copies — the caller must
+    .set() the returned event once the guarded stage completes, or the
+    watchdog kills the process with a message blaming that stage.
+    """
+    import threading
+
+    try:
+        timeout = float(os.environ.get(env_var, str(default)))
+    except ValueError:
+        timeout = float(default)
+    event = threading.Event()
+
+    def _watch():
+        if not event.wait(timeout=timeout):
+            import sys
+
+            print(
+                f"{prog}: {message} after {timeout:.0f}s; aborting",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(exit_code)
+
+    if timeout > 0:
+        threading.Thread(target=_watch, daemon=True).start()
+    return event
+
+
 _RECORDS_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "benchmarks"
 )
@@ -400,31 +433,6 @@ def main(argv=None):
     # wedge mid-run (observed: a killed client leaves the remote claim
     # stuck and subsequent device ops block forever).  A bounded failure
     # with a clear message beats hanging the driver either way.
-    import threading
-
-    def _arm_watchdog(env_var, default, message, exit_code):
-        """Daemon thread that os._exit(exit_code)s unless the returned
-        event is set within the env-configured timeout (<= 0 disables)."""
-        try:
-            timeout = float(os.environ.get(env_var, str(default)))
-        except ValueError:
-            timeout = float(default)
-        event = threading.Event()
-
-        def _watch():
-            if not event.wait(timeout=timeout):
-                import sys
-
-                print(
-                    f"bench: {message} after {timeout:.0f}s; aborting",
-                    file=sys.stderr, flush=True,
-                )
-                os._exit(exit_code)
-
-        if timeout > 0:
-            threading.Thread(target=_watch, daemon=True).start()
-        return event
-
     ready = _arm_watchdog(
         "BENCH_INIT_TIMEOUT", 240, "backend init hung (tunnel wedged?)", 3
     )

@@ -34,33 +34,6 @@ _REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 sys.path.insert(0, _REPO)
 
 
-def _arm_watchdogs():
-    """The same env-driven init/total watchdogs bench.py arms: this
-    script calls run_sweep directly, and on a wedged tunnel a bounded
-    abort (rc=3/4, matching bench.py's codes) beats hanging the
-    on-chip session's step budget."""
-    import threading
-
-    def arm(env_var, default, message, code):
-        try:
-            t = float(os.environ.get(env_var, str(default)))
-        except ValueError:
-            t = float(default)
-        ev = threading.Event()
-        if t > 0:
-            def watch():
-                if not ev.wait(timeout=t):
-                    print(f"maxiter_probe: {message} after {t:.0f}s",
-                          file=sys.stderr, flush=True)
-                    os._exit(code)
-            threading.Thread(target=watch, daemon=True).start()
-        return ev
-
-    ready = arm("BENCH_INIT_TIMEOUT", 240, "backend init hung", 3)
-    done = arm("BENCH_TOTAL_TIMEOUT", 1500, "run wedged mid-flight", 4)
-    return ready, done
-
-
 def cpu_experiment():
     """PAC sensitivity to the Lloyd max_iter cap, CPU-reproducible."""
     import time
@@ -115,15 +88,31 @@ def main(argv=None):
     if args.cpu_experiment:
         return cpu_experiment()
 
-    _arm_watchdogs()
+    # bench.py's own watchdogs, same env contract and exit codes: the
+    # init one is disarmed once the backend answers, the run one when
+    # the sweep returns — a wedged tunnel costs a bounded rc=3/4, not
+    # the on-chip session's whole step budget.
+    from bench import SEED, _arm_watchdog, _build
 
-    from bench import SEED, _build
+    ready = _arm_watchdog("BENCH_INIT_TIMEOUT", 240,
+                          "backend init hung (tunnel wedged?)", 3,
+                          prog="maxiter_probe")
+    done = _arm_watchdog("BENCH_TOTAL_TIMEOUT", 1800,
+                         "run wedged mid-flight", 4,
+                         prog="maxiter_probe")
+
+    import jax
+
+    jax.default_backend()
+    ready.set()
+
     from consensus_clustering_tpu.parallel.sweep import run_sweep
 
     km, config, x, metric, _ = _build(args.config, small=False)
     km_capped = dataclasses.replace(km, max_iter=args.max_iter)
     out = run_sweep(km_capped, config, x, seed=SEED,
                     repeats=max(1, args.repeats))
+    done.set()
     print(json.dumps({
         "metric": f"{metric} [max_iter={args.max_iter} probe]",
         "value": round(out["timing"]["resamples_per_second"], 2),
