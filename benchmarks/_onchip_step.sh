@@ -1,0 +1,47 @@
+# Shared step runner for the on-chip evidence scripts.  Source after
+# setting OUT (artifact dir); both onchip_session.sh and onchip_retry.sh
+# use these so the watchdog env contract cannot drift between them.
+#
+#   log <msg>            append to $OUT/session.log and echo
+#   step <name> <cmd...> run one step under the bench watchdog contract:
+#                        BENCH_SUPERVISED=1 (the script, not bench.py's
+#                        supervisor, owns retries), a 240s init watchdog,
+#                        a 1500s total watchdog, and timeout(1) at 1800s
+#                        as the backstop for tools without self-arming
+#                        watchdogs (lloyd_iters.py).  stdout lands in
+#                        $OUT/<name>.json; a success writes
+#                        $OUT/<name>.done and is never re-run; after
+#                        STEP_FAIL_CAP failures (default 3) the step is
+#                        abandoned (rc 0, .gave_up marker) so one
+#                        deterministically-failing step cannot starve
+#                        the steps queued after it.
+
+STEP_FAIL_CAP=${STEP_FAIL_CAP:-3}
+
+log() { echo "$*" | tee -a "$OUT/session.log"; }
+
+step() {
+  name=$1; shift
+  [ -f "$OUT/$name.done" ] && return 0
+  if [ -f "$OUT/$name.gave_up" ]; then
+    return 0
+  fi
+  log "=== $name: $* ($(date -u +%FT%TZ))"
+  BENCH_SUPERVISED=1 BENCH_INIT_TIMEOUT=240 BENCH_TOTAL_TIMEOUT=1500 \
+    timeout 1800 "$@" > "$OUT/$name.json" 2>> "$OUT/session.log"
+  rc=$?
+  log "=== $name rc=$rc"
+  tail -c 400 "$OUT/$name.json" >> "$OUT/session.log" 2>/dev/null
+  if [ $rc -eq 0 ] && [ -s "$OUT/$name.json" ]; then
+    touch "$OUT/$name.done"
+    return 0
+  fi
+  fails=$(( $(cat "$OUT/$name.fails" 2>/dev/null || echo 0) + 1 ))
+  echo "$fails" > "$OUT/$name.fails"
+  if [ "$fails" -ge "$STEP_FAIL_CAP" ]; then
+    log "=== $name: abandoned after $fails failures; later steps proceed"
+    touch "$OUT/$name.gave_up"
+    return 0
+  fi
+  return 1
+}

@@ -22,23 +22,16 @@
 set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date -u +%Y%m%dT%H%M%S)
-OUT=benchmarks/onchip_session_${STAMP}
+OUT=${ONCHIP_SESSION_DIR:-benchmarks/onchip_session_${STAMP}}
 mkdir -p "$OUT"
 CACHE="$OUT/xla-cache-cold"
 
-run() {
-  name=$1; shift
-  echo "=== $name: $*" | tee -a "$OUT/session.log"
-  # timeout(1) backstops steps that have no self-arming watchdogs
-  # (lloyd_iters.py; bench.py and maxiter_probe.py arm their own from
-  # the BENCH_* vars): a re-wedged tunnel must cost one step, not the
-  # whole session.
-  BENCH_SUPERVISED=1 BENCH_INIT_TIMEOUT=240 BENCH_TOTAL_TIMEOUT=1500 \
-    timeout 1800 "$@" > "$OUT/$name.json" 2>> "$OUT/session.log"
-  rc=$?
-  echo "=== $name rc=$rc" | tee -a "$OUT/session.log"
-  tail -c 400 "$OUT/$name.json" | tee -a "$OUT/session.log"
-}
+# Step runner (watchdog env contract + per-step markers) shared with
+# onchip_retry.sh: benchmarks/_onchip_step.sh.  step() ignores a step
+# whose .done marker exists, so re-running the script into the same
+# ONCHIP_SESSION_DIR resumes where a wedge cut it off.
+. benchmarks/_onchip_step.sh
+run() { step "$@" || true; }
 
 # 1. cache before/after on chip (cold dir private to this session)
 CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_cold python bench.py --config corr
