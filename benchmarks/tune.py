@@ -73,6 +73,12 @@ def main(argv=None):
         "knobs interact, so re-tune chunk_size after pinning a "
         "cluster_batch)",
     )
+    parser.add_argument(
+        "--split-init", action="store_true",
+        help="compute k-means++ inits outside the cluster_batch groups "
+        "(SweepConfig.split_init); an A/B against the default needs "
+        "identical remaining knobs",
+    )
     parser.add_argument("--seed", type=int, default=23)
     parser.add_argument(
         "--use-pallas", choices=("auto", "on", "off"), default="auto",
@@ -132,6 +138,7 @@ def main(argv=None):
             use_pallas={"auto": None, "on": True, "off": False}[
                 args.use_pallas
             ],
+            split_init=args.split_init,
         )
         if knob == "chunk_size":
             kwargs["chunk_size"] = value
@@ -162,6 +169,7 @@ def main(argv=None):
             "config": {
                 "n": args.n, "d": args.d, "h": args.h, "k_hi": args.k_hi,
                 "seed": args.seed, "use_pallas": args.use_pallas,
+                "split_init": args.split_init,
                 **(
                     {"chunk_size": args.chunk_size}
                     if knob == "cluster_batch"

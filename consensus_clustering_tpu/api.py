@@ -128,6 +128,11 @@ class ConsensusClustering:
         groups let each sub-batch's Lloyd loop stop at its own slowest
         member instead of the sweep-wide slowest — bit-identical labels,
         less lockstep waste, serialised groups (see SweepConfig).
+    split_init : bool, keyword-only
+        With ``cluster_batch`` set and the native KMeans clusterer,
+        compute every lane's k-means++ init outside the sub-batch groups
+        in one full-width vmapped pass and group only the Lloyd loop —
+        bit-identical labels, full-size init GEMMs (see SweepConfig).
     compute_consensus_labels : bool, keyword-only
         Opt-in consensus labels via agglomerative clustering on 1 - Cij
         (the reference's dead code path Q5, done properly).
@@ -196,6 +201,7 @@ class ConsensusClustering:
         bins: int = 20,
         chunk_size: int = 8,
         cluster_batch: Optional[int] = None,
+        split_init: bool = False,
         compute_consensus_labels: bool = False,
         reseed_clusterer_per_resample: bool = False,
         checkpoint_dir: Optional[str] = None,
@@ -257,6 +263,7 @@ class ConsensusClustering:
         self.bins = bins
         self.chunk_size = chunk_size
         self.cluster_batch = cluster_batch
+        self.split_init = split_init
         self.compute_consensus_labels = compute_consensus_labels
         self.reseed_clusterer_per_resample = reseed_clusterer_per_resample
         self.checkpoint_dir = checkpoint_dir
@@ -372,6 +379,7 @@ class ConsensusClustering:
             store_matrices=self._resolve_store_matrices(n),
             chunk_size=self.chunk_size,
             cluster_batch=self.cluster_batch,
+            split_init=self.split_init,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
             use_pallas=self.use_pallas,
             dtype=self.compute_dtype,

@@ -49,6 +49,17 @@ class SweepConfig:
         silently stop sub-batching on a wider mesh.  Tune on chip at
         the deployment mesh; keep cluster_batch * n_init problems large
         enough to fill the MXU.
+      split_init: with ``cluster_batch`` set and a clusterer exposing
+        ``init_centroids`` (native KMeans), compute every lane's init
+        OUTSIDE the ``lax.map`` groups in one full-width vmapped batch
+        and group only the Lloyd ``while_loop``.  The greedy k-means++
+        init has a k-determined trip count — identical for every lane
+        of the same K — so grouping gives it no early-stopping, only
+        smaller GEMMs; Lloyd's variable iteration count is the only
+        part per-group stopping helps.  Labels are bit-identical either
+        way (the init keys derive the same draws).  Default False until
+        the on-chip A/B records a win; no-op without cluster_batch or
+        for clusterers without the hook.
       reseed_clusterer_per_resample: False (default) re-seeds the inner
         clusterer identically for every resample — the reference's semantics
         (a fixed integer ``random_state`` makes every sklearn fit draw the
@@ -80,6 +91,7 @@ class SweepConfig:
     store_matrices: bool = True
     chunk_size: int = 8
     cluster_batch: Optional[int] = None
+    split_init: bool = False
     reseed_clusterer_per_resample: bool = False
     use_pallas: Optional[bool] = None
     dtype: str = "float32"

@@ -144,10 +144,41 @@ class KMeans:
     pallas_interpret: bool = False
 
     def fit_predict(
+        self,
+        key: jax.Array,
+        x: jax.Array,
+        k: jax.Array,
+        k_max: int,
+        init_centroids: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        labels, _ = self.fit(key, x, k, k_max, init_centroids=init_centroids)
+        return labels
+
+    def init_centroids(
         self, key: jax.Array, x: jax.Array, k: jax.Array, k_max: int
     ) -> jax.Array:
-        labels, _ = self.fit(key, x, k, k_max)
-        return labels
+        """The per-restart k-means++ seedings, shape (n_init, k_max, d).
+
+        The sweep's ``split_init`` path calls this OUTSIDE the
+        ``cluster_batch`` groups: the greedy init has a k-determined
+        trip count — identical across every lane of the same K — so
+        grouping buys it no early-stopping, only smaller GEMMs; the
+        variable-iteration Lloyd ``while_loop`` is the only part that
+        profits from per-group stopping.  Key derivation matches
+        :meth:`fit` exactly (``jax.random.split(key, n_init)``), so
+        Lloyd seeded from these centroids is bit-identical to
+        ``fit(key, ...)`` computing its own init.
+        """
+        if (
+            not jnp.issubdtype(x.dtype, jnp.floating)
+            or jnp.finfo(x.dtype).bits < 32
+        ):
+            x = x.astype(jnp.float32)
+        k = jnp.asarray(k, jnp.int32)
+        if self.n_init == 1:
+            return _kmeanspp_init(key, x, k, k_max)[None]
+        keys = jax.random.split(key, self.n_init)
+        return jax.vmap(lambda rk: _kmeanspp_init(rk, x, k, k_max))(keys)
 
     def fit(
         self,
@@ -156,6 +187,7 @@ class KMeans:
         k: jax.Array,
         k_max: Optional[int] = None,
         return_stats: bool = False,
+        init_centroids: Optional[jax.Array] = None,
     ):
         """Run best-of-n_init KMeans; returns (labels, centroids).
 
@@ -165,6 +197,11 @@ class KMeans:
         needs (benchmarks/lloyd_iters.py): under vmap a group of fits
         runs lockstep for max(iterations) steps, so the counts, not the
         wall-clock, are what turns bytes/iteration into bytes.
+
+        ``init_centroids``, shape (n_init, k_max, d), skips the
+        k-means++ seeding and runs Lloyd from the given centres (the
+        ``split_init`` contract: :meth:`init_centroids` on the same key
+        makes the result bit-identical to a self-seeding fit).
         """
         if k_max is None:
             k_max = int(k)
@@ -234,8 +271,20 @@ class KMeans:
                 far_row * k_max + jnp.arange(k_max), n_pts - 1
             )
 
-        def one_restart(rkey):
-            centroids = _kmeanspp_init(rkey, x, k, k_max)
+        if init_centroids is not None and init_centroids.shape != (
+            self.n_init, k_max, x.shape[1]
+        ):
+            raise ValueError(
+                f"init_centroids must have shape "
+                f"{(self.n_init, k_max, x.shape[1])} "
+                f"(n_init, k_max, d), got {init_centroids.shape}"
+            )
+
+        def one_restart(rkey, c0=None):
+            centroids = (
+                _kmeanspp_init(rkey, x, k, k_max) if c0 is None
+                else c0.astype(x.dtype)
+            )
 
             def masked_dist(c):
                 d = _pairwise_sqdist(x, c)
@@ -291,15 +340,24 @@ class KMeans:
             return labels, centroids, inertia, iters
 
         if self.n_init == 1:
-            labels, centroids, _, iters = one_restart(key)
+            labels, centroids, _, iters = one_restart(
+                key, None if init_centroids is None else init_centroids[0]
+            )
             if return_stats:
                 return labels, centroids, iters
             return labels, centroids
 
-        keys = jax.random.split(key, self.n_init)
-        labels_b, centroids_b, inertia_b, iters_b = jax.vmap(one_restart)(
-            keys
-        )
+        if init_centroids is None:
+            keys = jax.random.split(key, self.n_init)
+            labels_b, centroids_b, inertia_b, iters_b = jax.vmap(
+                one_restart
+            )(keys)
+        else:
+            # Restart keys seed only the k-means++ init, which is
+            # already baked into the given centroids.
+            labels_b, centroids_b, inertia_b, iters_b = jax.vmap(
+                lambda c0: one_restart(key, c0)
+            )(init_centroids)
         best = jnp.argmin(inertia_b)
         if return_stats:
             return labels_b[best], centroids_b[best], iters_b

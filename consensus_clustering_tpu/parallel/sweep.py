@@ -207,13 +207,52 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 n_groups = -(-local_h // batch)
                 keys_g = pad_to_lane_groups(keys, batch)
                 x_g = pad_to_lane_groups(x_sub, batch)
-                labels_g = jax.lax.map(
-                    lambda args: fit_batch(*args),
-                    (
-                        keys_g.reshape((n_groups, batch) + keys.shape[1:]),
-                        x_g.reshape((n_groups, batch) + x_sub.shape[1:]),
-                    ),
-                )
+                if config.split_init and hasattr(
+                    clusterer, "init_centroids"
+                ):
+                    # Init has a k-determined trip count (no lockstep
+                    # waste), so run it ONCE over the full lane batch —
+                    # full-width GEMMs — and group only the Lloyd
+                    # while_loop.  Same key derivation, so labels are
+                    # bit-identical to the self-seeding grouped path
+                    # (SweepConfig.split_init).
+                    inits = jax.vmap(
+                        lambda kk, xs: clusterer.init_centroids(
+                            kk, xs, k, k_max
+                        )
+                    )(keys, x_sub)
+                    inits_g = pad_to_lane_groups(inits, batch)
+                    fit_from = jax.vmap(
+                        lambda kk, xs, c0: clusterer.fit_predict(
+                            kk, xs, k, k_max, init_centroids=c0
+                        )
+                    )
+                    labels_g = jax.lax.map(
+                        lambda args: fit_from(*args),
+                        (
+                            keys_g.reshape(
+                                (n_groups, batch) + keys.shape[1:]
+                            ),
+                            x_g.reshape(
+                                (n_groups, batch) + x_sub.shape[1:]
+                            ),
+                            inits_g.reshape(
+                                (n_groups, batch) + inits.shape[1:]
+                            ),
+                        ),
+                    )
+                else:
+                    labels_g = jax.lax.map(
+                        lambda args: fit_batch(*args),
+                        (
+                            keys_g.reshape(
+                                (n_groups, batch) + keys.shape[1:]
+                            ),
+                            x_g.reshape(
+                                (n_groups, batch) + x_sub.shape[1:]
+                            ),
+                        ),
+                    )
                 labels = labels_g.reshape(
                     (n_groups * batch,) + labels_g.shape[2:]
                 )[:local_h]

@@ -160,3 +160,29 @@ class TestFitStats:
         )
         assert np.asarray(iters).shape == ()
         assert 1 <= int(iters) <= 100
+
+    @pytest.mark.parametrize("n_init", [1, 3])
+    def test_precomputed_init_bit_identical(self, blobs, n_init):
+        # The split_init contract: Lloyd seeded from init_centroids(key)
+        # must reproduce fit(key) exactly — same key derivation, same
+        # draws, bit-identical labels and centroids.
+        x, _ = blobs
+        xj = jnp.asarray(x)
+        km = KMeans(n_init=n_init)
+        key = jax.random.PRNGKey(7)
+        inits = km.init_centroids(key, xj, 3, 4)
+        assert inits.shape == (n_init, 4, x.shape[1])
+        labels, centroids = km.fit(key, xj, 3, 4, init_centroids=inits)
+        ref_labels, ref_centroids = km.fit(key, xj, 3, 4)
+        np.testing.assert_array_equal(np.asarray(labels),
+                                      np.asarray(ref_labels))
+        np.testing.assert_array_equal(np.asarray(centroids),
+                                      np.asarray(ref_centroids))
+
+    def test_precomputed_init_shape_validated(self, blobs):
+        x, _ = blobs
+        xj = jnp.asarray(x)
+        km = KMeans(n_init=2)
+        bad = jnp.zeros((3, 4, x.shape[1]), jnp.float32)  # wrong n_init
+        with pytest.raises(ValueError, match="init_centroids"):
+            km.fit(jax.random.PRNGKey(0), xj, 3, 4, init_centroids=bad)

@@ -99,6 +99,35 @@ class TestSweepSingleDevice:
             for name in ("mij", "iij", "cij", "pac_area"):
                 np.testing.assert_array_equal(ref[name], out[name])
 
+    def test_split_init_bit_identical(self, blobs):
+        # split_init moves the k-means++ seeding outside the lax.map
+        # groups (one full-width vmapped pass) and runs Lloyd from the
+        # precomputed centroids inside them.  The key derivation is
+        # shared (KMeans.init_centroids contract), so mij/cij/pac must
+        # be bit-identical to the self-seeding grouped path — and to
+        # the ungrouped sweep.  Batch 7 exercises the init padding.
+        x, _ = blobs
+        ref = run_sweep(KMeans(n_init=2), _sweep_config(x), x, seed=3)
+        for batch in (3, 7):
+            out = run_sweep(
+                KMeans(n_init=2),
+                _sweep_config(x, cluster_batch=batch, split_init=True),
+                x, seed=3,
+            )
+            for name in ("mij", "iij", "cij", "pac_area"):
+                np.testing.assert_array_equal(ref[name], out[name])
+
+    def test_split_init_noop_without_grouping(self, blobs):
+        # Without cluster_batch the flag must change nothing (same
+        # program: init is already full-width).
+        x, _ = blobs
+        ref = run_sweep(KMeans(n_init=2), _sweep_config(x), x, seed=4)
+        out = run_sweep(
+            KMeans(n_init=2), _sweep_config(x, split_init=True), x, seed=4
+        )
+        np.testing.assert_array_equal(ref["mij"], out["mij"])
+        np.testing.assert_array_equal(ref["pac_area"], out["pac_area"])
+
     def test_deterministic(self, blobs):
         x, _ = blobs
         config = _sweep_config(x)
@@ -182,6 +211,17 @@ class TestSweepSharded:
         np.testing.assert_allclose(
             ref["pac_area"], sharded["pac_area"], atol=1e-7
         )
+        # split_init composes the same way: full-width init per chip,
+        # grouped Lloyd, still bit-identical counts.
+        split = run_sweep(
+            km,
+            _sweep_config(
+                x, n_iterations=16, cluster_batch=1, split_init=True
+            ),
+            x, seed=5, mesh=resample_mesh(),
+        )
+        np.testing.assert_array_equal(ref["mij"], split["mij"])
+        np.testing.assert_array_equal(ref["iij"], split["iij"])
 
     def test_row_sharding_uneven_rows(self, blobs):
         # N=119 over 8 row shards: 15-row blocks, one row of padding —
