@@ -8,13 +8,19 @@
 #                        supervisor, owns retries), a 240s init watchdog,
 #                        a 1500s total watchdog, and timeout(1) at 1800s
 #                        as the backstop for tools without self-arming
-#                        watchdogs (lloyd_iters.py).  stdout lands in
-#                        $OUT/<name>.json; a success writes
-#                        $OUT/<name>.done and is never re-run; after
-#                        STEP_FAIL_CAP failures (default 3) the step is
-#                        abandoned (rc 0, .gave_up marker) so one
-#                        deterministically-failing step cannot starve
-#                        the steps queued after it.
+#                        watchdogs (lloyd_iters.py).
+#
+# Step bookkeeping, designed so artifact names cannot lie:
+#   - stdout goes to $OUT/<name>.json.part and is renamed to
+#     $OUT/<name>.json ONLY on success — a bare .json always means a
+#     valid record, never a truncated one from a watchdog kill;
+#   - a success writes $OUT/<name>.done (never re-run) and clears every
+#     step's failure counter: a completed step is evidence the tunnel
+#     is healthy, so earlier failures were likely wedges, not bugs;
+#   - a step that accumulates STEP_FAIL_CAP failures (default 3)
+#     without any intervening success is abandoned ($OUT/<name>.gave_up,
+#     returns rc 0) so a deterministically-failing step cannot starve
+#     the steps queued after it.
 
 STEP_FAIL_CAP=${STEP_FAIL_CAP:-3}
 
@@ -28,18 +34,20 @@ step() {
   fi
   log "=== $name: $* ($(date -u +%FT%TZ))"
   BENCH_SUPERVISED=1 BENCH_INIT_TIMEOUT=240 BENCH_TOTAL_TIMEOUT=1500 \
-    timeout 1800 "$@" > "$OUT/$name.json" 2>> "$OUT/session.log"
+    timeout 1800 "$@" > "$OUT/$name.json.part" 2>> "$OUT/session.log"
   rc=$?
   log "=== $name rc=$rc"
-  tail -c 400 "$OUT/$name.json" >> "$OUT/session.log" 2>/dev/null
-  if [ $rc -eq 0 ] && [ -s "$OUT/$name.json" ]; then
+  tail -c 400 "$OUT/$name.json.part" >> "$OUT/session.log" 2>/dev/null
+  if [ $rc -eq 0 ] && [ -s "$OUT/$name.json.part" ]; then
+    mv "$OUT/$name.json.part" "$OUT/$name.json"
     touch "$OUT/$name.done"
+    rm -f "$OUT"/*.fails
     return 0
   fi
   fails=$(( $(cat "$OUT/$name.fails" 2>/dev/null || echo 0) + 1 ))
   echo "$fails" > "$OUT/$name.fails"
   if [ "$fails" -ge "$STEP_FAIL_CAP" ]; then
-    log "=== $name: abandoned after $fails failures; later steps proceed"
+    log "=== $name: abandoned after $fails failures with no intervening success"
     touch "$OUT/$name.gave_up"
     return 0
   fi

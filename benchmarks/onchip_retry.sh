@@ -45,23 +45,37 @@ assert float(out) == 128.0 * 128.0 * 128.0
 EOF
 }
 
+# After a step fails, re-probe before touching the next step: a healthy
+# probe means the failure was the step's own (march on — the fail cap is
+# the backstop for a deterministic breakage), a failed probe means the
+# tunnel wedged mid-step (back to sleep).  Restarting the chain from the
+# top on every failure would let a first-step wedge burn that step's
+# fail cap before any later step ever ran.
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     log "probe ok ($(date -u +%FT%TZ)); running queued steps"
-    step spectral python bench.py --config spectral || { sleep 60; continue; }
-    step gmm python bench.py --config gmm || { sleep 60; continue; }
+    step spectral python bench.py --config spectral \
+        || { probe || { sleep 60; continue; }; }
+    step gmm python bench.py --config gmm \
+        || { probe || { sleep 60; continue; }; }
     step maxiter25_blobs10k python benchmarks/maxiter_probe.py --max-iter 25 \
-        || { sleep 60; continue; }
+        || { probe || { sleep 60; continue; }; }
     step lloyd_iters_blobs10k python benchmarks/lloyd_iters.py --config blobs10k \
-        || { sleep 60; continue; }
+        || { probe || { sleep 60; continue; }; }
     step lloyd_iters_headline python benchmarks/lloyd_iters.py --config headline \
-        || { sleep 60; continue; }
+        || { probe || { sleep 60; continue; }; }
     step blobs10k_trace python bench.py --config blobs10k --repeats 1 \
-        --profile-dir "$OUT/blobs10k_trace" || { sleep 60; continue; }
-    log "all steps done or abandoned ($(date -u +%FT%TZ))"
-    exit 0
+        --profile-dir "$OUT/blobs10k_trace" \
+        || { probe || { sleep 60; continue; }; }
+    if ls "$OUT"/*.done >/dev/null 2>&1 \
+        && [ "$(ls "$OUT"/*.done "$OUT"/*.gave_up 2>/dev/null | wc -l)" -ge 6 ]; then
+      log "all steps done or abandoned ($(date -u +%FT%TZ))"
+      exit 0
+    fi
+    sleep 60
+  else
+    sleep "$PROBE_EVERY"
   fi
-  sleep "$PROBE_EVERY"
 done
 log "deadline reached with steps pending"
 exit 1

@@ -33,7 +33,11 @@ CACHE="$OUT/xla-cache-cold"
 . benchmarks/_onchip_step.sh
 run() { step "$@" || true; }
 
-# 1. cache before/after on chip (cold dir private to this session)
+# 1. cache before/after on chip (cold dir private to this session).
+# On a resume, a prior FAILED cold attempt may already have populated
+# the cache dir — wipe it so "cold" measures a cold compile, not the
+# leftovers of the attempt that wedged.
+[ -f "$OUT/corr_cache_cold.done" ] || rm -rf "$CACHE"
 CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_cold python bench.py --config corr
 CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_warm python bench.py --config corr
 
