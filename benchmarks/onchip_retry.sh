@@ -45,6 +45,18 @@ assert float(out) == 128.0 * 128.0 * 128.0
 EOF
 }
 
+STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k \
+lloyd_iters_headline blobs10k_trace"
+
+all_settled() {
+  # Every queued step, by name, is done or abandoned — never a marker
+  # count, which foreign markers in a shared dir would inflate.
+  for n in $STEP_NAMES; do
+    [ -f "$OUT/$n.done" ] || [ -f "$OUT/$n.gave_up" ] || return 1
+  done
+  return 0
+}
+
 # After a step fails, re-probe before touching the next step: a healthy
 # probe means the failure was the step's own (march on — the fail cap is
 # the backstop for a deterministic breakage), a failed probe means the
@@ -52,6 +64,10 @@ EOF
 # top on every failure would let a first-step wedge burn that step's
 # fail cap before any later step ever ran.
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if all_settled; then
+    log "all steps done or abandoned ($(date -u +%FT%TZ))"
+    exit 0
+  fi
   if probe; then
     log "probe ok ($(date -u +%FT%TZ)); running queued steps"
     step spectral python bench.py --config spectral \
@@ -67,15 +83,14 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     step blobs10k_trace python bench.py --config blobs10k --repeats 1 \
         --profile-dir "$OUT/blobs10k_trace" \
         || { probe || { sleep 60; continue; }; }
-    if ls "$OUT"/*.done >/dev/null 2>&1 \
-        && [ "$(ls "$OUT"/*.done "$OUT"/*.gave_up 2>/dev/null | wc -l)" -ge 6 ]; then
-      log "all steps done or abandoned ($(date -u +%FT%TZ))"
-      exit 0
-    fi
-    sleep 60
+    sleep 10
   else
     sleep "$PROBE_EVERY"
   fi
 done
+if all_settled; then
+  log "all steps done or abandoned ($(date -u +%FT%TZ))"
+  exit 0
+fi
 log "deadline reached with steps pending"
 exit 1

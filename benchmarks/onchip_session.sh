@@ -36,10 +36,18 @@ run() { step "$@" || true; }
 # 1. cache before/after on chip (cold dir private to this session).
 # On a resume, a prior FAILED cold attempt may already have populated
 # the cache dir — wipe it so "cold" measures a cold compile, not the
-# leftovers of the attempt that wedged.
-[ -f "$OUT/corr_cache_cold.done" ] || rm -rf "$CACHE"
+# leftovers of the attempt that wedged.  The warm step only runs after
+# a VALID cold measurement: pairing it with an abandoned (or wiped)
+# cold run would record a cold compile under the "warm" name.
+if [ ! -f "$OUT/corr_cache_cold.done" ] && [ ! -f "$OUT/corr_cache_cold.gave_up" ]; then
+  rm -rf "$CACHE"
+fi
 CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_cold python bench.py --config corr
-CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_warm python bench.py --config corr
+if [ -f "$OUT/corr_cache_cold.done" ]; then
+  CCTPU_COMPILATION_CACHE="$CACHE" run corr_cache_warm python bench.py --config corr
+else
+  log "corr_cache_warm skipped: no valid cold measurement to pair with"
+fi
 
 # 2. driver-facing throughput numbers
 run headline python bench.py

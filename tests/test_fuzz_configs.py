@@ -31,11 +31,12 @@ def _draw_case(seed):
     ).tolist())) or (2,)
     chunk = int(rng.integers(1, 9))
     cluster_batch = [None, 1, 3, 7][int(rng.integers(0, 4))]
+    split_init = bool(rng.integers(0, 2))
     x = rng.normal(size=(n, d)).astype(np.float32)
     config = SweepConfig(
         n_samples=n, n_features=d, k_values=ks, n_iterations=h,
         subsampling=subsampling, chunk_size=chunk,
-        cluster_batch=cluster_batch,
+        cluster_batch=cluster_batch, split_init=split_init,
     )
     return x, config
 
