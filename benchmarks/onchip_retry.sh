@@ -45,8 +45,29 @@ assert float(out) == 128.0 * 128.0 * 128.0
 EOF
 }
 
+# Single source of truth for the queue: the run chain iterates this
+# list and run_step maps each name to its command, so the settled check
+# can never drift from the steps actually run.  Adding a step = add its
+# name here + a case arm; a name without an arm fails loudly per pass.
 STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k \
 lloyd_iters_headline blobs10k_trace"
+
+run_step() {
+  case $1 in
+    spectral) step spectral python bench.py --config spectral ;;
+    gmm) step gmm python bench.py --config gmm ;;
+    maxiter25_blobs10k)
+      step maxiter25_blobs10k python benchmarks/maxiter_probe.py --max-iter 25 ;;
+    lloyd_iters_blobs10k)
+      step lloyd_iters_blobs10k python benchmarks/lloyd_iters.py --config blobs10k ;;
+    lloyd_iters_headline)
+      step lloyd_iters_headline python benchmarks/lloyd_iters.py --config headline ;;
+    blobs10k_trace)
+      step blobs10k_trace python bench.py --config blobs10k --repeats 1 \
+          --profile-dir "$OUT/blobs10k_trace" ;;
+    *) log "run_step: no command registered for step '$1'"; return 1 ;;
+  esac
+}
 
 all_settled() {
   # Every queued step, by name, is done or abandoned — never a marker
@@ -60,9 +81,9 @@ all_settled() {
 # After a step fails, re-probe before touching the next step: a healthy
 # probe means the failure was the step's own (march on — the fail cap is
 # the backstop for a deterministic breakage), a failed probe means the
-# tunnel wedged mid-step (back to sleep).  Restarting the chain from the
-# top on every failure would let a first-step wedge burn that step's
-# fail cap before any later step ever ran.
+# tunnel wedged mid-step (back to sleep).  Iterating the chain instead
+# of restarting it on failure keeps a first-step wedge from burning that
+# step's fail cap before any later step ever runs.
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if all_settled; then
     log "all steps done or abandoned ($(date -u +%FT%TZ))"
@@ -70,19 +91,11 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   fi
   if probe; then
     log "probe ok ($(date -u +%FT%TZ)); running queued steps"
-    step spectral python bench.py --config spectral \
-        || { probe || { sleep 60; continue; }; }
-    step gmm python bench.py --config gmm \
-        || { probe || { sleep 60; continue; }; }
-    step maxiter25_blobs10k python benchmarks/maxiter_probe.py --max-iter 25 \
-        || { probe || { sleep 60; continue; }; }
-    step lloyd_iters_blobs10k python benchmarks/lloyd_iters.py --config blobs10k \
-        || { probe || { sleep 60; continue; }; }
-    step lloyd_iters_headline python benchmarks/lloyd_iters.py --config headline \
-        || { probe || { sleep 60; continue; }; }
-    step blobs10k_trace python bench.py --config blobs10k --repeats 1 \
-        --profile-dir "$OUT/blobs10k_trace" \
-        || { probe || { sleep 60; continue; }; }
+    wedged=0
+    for n in $STEP_NAMES; do
+      run_step "$n" || { probe || { wedged=1; break; }; }
+    done
+    if [ "$wedged" = 1 ]; then sleep 60; continue; fi
     sleep 10
   else
     sleep "$PROBE_EVERY"

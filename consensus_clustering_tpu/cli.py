@@ -101,6 +101,8 @@ def cmd_run(args):
         compute_consensus_labels=False,
         profile_dir=args.profile_dir,
         use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
+        cluster_batch=args.cluster_batch or None,
+        split_init=args.split_init,
         metrics_path=args.metrics_path,
         k_batch_size=args.k_batch_size,
         compute_dtype=args.compute_dtype,
@@ -221,6 +223,14 @@ def main(argv=None):
     run.add_argument("--checkpoint-dir", default=None)
     run.add_argument("--profile-dir", default=None,
                      help="capture a jax.profiler trace here")
+    run.add_argument("--cluster-batch", type=int, default=0,
+                     help="resamples per clustering sub-batch (0 = one "
+                     "batch); lets each group's Lloyd loop stop at its "
+                     "own slowest lane")
+    run.add_argument("--split-init", action="store_true",
+                     help="with --cluster-batch: seed all lanes in one "
+                     "full-width pass, group only the Lloyd loop "
+                     "(bit-identical)")
     run.add_argument("--use-pallas", choices=["auto", "on", "off"],
                      default="auto",
                      help="consensus-histogram kernel selection")
