@@ -22,9 +22,72 @@
 #     returns rc 0) so a deterministically-failing step cannot starve
 #     the steps queued after it.
 
+#
+# The probe-gated driver loop is shared too (onchip_retry.sh grew it
+# first; factored here so the health-probe and wedge contract cannot
+# drift between watchers): a script defines STEP_NAMES and run_step,
+# sets DEADLINE and PROBE_EVERY, then calls run_queue.  probe() is one
+# real accelerator round trip — jit + execute + fetch; a wedged tunnel
+# hangs the backend init or the fetch, and timeout(1) turns either
+# into a failed probe.  (128^3 is exactly representable in f32, so the
+# equality check is safe.)
+
 STEP_FAIL_CAP=${STEP_FAIL_CAP:-3}
 
 log() { echo "$*" | tee -a "$OUT/session.log"; }
+
+probe() {
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax
+import jax.numpy as jnp
+
+assert jax.devices()[0].platform != "cpu"
+out = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)))
+assert float(out) == 128.0 * 128.0 * 128.0
+EOF
+}
+
+all_settled() {
+  # Every queued step, by name, is done or abandoned — never a marker
+  # count, which foreign markers in a shared dir would inflate.
+  for n in $STEP_NAMES; do
+    [ -f "$OUT/$n.done" ] || [ -f "$OUT/$n.gave_up" ] || return 1
+  done
+  return 0
+}
+
+run_queue() {
+  # After a step fails, re-probe before touching the next step: a
+  # healthy probe means the failure was the step's own (march on — the
+  # fail cap is the backstop for a deterministic breakage), a failed
+  # probe means the tunnel wedged mid-step (back to sleep).  Iterating
+  # the chain instead of restarting it on failure keeps a first-step
+  # wedge from burning that step's fail cap before any later step ever
+  # runs.
+  while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if all_settled; then
+      log "all steps done or abandoned ($(date -u +%FT%TZ))"
+      return 0
+    fi
+    if probe; then
+      log "probe ok ($(date -u +%FT%TZ)); running queued steps"
+      wedged=0
+      for n in $STEP_NAMES; do
+        run_step "$n" || { probe || { wedged=1; break; }; }
+      done
+      if [ "$wedged" = 1 ]; then sleep 60; continue; fi
+      sleep 10
+    else
+      sleep "$PROBE_EVERY"
+    fi
+  done
+  if all_settled; then
+    log "all steps done or abandoned ($(date -u +%FT%TZ))"
+    return 0
+  fi
+  log "deadline reached with steps pending"
+  return 1
+}
 
 step() {
   name=$1; shift

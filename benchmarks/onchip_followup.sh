@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Round-4 follow-up on-chip steps, run after onchip_retry.sh settles:
+#
+#   1. maxiter100_blobs10k — the DEFAULT-cap (max_iter=100) probe run,
+#      printing the full 19-value PAC vector.  The max_iter=25 probe
+#      (onchip_retry_r04/maxiter25_blobs10k.json, 1504.5 r/s vs the
+#      1060.7 default record) can only be pinned if its pac_all is
+#      bit-identical to the default's pac_all at the same rounding —
+#      the preserved records carry only pac_head (3 values), so this
+#      run supplies the other 16.
+#   2/3. split_init A/B at the headline shape (N=5000 H=500,
+#      cluster_batch=16, chunk 4): PERF.md "Remaining headroom" says
+#      pin SweepConfig.split_init in bench.py only on a reproduced
+#      on-chip win; CPU A/B was neutral.
+#   4/5. split_init A/B at the blobs10k shape (N=10000 H=1000,
+#      cluster_batch=8, chunk 8).
+#
+# Bookkeeping, probe gating, and the driver loop are shared with the
+# session/retry scripts (benchmarks/_onchip_step.sh): .json only on
+# success, .done markers, fail caps, health probe between failures.
+# The retry queue owns the tunnel first: this script WAITS until every
+# onchip_retry.sh step is done or abandoned before submitting anything
+# — two full-shape sweeps through one 16 GB chip can OOM each other
+# and burn fail caps on steps that would have succeeded serially.
+#
+#   bash benchmarks/onchip_followup.sh
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${ONCHIP_FOLLOWUP_DIR:-benchmarks/onchip_followup_r04}
+mkdir -p "$OUT"
+DEADLINE=$(( $(date +%s) + ${ONCHIP_FOLLOWUP_DEADLINE_S:-21600} ))
+PROBE_EVERY=${ONCHIP_FOLLOWUP_PROBE_EVERY:-300}
+RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
+. benchmarks/_onchip_step.sh
+
+STEP_NAMES="maxiter100_blobs10k splitinit_headline_off splitinit_headline_on \
+splitinit_blobs10k_off splitinit_blobs10k_on"
+
+# onchip_retry.sh's queue, kept in sync with its STEP_NAMES: the
+# followup yields the tunnel until each of these is settled in
+# RETRY_DIR (or the dir doesn't exist — nothing to yield to).
+RETRY_STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k \
+lloyd_iters_headline blobs10k_trace"
+
+retry_settled() {
+  [ -d "$RETRY_DIR" ] || return 0
+  for n in $RETRY_STEP_NAMES; do
+    [ -f "$RETRY_DIR/$n.done" ] || [ -f "$RETRY_DIR/$n.gave_up" ] || return 1
+  done
+  return 0
+}
+
+run_step() {
+  case $1 in
+    maxiter100_blobs10k)
+      step maxiter100_blobs10k python benchmarks/maxiter_probe.py --max-iter 100 ;;
+    splitinit_headline_off)
+      step splitinit_headline_off python benchmarks/tune.py \
+          --n 5000 --h 500 --cluster-batches 16 --chunk-size 4 ;;
+    splitinit_headline_on)
+      step splitinit_headline_on python benchmarks/tune.py \
+          --n 5000 --h 500 --cluster-batches 16 --chunk-size 4 --split-init ;;
+    splitinit_blobs10k_off)
+      step splitinit_blobs10k_off python benchmarks/tune.py \
+          --n 10000 --h 1000 --cluster-batches 8 --chunk-size 8 ;;
+    splitinit_blobs10k_on)
+      step splitinit_blobs10k_on python benchmarks/tune.py \
+          --n 10000 --h 1000 --cluster-batches 8 --chunk-size 8 --split-init ;;
+    *) log "run_step: no command registered for step '$1'"; return 1 ;;
+  esac
+}
+
+until retry_settled; do
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    log "deadline reached still waiting for $RETRY_DIR to settle"
+    exit 1
+  fi
+  sleep 60
+done
+log "retry queue settled; followup queue starts ($(date -u +%FT%TZ))"
+
+run_queue

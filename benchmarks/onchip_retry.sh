@@ -12,12 +12,12 @@
 #   3. exact on-chip Lloyd lockstep counts for roofline.py,
 #   4. a blobs10k profiler trace (least valuable, slowest through the
 #      tunnel — last on purpose).
-# Step bookkeeping lives in _onchip_step.sh (shared with
-# onchip_session.sh): a success writes a .done marker and is never
-# re-run; a failure sends the loop back to probing, and a step that
-# fails STEP_FAIL_CAP times is abandoned so it cannot starve the steps
-# behind it.  Exits when all steps are done or abandoned, or the
-# deadline (default 8h) passes.
+# Step bookkeeping, the health probe, and the driver loop live in
+# _onchip_step.sh (shared with onchip_session.sh / onchip_followup.sh):
+# a success writes a .done marker and is never re-run; a failure sends
+# the loop back to probing, and a step that fails STEP_FAIL_CAP times
+# is abandoned so it cannot starve the steps behind it.  Exits when all
+# steps are done or abandoned, or the deadline (default 8h) passes.
 #
 #   bash benchmarks/onchip_retry.sh
 #   ONCHIP_RETRY_DIR=... ONCHIP_RETRY_DEADLINE_S=3600 bash benchmarks/onchip_retry.sh
@@ -30,25 +30,12 @@ DEADLINE=$(( $(date +%s) + ${ONCHIP_RETRY_DEADLINE_S:-28800} ))
 PROBE_EVERY=${ONCHIP_RETRY_PROBE_EVERY:-480}
 . benchmarks/_onchip_step.sh
 
-probe() {
-  # A real round trip: jit + execute + fetch on the accelerator.  A
-  # wedged tunnel hangs the backend init or the fetch; timeout(1) turns
-  # either into a failed probe.  (128^3 is exactly representable in
-  # f32, so the equality check is safe.)
-  timeout 150 python - <<'EOF' >/dev/null 2>&1
-import jax
-import jax.numpy as jnp
-
-assert jax.devices()[0].platform != "cpu"
-out = jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128)))
-assert float(out) == 128.0 * 128.0 * 128.0
-EOF
-}
-
-# Single source of truth for the queue: the run chain iterates this
-# list and run_step maps each name to its command, so the settled check
-# can never drift from the steps actually run.  Adding a step = add its
+# Single source of truth for the queue: run_queue iterates this list
+# and run_step maps each name to its command, so the settled check can
+# never drift from the steps actually run.  Adding a step = add its
 # name here + a case arm; a name without an arm fails loudly per pass.
+# (onchip_followup.sh mirrors this list as RETRY_STEP_NAMES to know
+# when to take the tunnel — keep them in sync.)
 STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k \
 lloyd_iters_headline blobs10k_trace"
 
@@ -69,41 +56,4 @@ run_step() {
   esac
 }
 
-all_settled() {
-  # Every queued step, by name, is done or abandoned — never a marker
-  # count, which foreign markers in a shared dir would inflate.
-  for n in $STEP_NAMES; do
-    [ -f "$OUT/$n.done" ] || [ -f "$OUT/$n.gave_up" ] || return 1
-  done
-  return 0
-}
-
-# After a step fails, re-probe before touching the next step: a healthy
-# probe means the failure was the step's own (march on — the fail cap is
-# the backstop for a deterministic breakage), a failed probe means the
-# tunnel wedged mid-step (back to sleep).  Iterating the chain instead
-# of restarting it on failure keeps a first-step wedge from burning that
-# step's fail cap before any later step ever runs.
-while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if all_settled; then
-    log "all steps done or abandoned ($(date -u +%FT%TZ))"
-    exit 0
-  fi
-  if probe; then
-    log "probe ok ($(date -u +%FT%TZ)); running queued steps"
-    wedged=0
-    for n in $STEP_NAMES; do
-      run_step "$n" || { probe || { wedged=1; break; }; }
-    done
-    if [ "$wedged" = 1 ]; then sleep 60; continue; fi
-    sleep 10
-  else
-    sleep "$PROBE_EVERY"
-  fi
-done
-if all_settled; then
-  log "all steps done or abandoned ($(date -u +%FT%TZ))"
-  exit 0
-fi
-log "deadline reached with steps pending"
-exit 1
+run_queue

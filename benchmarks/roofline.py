@@ -115,22 +115,22 @@ MEASURED = {
                       "onchip_records_r03.json (best-of-3 record wall)",
     },
     "blobs10k": {
-        # No phase trace at this shape yet; the Lloyd count instead
-        # comes from benchmarks/lloyd_iters.py on the CPU backend
-        # (exact lane replication of the compiled sweep): H=200 all-K
-        # measurement x 5.052 empirical full-H scaling, validated on
-        # the K<=9 full-H overlap (lloyd_iters_blobs10k_cpu.json).
-        # CPU-derived: on-chip counts can differ by a few steps/group
-        # (bf16-pass rounding); onchip_session.sh step 5 refreshes it.
+        # No phase trace at this shape yet; the Lloyd count is the
+        # round-4 ON-CHIP measurement from benchmarks/lloyd_iters.py
+        # (exact lane replication of the compiled sweep at the full
+        # H=1000 shape; onchip_retry_r04/lloyd_iters_blobs10k.json).
+        # The earlier CPU-derived estimate (H=200 x 5.052 full-H
+        # scaling, lloyd_iters_blobs10k_cpu.json) was 2,119,603 —
+        # within 1.1% — validating that extrapolation method.
         "phase_seconds": {},
         "traced_device_total": None,
         # Already the grouped (cluster_batch=8) count — the same
         # grouping the record wall ran with.
-        "lloyd_lane_steps": 2_119_603,
-        "record_wall": 19000 / 1060.3,
-        "provenance": "onchip_records_r03.json (wall) + "
-                      "lloyd_iters_blobs10k_cpu.json (CPU-derived "
-                      "Lloyd count)",
+        "lloyd_lane_steps": 2_097_048,
+        "record_wall": 19000 / 1060.7,
+        "provenance": "onchip_records_r04.json (wall) + "
+                      "onchip_retry_r04/lloyd_iters_blobs10k.json "
+                      "(on-chip Lloyd count)",
     },
 }
 
