@@ -33,6 +33,9 @@
 # equality check is safe.)
 
 STEP_FAIL_CAP=${STEP_FAIL_CAP:-3}
+# Pause between queue passes when steps are still pending (the contract
+# tests shrink it; watchers keep the default).
+QUEUE_PAUSE=${QUEUE_PAUSE:-10}
 
 log() { echo "$*" | tee -a "$OUT/session.log"; }
 
@@ -76,7 +79,7 @@ run_queue() {
         run_step "$n" || { probe || { wedged=1; break; }; }
       done
       if [ "$wedged" = 1 ]; then sleep 60; continue; fi
-      sleep 10
+      sleep "$QUEUE_PAUSE"
     else
       sleep "$PROBE_EVERY"
     fi

@@ -1,0 +1,172 @@
+"""Contract tests for the shared on-chip step runner (benchmarks/_onchip_step.sh).
+
+The three watcher scripts (onchip_session.sh / onchip_retry.sh /
+onchip_followup.sh) all source this library for step bookkeeping, the
+tunnel health probe, and the probe-gated ``run_queue`` driver.  The
+library's promises are load-bearing for the round's evidence artifacts
+— "a bare .json always means a valid record" is what lets PERF.md cite
+them — so they are pinned here with a stubbed ``probe`` (no accelerator,
+no jax import; everything runs bash + /bin/echo).
+
+What is pinned:
+  * ``step``: stdout lands in <name>.json ONLY on success (rc 0 AND
+    non-empty output); failures leave .json.part, never .json.
+  * fail cap: STEP_FAIL_CAP failures with no intervening success write
+    <name>.gave_up and stop re-running the step.
+  * a success clears every step's failure counter (a completed step
+    proves the tunnel is healthy, so earlier failures were wedges).
+  * ``run_queue``: settles (rc 0) when every STEP_NAMES entry is .done
+    or .gave_up; a past deadline with pending steps is rc 1; a .done
+    step is never executed again.
+  * ``onchip_followup.sh`` yields the tunnel until every
+    onchip_retry.sh step is settled in RETRY_DIR (gate tested with a
+    zero deadline so no real probe ever runs).
+"""
+
+import pathlib
+import subprocess
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LIB = REPO / "benchmarks" / "_onchip_step.sh"
+FOLLOWUP = REPO / "benchmarks" / "onchip_followup.sh"
+
+pytestmark = pytest.mark.skipif(
+    not LIB.exists(), reason="shared step library not present"
+)
+
+
+def run_driver(tmp_path, body, env=None):
+    """Source the library with OUT=<tmp>, stub probe healthy, run body."""
+    script = f"""
+set -u
+OUT={tmp_path}/out; mkdir -p "$OUT"
+DEADLINE=$(( $(date +%s) + 30 )); PROBE_EVERY=1; QUEUE_PAUSE=0
+. {LIB}
+probe() {{ return 0; }}
+{body}
+"""
+    return subprocess.run(
+        ["bash", "-c", script], capture_output=True, text=True,
+        cwd=REPO, timeout=120, env=env,
+    )
+
+
+def test_json_only_on_success(tmp_path):
+    out = tmp_path / "out"
+    r = run_driver(
+        tmp_path,
+        'STEP_NAMES="good bad"\n'
+        'run_step() { case $1 in good) step good echo \'{"ok":1}\';;'
+        " bad) step bad false;; esac; }\n"
+        'run_queue; echo "rc=$?"',
+    )
+    assert "rc=0" in r.stdout, r.stdout + r.stderr
+    assert (out / "good.json").read_text().strip() == '{"ok":1}'
+    assert (out / "good.done").exists()
+    # The failing step never earns a bare .json, and is abandoned at cap.
+    assert not (out / "bad.json").exists()
+    assert (out / "bad.gave_up").exists()
+
+
+def test_empty_stdout_is_a_failure(tmp_path):
+    # rc 0 with no output must not mint a .json (a watchdog kill can
+    # leave rc 0 shells with nothing written).
+    out = tmp_path / "out"
+    r = run_driver(
+        tmp_path,
+        'STEP_NAMES="quiet"\n'
+        "run_step() { step quiet true; }\n"
+        'run_queue; echo "rc=$?"',
+    )
+    assert "rc=0" in r.stdout, r.stdout + r.stderr
+    assert not (out / "quiet.json").exists()
+    assert (out / "quiet.gave_up").exists()
+
+
+def test_success_clears_fail_counters(tmp_path):
+    # flaky fails once (writing flaky.fails), then good succeeds and
+    # must wipe the counter before flaky's second attempt.
+    out = tmp_path / "out"
+    r = run_driver(
+        tmp_path,
+        "STEP_FAIL_CAP=2\n"
+        'STEP_NAMES="flaky good"\n'
+        "run_step() { case $1 in\n"
+        "  flaky) step flaky bash -c 'test -f " + str(tmp_path) +
+        "/armed && echo done-now; test -f " + str(tmp_path) + "/armed';;\n"
+        "  good) step good bash -c 'touch " + str(tmp_path) +
+        "/armed; echo ok';;\n"
+        "esac; }\n"
+        'run_queue; echo "rc=$?"',
+    )
+    assert "rc=0" in r.stdout, r.stdout + r.stderr
+    # flaky eventually succeeded (second pass) instead of being
+    # abandoned at the cap of 2: the intervening good success cleared
+    # its first failure.
+    assert (out / "flaky.done").exists()
+    assert not (out / "flaky.gave_up").exists()
+
+
+def test_done_steps_never_rerun(tmp_path):
+    out = tmp_path / "out"
+    r = run_driver(
+        tmp_path,
+        'STEP_NAMES="once"\n'
+        "run_step() { step once bash -c 'echo ran >> " + str(tmp_path) +
+        "/count; echo ok'; }\n"
+        "run_queue\n"
+        "run_queue\n"           # second drain: .done short-circuits
+        'echo "rc=$?"',
+    )
+    assert "rc=0" in r.stdout, r.stdout + r.stderr
+    assert (tmp_path / "count").read_text().count("ran") == 1
+    assert (out / "once.done").exists()
+
+
+def test_past_deadline_with_pending_steps_is_rc1(tmp_path):
+    r = run_driver(
+        tmp_path,
+        "DEADLINE=$(( $(date +%s) - 1 ))\n"
+        'STEP_NAMES="never"\n'
+        "run_step() { step never echo unreachable; }\n"
+        'run_queue; echo "rc=$?"',
+    )
+    assert "rc=1" in r.stdout, r.stdout + r.stderr
+    assert "deadline reached with steps pending" in r.stdout + r.stderr
+    assert not (tmp_path / "out" / "never.json").exists()
+
+
+@pytest.mark.skipif(not FOLLOWUP.exists(), reason="followup script absent")
+def test_followup_waits_for_retry_queue(tmp_path):
+    # Unsettled retry dir + zero deadline: must exit 1 while still
+    # WAITING (before run_queue), running no steps and no probe.
+    retry = tmp_path / "retry"
+    retry.mkdir()
+    env = {
+        "PATH": "/usr/bin:/bin",
+        "ONCHIP_FOLLOWUP_DIR": str(tmp_path / "fup"),
+        "ONCHIP_FOLLOWUP_DEADLINE_S": "0",
+        "ONCHIP_RETRY_DIR": str(retry),
+    }
+    r = subprocess.run(
+        ["bash", str(FOLLOWUP)], capture_output=True, text=True,
+        cwd=REPO, timeout=60, env=env,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "waiting" in r.stdout + r.stderr
+    assert not list((tmp_path / "fup").glob("*.json*"))
+
+    # Settled retry dir (every retry step done/gave_up): the gate opens
+    # and the zero deadline now surfaces run_queue's own pending exit.
+    for name in ("spectral", "gmm", "maxiter25_blobs10k",
+                 "lloyd_iters_blobs10k", "lloyd_iters_headline",
+                 "blobs10k_trace"):
+        (retry / f"{name}.done").touch()
+    r2 = subprocess.run(
+        ["bash", str(FOLLOWUP)], capture_output=True, text=True,
+        cwd=REPO, timeout=60, env=env,
+    )
+    assert r2.returncode == 1, r2.stdout + r2.stderr
+    assert "deadline reached with steps pending" in r2.stdout + r2.stderr
