@@ -72,6 +72,11 @@ from bench import FULL_SHAPES  # noqa: E402
 PEAK_BF16 = 197e12      # FLOP/s, MXU
 HBM_BW = 819e9          # B/s
 HIGHEST_PASSES = 6      # f32-accurate matmul = 6 bf16 MXU passes
+# Interchip interconnect, public spec: 1600 Gbps per v5e chip.  Used
+# only by the --mesh projection for the Mij psum; a real pod's achieved
+# all-reduce bandwidth depends on topology, so the projection labels
+# every ICI term as spec-peak (optimistic) arithmetic.
+ICI_BW = 200e9          # B/s per chip
 
 # Measured, with provenance.  Phase seconds: xplane trace of the
 # round-3 headline run (PERF.md "Where the time goes"; bench.py
@@ -135,6 +140,42 @@ MEASURED = {
 }
 
 
+def _lloyd_model(n_sub, d, k_max, lane_steps):
+    """(flops_math, passes, bytes_lo, bytes_hi) for the Lloyd body.
+
+    One source of truth for the assign+update accounting: phases()
+    formats it, project() rescales its lane_steps per shard.
+    """
+    flops = 2 * 2 * n_sub * d * k_max * lane_steps
+    x_lane = n_sub * d * 4
+    dist_lane = n_sub * k_max * 4
+    lo = 2 * x_lane * lane_steps        # x streamed twice/step
+    hi = (2 * x_lane + 2 * dist_lane) * lane_steps
+    return flops, HIGHEST_PASSES, lo, hi
+
+
+def _init_model(n_sub, d, k_max, steps):
+    """(flops_math, passes, bytes_lo, bytes_hi, T) for kmeans++ init."""
+    t = 2 + int(math.ceil(math.log(max(k_max, 2))))
+    flops = 2 * t * n_sub * d * steps
+    lo = n_sub * d * 4 * steps          # x read per step
+    hi = (n_sub * d * 4 + 3 * t * n_sub * 4) * steps
+    return flops, HIGHEST_PASSES, lo, hi, t
+
+
+def _coassoc_bytes(n_rows, n_cols, chunk, k_max, chunks):
+    """HBM bytes for ``chunks`` accumulation GEMMs onto an
+    (n_rows, n_cols) Mij block: the f32 RMW + the bf16 one-hot operand
+    (which never shards over 'n')."""
+    return chunks * (2 * n_rows * n_cols * 4 + chunk * k_max * n_cols * 2)
+
+
+def _floor_secs(flops, passes, b_lo, b_hi):
+    """[lo, hi] roofline floor seconds for one phase."""
+    ft = flops * passes / PEAK_BF16
+    return max(ft, b_lo / HBM_BW), max(ft, b_hi / HBM_BW)
+
+
 def phases(config_name, lloyd_lane_steps):
     """Returns [(phase, flops_math, mxu_passes_mult, bytes_lo, bytes_hi,
     formula_note)] from shapes alone (+ the measured lane-weighted Lloyd
@@ -157,13 +198,12 @@ def phases(config_name, lloyd_lane_steps):
     out = []
     if lloyd_lane_steps is not None:
         # Assign + update per lane-step; the count is measured.
-        flops = 2 * 2 * n_sub * d * k_max * lloyd_lane_steps
+        flops, passes, lo, hi = _lloyd_model(
+            n_sub, d, k_max, lloyd_lane_steps)
         x_lane = n_sub * d * 4
         dist_lane = n_sub * k_max * 4
-        lo = 2 * x_lane * lloyd_lane_steps      # x streamed twice/step
-        hi = (2 * x_lane + 2 * dist_lane) * lloyd_lane_steps
         out.append((
-            "lloyd (assign+update)", flops, HIGHEST_PASSES, lo, hi,
+            "lloyd (assign+update)", flops, passes, lo, hi,
             f"2 GEMMs x 2*n_sub*d*k_max x {lloyd_lane_steps} "
             f"lane-steps; lo: 2 x-reads ({x_lane/1e6:.1f} MB/lane)/"
             f"step; hi: + dist block ({dist_lane/1e6:.2f} MB/lane) RW "
@@ -171,25 +211,24 @@ def phases(config_name, lloyd_lane_steps):
         ))
     # k-means++: steps = B_l * sum(K-1) over the sweep (traced-K loop).
     steps = b_l * sum(k - 1 for k in k_values)
-    t = 2 + int(math.ceil(math.log(max(k_max, 2))))
-    flops = 2 * t * n_sub * d * steps
-    lo = n_sub * d * 4 * steps                  # x read per step
-    hi = (n_sub * d * 4 + 3 * t * n_sub * 4) * steps
+    flops, passes, lo, hi, t = _init_model(n_sub, d, k_max, steps)
     out.append((
-        "kmeans++ init", flops, HIGHEST_PASSES, lo, hi,
+        "kmeans++ init", flops, passes, lo, hi,
         f"{steps} greedy steps (B_l x sum(K-1)), T={t} candidates: "
         "GEMM 2*T*n_sub*d; lo: x read/step; hi: + 3 (T,n_sub) f32 "
         "blocks if unfused",
     ))
-    # Co-association: H/C chunks per K, each 2*C*k_max*N^2 bf16 FLOPs;
+    # Co-association: ceil(H/C) chunks per K (the sweep pads H and
+    # accumulates the remainder too), each 2*C*k_max*N^2 bf16 FLOPs;
     # Mij RMW dominates traffic and cannot fuse away (N^2 f32 >> VMEM).
-    chunks = (h // chunk) * n_k
+    chunks = -(-h // chunk) * n_k
     flops = 2 * chunk * k_max * n * n * chunks
-    byts = chunks * (2 * n * n * 4 + chunk * k_max * n * 2)
+    byts = _coassoc_bytes(n, n, chunk, k_max, chunks)
     out.append((
         "co-association GEMM", flops, 1, byts, byts,
-        f"{chunks} chunks (H/C={h//chunk} x {n_k} K) x 2*C*k_max*N^2 "
-        "bf16; bytes: Mij f32 RMW per chunk + bf16 one-hot operand",
+        f"{chunks} chunks (ceil(H/C)={-(-h//chunk)} x {n_k} K) x "
+        "2*C*k_max*N^2 bf16; bytes: Mij f32 RMW per chunk + bf16 "
+        "one-hot operand",
     ))
     # Histogram/CDF/PAC: stream Mij+Iij once per K.
     byts = n_k * 2 * n * n * 4
@@ -287,16 +326,172 @@ def report(config_name):
              "trace, so the floor here covers init+coassoc+hist only)"))
 
 
+def _per_k_lane_steps(config_name):
+    """Per-K lane-weighted Lloyd step counts from the on-chip
+    lloyd_iters.py artifacts, or None when not yet measured.
+
+    The artifact records LOCKSTEP steps per K (sequential steps of the
+    serialized cluster_batch groups); each lockstep step moves one
+    group's worth of lanes = cluster_batch * n_init, so lane-steps per
+    K = lockstep * that factor.  Sanity-pinned against the artifact's
+    own ``lane_steps`` total.
+    """
+    import json
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "onchip_retry_r04",
+                        f"lloyd_iters_{config_name}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        rec = json.load(f)
+    lanes_per_group = rec["cluster_batch"] * FULL_SHAPES[config_name]["n_init"]
+    per_k = {int(k): v * lanes_per_group
+             for k, v in rec["lockstep_steps_per_k"].items()}
+    if sum(per_k.values()) != rec["lane_steps"]:
+        raise AssertionError(
+            f"{path}: lockstep*{lanes_per_group} != lane_steps total"
+        )
+    return per_k
+
+
+def project(config_name, kshards, hshards, nshards):
+    """Project the floor bands onto a (k, h, n) device mesh.
+
+    Pure arithmetic over the same phase model, with the program's REAL
+    sharding semantics (parallel/sweep.py):
+
+    - clustering (Lloyd + init) is data-parallel over ALL h*n devices
+      within a k-group (resamples shard over both axes), so its floor
+      divides by h*n — modulo the assumption that convergence cost
+      spreads evenly across resample shards (the measured per-K counts
+      are sweep-wide, not per-shard);
+    - the K scan shards in CONTIGUOUS blocks over the 'k' axis (padded
+      with repeats of the last K), so the k-group critical path is the
+      max, not the mean — and the beyond-elbow Ks cluster in the tail
+      block, which this makes visible;
+    - each device owns an (N/n, N) row block of Mij and accumulates
+      ONLY its own 'h'-shard's resamples into it (labels all_gather
+      along 'n' is int32 rows, negligible): co-association chunks
+      divide by h, RMW bytes divide by n, the bf16 one-hot operand
+      does not shard over 'n'; the 'h'-axis psum of each row block
+      rides ICI at spec peak (optimistic), ~2*(h-1)/h * block bytes
+      per K;
+    - histogram/CDF reads divide by n.
+
+    Compile time, host I/O, and collective latency floors are NOT
+    modelled — this is a bytes/FLOPs projection, the same altitude as
+    the single-chip floors above.
+    """
+    if min(kshards, hshards, nshards) < 1:
+        raise SystemExit(
+            f"--mesh axes must be >= 1, got k={kshards},h={hshards},"
+            f"n={nshards}"
+        )
+    fs = FULL_SHAPES[config_name]
+    n, d, h = fs["n"], fs["d"], fs["h"]
+    n_init = fs["n_init"]
+    k_values = list(range(2, fs["k_hi"] + 1))
+    k_max = fs["k_hi"]
+    n_sub = int(0.8 * n)
+    chunk = fs["chunk"]
+    per_k = _per_k_lane_steps(config_name)
+    if per_k is None:
+        print(f"\n### {config_name} --mesh projection unavailable: no "
+              f"on-chip per-K Lloyd counts (lloyd_iters_"
+              f"{config_name}.json) yet")
+        return None
+    meas = MEASURED[config_name]
+    devs = kshards * hshards * nshards
+    n_local = -(-n // nshards)
+    # Contiguous K blocks, padded with the last K (sweep.py's scheme).
+    k_local = -(-len(k_values) // kshards)
+    padded = k_values + [k_values[-1]] * (k_local * kshards - len(k_values))
+    groups = [padded[i * k_local:(i + 1) * k_local]
+              for i in range(kshards)]
+    b_l = h * n_init
+
+    print(f"\n### {config_name} projected onto mesh "
+          f"{{'k': {kshards}, 'h': {hshards}, 'n': {nshards}}} "
+          f"({devs} chips, spec-peak ICI {ICI_BW/1e9:.0f} GB/s)\n")
+    print("| k-group | K block | lloyd floor | init floor | "
+          "coassoc+hist floor | ICI psum | group total (lo-hi) |")
+    print("|---|---|---|---|---|---|---|")
+    worst_lo = worst_hi = 0.0
+    detail = []
+    for gi, ks in enumerate(groups):
+        lane_steps = sum(per_k[k] for k in ks) / (hshards * nshards)
+        lloyd_lo, lloyd_hi = _floor_secs(
+            *_lloyd_model(n_sub, d, k_max, lane_steps))
+        steps = b_l * sum(k - 1 for k in ks) / (hshards * nshards)
+        init_lo, init_hi = _floor_secs(
+            *_init_model(n_sub, d, k_max, steps)[:4])
+        # Per device: this group's Ks, its own 'h'-shard's chunks only
+        # (each device accumulates its resample shard then psums over
+        # 'h'), RMW onto its (n_local, N) row block, plus the full
+        # one-hot operand (which does NOT shard over 'n').  Same
+        # max(flops, bytes) floor as every phase: the block GEMM is
+        # 2*C*k_max*n_local*N per chunk.
+        h_shard = -(-h // hshards)          # ceil
+        chunks = -(-h_shard // chunk) * len(ks)
+        co_flops = 2 * chunk * k_max * n_local * n * chunks
+        co_bytes = _coassoc_bytes(n_local, n, chunk, k_max, chunks)
+        co_t = _floor_secs(co_flops, 1, co_bytes, co_bytes)[0]
+        co_t += len(ks) * 2 * n_local * n * 4 / HBM_BW  # hist reads
+        ici = (len(ks) * 2 * (hshards - 1) / hshards
+               * n_local * n * 4 / ICI_BW) if hshards > 1 else 0.0
+        g_lo = lloyd_lo + init_lo + co_t + ici
+        g_hi = lloyd_hi + init_hi + co_t + ici
+        worst_lo, worst_hi = max(worst_lo, g_lo), max(worst_hi, g_hi)
+        detail.append({"ks": ks, "lloyd": (lloyd_lo, lloyd_hi),
+                       "init": (init_lo, init_hi), "coassoc_hist": co_t,
+                       "ici": ici})
+        print(f"| {gi} | K={ks[0]}..{ks[-1]}"
+              f"{' (+pad)' if len(set(ks)) < len(ks) else ''} | "
+              f"{lloyd_lo:.2f}-{lloyd_hi:.2f} s | "
+              f"{init_lo:.2f}-{init_hi:.2f} s | {co_t:.2f} s | "
+              f"{ici * 1e3:.0f} ms | {g_lo:.2f}-{g_hi:.2f} s |")
+    wall = meas["record_wall"]
+    total = h * len(k_values)
+    print(f"\ncritical path (slowest k-group): [{worst_lo:.2f}, "
+          f"{worst_hi:.2f}] s -> projected {total / worst_hi:.0f}-"
+          f"{total / worst_lo:.0f} resamples/s vs {total / wall:.0f} "
+          f"measured single-chip ({wall:.2f} s wall); ideal linear would "
+          f"be {devs}x — the gap is the contiguous-K tail block "
+          "(beyond-elbow Ks) plus the unsharded one-hot operand")
+    return worst_lo, worst_hi, detail
+
+
+def _parse_mesh(text):
+    usage = f"--mesh wants e.g. k=2,h=2,n=2 (axes >= 1), got {text!r}"
+    try:
+        parts = dict(p.split("=") for p in text.split(","))
+        sizes = {a: int(v) for a, v in parts.items()}
+    except ValueError:
+        raise SystemExit(usage)
+    unknown = set(sizes) - {"k", "h", "n"}
+    if unknown:
+        raise SystemExit(f"--mesh axes must be k/h/n, got {sorted(unknown)}")
+    if any(v < 1 for v in sizes.values()):
+        raise SystemExit(usage)
+    return sizes.get("k", 1), sizes.get("h", 1), sizes.get("n", 1)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", choices=["headline", "blobs10k"],
                    default=None)
+    p.add_argument("--mesh", default=None, metavar="k=2,h=2,n=2",
+                   help="ALSO project the floors onto a (k,h,n) device "
+                        "mesh (needs the on-chip per-K Lloyd counts)")
     args = p.parse_args(argv)
     names = [args.config] if args.config else ["headline", "blobs10k"]
     print("Chip: TPU v5e — 197 TFLOP/s bf16 MXU, 819 GB/s HBM "
           "(Precision.HIGHEST = 6 bf16 passes)")
     for name in names:
         report(name)
+        if args.mesh:
+            project(name, *_parse_mesh(args.mesh))
     return 0
 
 
