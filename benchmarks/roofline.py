@@ -355,7 +355,7 @@ def _per_k_lane_steps(config_name):
     return per_k
 
 
-def project(config_name, kshards, hshards, nshards):
+def project(config_name, kshards, hshards, nshards, interleave=False):
     """Project the floor bands onto a (k, h, n) device mesh.
 
     Pure arithmetic over the same phase model, with the program's REAL
@@ -404,16 +404,21 @@ def project(config_name, kshards, hshards, nshards):
     meas = MEASURED[config_name]
     devs = kshards * hshards * nshards
     n_local = -(-n // nshards)
-    # Contiguous K blocks, padded with the last K (sweep.py's scheme).
+    # K blocks padded with the last K: contiguous (sweep.py's default)
+    # or round-robin (SweepConfig.k_interleave).
     k_local = -(-len(k_values) // kshards)
     padded = k_values + [k_values[-1]] * (k_local * kshards - len(k_values))
-    groups = [padded[i * k_local:(i + 1) * k_local]
-              for i in range(kshards)]
+    if interleave:
+        groups = [padded[g::kshards] for g in range(kshards)]
+    else:
+        groups = [padded[i * k_local:(i + 1) * k_local]
+                  for i in range(kshards)]
     b_l = h * n_init
 
     print(f"\n### {config_name} projected onto mesh "
           f"{{'k': {kshards}, 'h': {hshards}, 'n': {nshards}}} "
-          f"({devs} chips, spec-peak ICI {ICI_BW/1e9:.0f} GB/s)\n")
+          f"({devs} chips, spec-peak ICI {ICI_BW/1e9:.0f} GB/s"
+          f"{', k_interleave' if interleave else ''})\n")
     print("| k-group | K block | lloyd floor | init floor | "
           "coassoc+hist floor | ICI psum | group total (lo-hi) |")
     print("|---|---|---|---|---|---|---|")
@@ -446,27 +451,34 @@ def project(config_name, kshards, hshards, nshards):
         detail.append({"ks": ks, "lloyd": (lloyd_lo, lloyd_hi),
                        "init": (init_lo, init_hi), "coassoc_hist": co_t,
                        "ici": ici})
-        print(f"| {gi} | K={ks[0]}..{ks[-1]}"
+        blk = (",".join(str(k) for k in ks) if interleave
+               else f"{ks[0]}..{ks[-1]}")
+        print(f"| {gi} | K={blk}"
               f"{' (+pad)' if len(set(ks)) < len(ks) else ''} | "
               f"{lloyd_lo:.2f}-{lloyd_hi:.2f} s | "
               f"{init_lo:.2f}-{init_hi:.2f} s | {co_t:.2f} s | "
               f"{ici * 1e3:.0f} ms | {g_lo:.2f}-{g_hi:.2f} s |")
     wall = meas["record_wall"]
     total = h * len(k_values)
+    gap = ("residual per-group Lloyd imbalance plus the unsharded "
+           "one-hot operand" if interleave else
+           "the contiguous-K tail block (beyond-elbow Ks) plus the "
+           "unsharded one-hot operand")
     print(f"\ncritical path (slowest k-group): [{worst_lo:.2f}, "
           f"{worst_hi:.2f}] s -> projected {total / worst_hi:.0f}-"
           f"{total / worst_lo:.0f} resamples/s vs {total / wall:.0f} "
           f"measured single-chip ({wall:.2f} s wall); ideal linear would "
-          f"be {devs}x — the gap is the contiguous-K tail block "
-          "(beyond-elbow Ks) plus the unsharded one-hot operand")
+          f"be {devs}x — the gap is {gap}")
     return worst_lo, worst_hi, detail
 
 
 def _parse_mesh(text):
     usage = f"--mesh wants e.g. k=2,h=2,n=2 (axes >= 1), got {text!r}"
     try:
-        parts = dict(p.split("=") for p in text.split(","))
-        sizes = {a: int(v) for a, v in parts.items()}
+        pairs = [p.split("=") for p in text.split(",")]
+        if len({a for a, _ in pairs}) != len(pairs):
+            raise SystemExit(f"--mesh repeats an axis: {text!r}")
+        sizes = {a: int(v) for a, v in pairs}
     except ValueError:
         raise SystemExit(usage)
     unknown = set(sizes) - {"k", "h", "n"}
@@ -484,6 +496,10 @@ def main(argv=None):
     p.add_argument("--mesh", default=None, metavar="k=2,h=2,n=2",
                    help="ALSO project the floors onto a (k,h,n) device "
                         "mesh (needs the on-chip per-K Lloyd counts)")
+    p.add_argument("--interleave", action="store_true",
+                   help="with --mesh: model SweepConfig.k_interleave "
+                        "(round-robin K assignment) instead of the "
+                        "contiguous default")
     args = p.parse_args(argv)
     names = [args.config] if args.config else ["headline", "blobs10k"]
     print("Chip: TPU v5e — 197 TFLOP/s bf16 MXU, 819 GB/s HBM "
@@ -491,7 +507,8 @@ def main(argv=None):
     for name in names:
         report(name)
         if args.mesh:
-            project(name, *_parse_mesh(args.mesh))
+            project(name, *_parse_mesh(args.mesh),
+                    interleave=args.interleave)
     return 0
 
 

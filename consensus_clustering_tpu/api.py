@@ -133,6 +133,12 @@ class ConsensusClustering:
         compute every lane's k-means++ init outside the sub-batch groups
         in one full-width vmapped pass and group only the Lloyd loop —
         bit-identical labels, full-size init GEMMs (see SweepConfig).
+    k_interleave : bool, keyword-only
+        With a 'k'-sharded mesh, assign K values to the k-groups
+        round-robin instead of in contiguous blocks, spreading the
+        slow large-K Lloyd problems across groups — identical results,
+        shorter critical path (see SweepConfig; no-op without a 'k'
+        axis).
     compute_consensus_labels : bool, keyword-only
         Opt-in consensus labels via agglomerative clustering on 1 - Cij
         (the reference's dead code path Q5, done properly).
@@ -202,6 +208,7 @@ class ConsensusClustering:
         chunk_size: int = 8,
         cluster_batch: Optional[int] = None,
         split_init: bool = False,
+        k_interleave: bool = False,
         compute_consensus_labels: bool = False,
         reseed_clusterer_per_resample: bool = False,
         checkpoint_dir: Optional[str] = None,
@@ -264,6 +271,7 @@ class ConsensusClustering:
         self.chunk_size = chunk_size
         self.cluster_batch = cluster_batch
         self.split_init = split_init
+        self.k_interleave = k_interleave
         self.compute_consensus_labels = compute_consensus_labels
         self.reseed_clusterer_per_resample = reseed_clusterer_per_resample
         self.checkpoint_dir = checkpoint_dir
@@ -380,6 +388,7 @@ class ConsensusClustering:
             chunk_size=self.chunk_size,
             cluster_batch=self.cluster_batch,
             split_init=self.split_init,
+            k_interleave=self.k_interleave,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
             use_pallas=self.use_pallas,
             dtype=self.compute_dtype,

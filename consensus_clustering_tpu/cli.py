@@ -81,6 +81,16 @@ def cmd_run(args):
     from consensus_clustering_tpu.api import ConsensusClustering
 
     x = _load_dataset(args.dataset, args.n_samples, args.n_features, args.seed)
+    mesh = None
+    if args.k_shards > 1 or args.row_shards > 1:
+        from consensus_clustering_tpu.parallel.mesh import resample_mesh
+
+        try:
+            mesh = resample_mesh(
+                row_shards=args.row_shards, k_shards=args.k_shards
+            )
+        except ValueError as e:
+            raise SystemExit(f"--k-shards/--row-shards: {e}")
     # The heatmap needs Cij, so --plot-dir implies keeping matrices
     # unless they were explicitly switched off — in which case only the
     # curve figures are written.  Labels for ordering the heatmap are
@@ -103,6 +113,8 @@ def cmd_run(args):
         use_pallas={"auto": None, "on": True, "off": False}[args.use_pallas],
         cluster_batch=args.cluster_batch or None,
         split_init=args.split_init,
+        k_interleave=args.k_interleave,
+        mesh=mesh,
         metrics_path=args.metrics_path,
         k_batch_size=args.k_batch_size,
         compute_dtype=args.compute_dtype,
@@ -231,6 +243,17 @@ def main(argv=None):
                      help="with --cluster-batch: seed all lanes in one "
                      "full-width pass, group only the Lloyd loop "
                      "(bit-identical)")
+    run.add_argument("--k-interleave", action="store_true",
+                     help="on a 'k'-sharded mesh: assign K values to "
+                     "k-groups round-robin so slow large-K problems "
+                     "spread across groups (identical results)")
+    run.add_argument("--k-shards", type=int, default=1,
+                     help="shard the K sweep over this many k-groups "
+                     "of devices (device count must be divisible by "
+                     "k-shards * row-shards)")
+    run.add_argument("--row-shards", type=int, default=1,
+                     help="shard the N x N consensus matrices over "
+                     "this many row blocks of devices")
     run.add_argument("--use-pallas", choices=["auto", "on", "off"],
                      default="auto",
                      help="consensus-histogram kernel selection")

@@ -60,6 +60,18 @@ class SweepConfig:
         way (the init keys derive the same draws).  Default False until
         the on-chip A/B records a win; no-op without cluster_batch or
         for clusterers without the hook.
+      k_interleave: with a 'k'-sharded mesh, assign K values to the
+        k-groups round-robin (group g gets ``k_values[g::k_shards]``)
+        instead of in contiguous blocks.  Large-K Lloyd problems
+        converge ~7x slower than small-K ones (measured:
+        benchmarks/onchip_retry_r04/lloyd_iters_blobs10k.json), so
+        contiguous blocks pile the slow Ks onto the tail group and it
+        sets the whole sweep's critical path; round-robin spreads them
+        (the roofline --mesh projection quantifies the gap).  Results
+        are identical — the engine un-permutes the per-K outputs — but
+        with ``store_matrices`` the un-permute moves (N, N) blocks
+        between k-groups, so keep matrices off at pod scale (the
+        facade's auto rule already does).  No-op without a 'k' axis.
       reseed_clusterer_per_resample: False (default) re-seeds the inner
         clusterer identically for every resample — the reference's semantics
         (a fixed integer ``random_state`` makes every sklearn fit draw the
@@ -92,6 +104,7 @@ class SweepConfig:
     chunk_size: int = 8
     cluster_batch: Optional[int] = None
     split_init: bool = False
+    k_interleave: bool = False
     reseed_clusterer_per_resample: bool = False
     use_pallas: Optional[bool] = None
     dtype: str = "float32"

@@ -127,6 +127,19 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     k_values_pad = tuple(config.k_values) + (config.k_values[-1],) * (
         k_local * n_k - n_ks
     )
+    # Optional round-robin K assignment (config.k_interleave): the 'k'
+    # axis shards the scan array in CONTIGUOUS blocks, so laying the
+    # padded list out as [group0's strided picks, group1's, ...] gives
+    # group g exactly k_values_pad[g::n_k] — spreading the slow
+    # beyond-elbow Ks across groups instead of piling them on the tail
+    # block.  k_unperm maps each original K position to its row in the
+    # stacked per-K outputs so callers always see k_values order.
+    if config.k_interleave and n_k > 1:
+        perm = [g + j * n_k for g in range(n_k) for j in range(k_local)]
+        k_values_pad = tuple(k_values_pad[i] for i in perm)
+        k_unperm = np.argsort(np.asarray(perm))
+    else:
+        k_unperm = None
     k_arr = jnp.asarray(k_values_pad, jnp.int32)
     # Resolve the histogram path NOW, outside the traced program: the
     # kernel-availability probe compiles and runs the Pallas kernel once on
@@ -325,8 +338,16 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
                 ]
             )
         per_k_out, iij = sharded_body(x, indices, key_cluster, k_arr)
-        # Crop K padding from the k-group layout, then row/column padding
-        # introduced by the 'n'-axis block layout.
+        # Restore k_values order if the groups ran interleaved (a
+        # cross-'k'-shard gather — tiny for the (bins,) curves; (N, N)
+        # blocks only move when store_matrices is on, see config), then
+        # crop K padding from the k-group layout, then row/column
+        # padding introduced by the 'n'-axis block layout.
+        if k_unperm is not None:
+            per_k_out = {
+                k: jnp.take(v, k_unperm, axis=0)
+                for k, v in per_k_out.items()
+            }
         per_k_out = {k: v[:n_ks] for k, v in per_k_out.items()}
         if config.store_matrices:
             per_k_out["iij"] = iij[:n, :n]

@@ -29,6 +29,26 @@ class TestCli:
         payload = json.loads(capsys.readouterr().out)
         assert payload["K"] == [3, 5]
 
+    def test_run_sharded_interleaved_matches_default(self, tmp_path):
+        # --k-shards/--row-shards build the mesh, --k-interleave
+        # re-orders the K assignment; results must be bit-identical to
+        # the default single-axis run (the fake 8-device conftest env).
+        base, sharded = tmp_path / "base.json", tmp_path / "sharded.json"
+        common = [
+            "run", "--dataset", "blobs", "--n-samples", "96",
+            "--n-features", "5", "--k", "2:4", "--iterations", "12",
+            "--seed", "11",
+        ]
+        main(common + ["--out", str(base)])
+        main(common + [
+            "--k-shards", "2", "--row-shards", "2", "--k-interleave",
+            "--out", str(sharded),
+        ])
+        a = json.loads(base.read_text())
+        b = json.loads(sharded.read_text())
+        assert a["pac_area"] == b["pac_area"]
+        assert a["best_k"] == b["best_k"]
+
     def test_unknown_clusterer_exits(self):
         import pytest
 

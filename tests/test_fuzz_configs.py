@@ -32,11 +32,13 @@ def _draw_case(seed):
     chunk = int(rng.integers(1, 9))
     cluster_batch = [None, 1, 3, 7][int(rng.integers(0, 4))]
     split_init = bool(rng.integers(0, 2))
+    k_interleave = bool(rng.integers(0, 2))
     x = rng.normal(size=(n, d)).astype(np.float32)
     config = SweepConfig(
         n_samples=n, n_features=d, k_values=ks, n_iterations=h,
         subsampling=subsampling, chunk_size=chunk,
         cluster_batch=cluster_batch, split_init=split_init,
+        k_interleave=k_interleave,
     )
     return x, config
 
@@ -46,7 +48,11 @@ def test_sweep_invariants_random_config(seed):
     x, config = _draw_case(seed)
     n, h = config.n_samples, config.n_iterations
     devices = jax.devices()
-    mesh = resample_mesh(devices[: [1, 2, 4][seed % 3]])
+    # Vary the device count AND the k axis so a drawn k_interleave=True
+    # actually exercises the permute/un-permute path (it is a no-op
+    # when the mesh has no 'k' axis).
+    n_dev, k_sh = [(1, 1), (2, 2), (4, 2)][seed % 3]
+    mesh = resample_mesh(devices[:n_dev], k_shards=k_sh)
     out = jax.tree.map(
         np.asarray,
         build_sweep(KMeans(n_init=2), config, mesh)(

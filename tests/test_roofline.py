@@ -92,9 +92,30 @@ def test_h_sharding_divides_coassoc_chunks(capsys):
     assert k_and_h[1] < k_only[1]
 
 
+def test_interleave_balances_k_groups(capsys):
+    # Round-robin K assignment must shorten the critical path vs the
+    # contiguous default (the tail block carries the beyond-elbow Ks)
+    # and tighten the spread between the lightest and heaviest group's
+    # Lloyd floor.
+    if roofline._per_k_lane_steps("blobs10k") is None:
+        pytest.skip("on-chip blobs10k Lloyd counts not present")
+    contig = roofline.project("blobs10k", 2, 2, 2)
+    inter = roofline.project("blobs10k", 2, 2, 2, interleave=True)
+    capsys.readouterr()
+    assert inter[1] < contig[1]
+    spread = [max(g["lloyd"][1] for g in p[2])
+              / min(g["lloyd"][1] for g in p[2]) for p in (contig, inter)]
+    assert spread[1] < spread[0]
+    # Same total work either way: sum of group Lloyd floors is
+    # conserved (the knob only redistributes Ks).
+    assert sum(g["lloyd"][1] for g in inter[2]) == pytest.approx(
+        sum(g["lloyd"][1] for g in contig[2]), rel=1e-6)
+
+
 def test_parse_mesh():
     assert roofline._parse_mesh("k=2,h=2,n=2") == (2, 2, 2)
     assert roofline._parse_mesh("h=4") == (1, 4, 1)
-    for bad in ("k=2,q=3", "k", "k=2=3", "k=x", "k=0", "n=-1"):
+    for bad in ("k=2,q=3", "k", "k=2=3", "k=x", "k=0", "n=-1",
+                "k=2,k=4"):
         with pytest.raises(SystemExit):
             roofline._parse_mesh(bad)

@@ -1,5 +1,7 @@
 """Sweep engine: end-to-end correctness, device-count invariance, padding."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -290,6 +292,47 @@ class TestKShardedSweep:
     def test_mesh_rejects_indivisible_k_shards(self):
         with pytest.raises(ValueError, match="not divisible"):
             resample_mesh(jax.devices(), k_shards=3)
+
+    @pytest.mark.parametrize("k_shards,row_shards", [(2, 2), (4, 1)])
+    def test_k_interleave_is_bit_identical(self, blobs, k_shards,
+                                           row_shards):
+        # Round-robin K assignment (k_interleave) changes only WHICH
+        # k-group computes each K; the engine un-permutes the stacked
+        # outputs, so every result must be bit-identical to the
+        # contiguous default — including the padded-K case (k_values
+        # not divisible by k_shards) and the matrices.
+        x, _ = blobs
+        config = _sweep_config(x, n_iterations=12)
+        assert len(config.k_values) % k_shards != 0  # padding exercised
+        km = KMeans(n_init=2)
+        mesh = resample_mesh(
+            jax.devices()[: k_shards * 2 * row_shards],
+            row_shards=row_shards, k_shards=k_shards,
+        )
+        contiguous = run_sweep(km, config, x, seed=7, mesh=mesh)
+        inter = run_sweep(
+            km, dataclasses.replace(config, k_interleave=True), x,
+            seed=7, mesh=mesh,
+        )
+        for name in ("iij", "mij", "cij", "hist", "cdf", "pac_area"):
+            np.testing.assert_array_equal(
+                contiguous[name], inter[name], err_msg=name
+            )
+
+    def test_k_interleave_noop_without_k_axis(self, blobs):
+        # No 'k' axis: the knob must change nothing (not even compile a
+        # different program shape) — outputs bit-identical.
+        x, _ = blobs
+        config = _sweep_config(x, n_iterations=8)
+        km = KMeans(n_init=2)
+        mesh = resample_mesh(jax.devices()[:2])
+        base = run_sweep(km, config, x, seed=3, mesh=mesh)
+        inter = run_sweep(
+            km, dataclasses.replace(config, k_interleave=True), x,
+            seed=3, mesh=mesh,
+        )
+        np.testing.assert_array_equal(base["mij"], inter["mij"])
+        np.testing.assert_array_equal(base["pac_area"], inter["pac_area"])
 
 
 class TestSweepConfigValidation:
