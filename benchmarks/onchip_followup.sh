@@ -8,11 +8,16 @@
 #      bit-identical to the default's pac_all at the same rounding —
 #      the preserved records carry only pac_head (3 values), so this
 #      run supplies the other 16.
-#   2/3. split_init A/B at the headline shape (N=5000 H=500,
+#   2/3. the same A/B at the HEADLINE shape (max_iter=25 vs the
+#      default 100 printing pac_all): headline is the config the
+#      driver records, and its K=2..20 sweep over 8-center blobs has
+#      the same beyond-elbow structure the +42% blobs10k win came
+#      from.
+#   4/5. split_init A/B at the headline shape (N=5000 H=500,
 #      cluster_batch=16, chunk 4): PERF.md "Remaining headroom" says
 #      pin SweepConfig.split_init in bench.py only on a reproduced
 #      on-chip win; CPU A/B was neutral.
-#   4/5. split_init A/B at the blobs10k shape (N=10000 H=1000,
+#   6/7. split_init A/B at the blobs10k shape (N=10000 H=1000,
 #      cluster_batch=8, chunk 8).
 #
 # Bookkeeping, probe gating, and the driver loop are shared with the
@@ -34,7 +39,8 @@ PROBE_EVERY=${ONCHIP_FOLLOWUP_PROBE_EVERY:-300}
 RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
 . benchmarks/_onchip_step.sh
 
-STEP_NAMES="maxiter100_blobs10k splitinit_headline_off splitinit_headline_on \
+STEP_NAMES="maxiter100_blobs10k maxiter25_headline maxiter100_headline \
+splitinit_headline_off splitinit_headline_on \
 splitinit_blobs10k_off splitinit_blobs10k_on"
 
 # onchip_retry.sh's queue, kept in sync with its STEP_NAMES: the
@@ -55,6 +61,12 @@ run_step() {
   case $1 in
     maxiter100_blobs10k)
       step maxiter100_blobs10k python benchmarks/maxiter_probe.py --max-iter 100 ;;
+    maxiter25_headline)
+      step maxiter25_headline python benchmarks/maxiter_probe.py \
+          --config headline --max-iter 25 ;;
+    maxiter100_headline)
+      step maxiter100_headline python benchmarks/maxiter_probe.py \
+          --config headline --max-iter 100 ;;
     splitinit_headline_off)
       step splitinit_headline_off python benchmarks/tune.py \
           --n 5000 --h 500 --cluster-batches 16 --chunk-size 4 ;;
