@@ -1,0 +1,140 @@
+"""Extract per-phase device times from a ``jax.profiler`` trace.
+
+Round 3 derived the headline phase split (PERF.md "Where the time
+goes") by reading the xplane trace by hand; this tool makes that step
+reproducible: point it at a ``--profile-dir`` written by
+``run_sweep(..., profile_dir=...)`` / ``bench.py --profile-dir`` and it
+
+1. loads every ``*.xplane.pb`` plane whose name matches ``--plane``
+   (default: device planes — ``TPU`` / ``/device:``; falls back to all
+   non-metadata planes so CPU host traces still print something),
+2. aggregates event durations per op name,
+3. prints the top ``--top`` ops (the calibration view: bucket regexes
+   are written FROM this listing, never guessed), and
+4. sums durations into named buckets by regex
+   (``--buckets '{"lloyd": "while", ...}'`` or the built-in defaults
+   below) and prints one JSON line.
+
+The default buckets encode how the sweep's phases lower on TPU today:
+the Lloyd body is the program's only ``while`` loop, the greedy
+k-means++ init is its only ``fori`` loop over candidate GEMMs, the
+accumulation is the big bf16 ``dot``/convert fusion writing Mij, and
+the histogram/CDF is the Pallas ``consensus_hist`` custom call (XLA
+fallback: the bincount fusion).  Calibrate against the top-ops listing
+whenever the program structure changes — a bucket regex that matches
+nothing is reported as 0 and flagged, never silently dropped.
+
+    python benchmarks/trace_phases.py --profile-dir <dir> [--top 30]
+"""
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_BUCKETS = {
+    # Lloyd assign+update: the vmapped/batched while loop body.
+    "lloyd": r"while|lloyd",
+    # k-means++ greedy init: fori loop / candidate-distance fusions.
+    "init": r"fori|init|candidate",
+    # Co-association accumulation GEMMs onto Mij.
+    "coassoc": r"dot|matmul|coassoc|one_hot",
+    # Histogram / CDF / PAC (Pallas kernel or bincount fallback).
+    "hist": r"consensus_hist|bincount|hist",
+}
+
+
+def load_planes(profile_dir, plane_re):
+    """Yield (plane_name, {op_name: duration_ps}) for matching planes."""
+    paths = sorted(glob.glob(
+        os.path.join(profile_dir, "**", "*.xplane.pb"), recursive=True))
+    if not paths:
+        raise SystemExit(f"no *.xplane.pb under {profile_dir!r}")
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:  # pragma: no cover - environment-specific
+        raise SystemExit(
+            f"cannot import xplane proto ({e}); this tool needs the "
+            "tensorflow wheel that ships tsl/profiler/protobuf"
+        )
+    space = xplane_pb2.XSpace()
+    # Newest file by mtime (the profiler writes one session dir per
+    # run; multi-host traces put one file per host in the SAME dir, so
+    # tell the user which file was read).
+    path = max(paths, key=os.path.getmtime)
+    if len(paths) > 1:
+        print(f"note: {len(paths)} xplane files under {profile_dir!r}; "
+              f"reading newest: {path!r}", file=sys.stderr)
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    pat = re.compile(plane_re, re.IGNORECASE)
+    planes = [p for p in space.planes if p.lines and pat.search(p.name)]
+    if not planes:
+        # Fall back to anything with events so host-only (CPU) traces
+        # still give the calibration listing.
+        planes = [p for p in space.planes
+                  if p.lines and "TFStreamz" not in p.name]
+    if not planes:
+        raise SystemExit(
+            f"{path!r} parsed but contains no planes with events "
+            "(truncated trace?)"
+        )
+    for plane in planes:
+        md = plane.event_metadata
+        agg = collections.Counter()
+        for line in plane.lines:
+            for ev in line.events:
+                agg[md[ev.metadata_id].name] += ev.duration_ps
+        yield plane.name, agg
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--profile-dir", required=True)
+    p.add_argument("--plane", default=r"TPU|/device:",
+                   help="regex selecting trace planes (default: device "
+                        "planes; falls back to all non-metadata planes)")
+    p.add_argument("--top", type=int, default=30)
+    p.add_argument("--buckets", default=None,
+                   help="JSON object {bucket: regex}; default is the "
+                        "built-in phase mapping")
+    args = p.parse_args(argv)
+    buckets = (json.loads(args.buckets) if args.buckets
+               else DEFAULT_BUCKETS)
+    compiled = {k: re.compile(v, re.IGNORECASE) for k, v in buckets.items()}
+
+    out = {}
+    for name, agg in load_planes(args.profile_dir, args.plane):
+        total_ms = sum(agg.values()) / 1e9
+        print(f"== plane {name!r}: {total_ms:.1f} ms total over "
+              f"{len(agg)} distinct ops", file=sys.stderr)
+        for op, ps in agg.most_common(args.top):
+            print(f"  {ps/1e9:9.2f} ms  {op[:100]}", file=sys.stderr)
+        sums = {b: 0.0 for b in compiled}
+        other = 0.0
+        for op, ps in agg.items():
+            for b, rx in compiled.items():
+                if rx.search(op):
+                    sums[b] += ps / 1e9
+                    break
+            else:
+                other += ps / 1e9
+        empty = [b for b, v in sums.items() if v == 0.0]
+        if empty:
+            print(f"  WARNING: buckets matched nothing: {empty} — "
+                  "recalibrate regexes against the listing above",
+                  file=sys.stderr)
+        out[name] = {"total_ms": round(total_ms, 2),
+                     "buckets_ms": {b: round(v, 2)
+                                    for b, v in sums.items()},
+                     "other_ms": round(other, 2),
+                     "unmatched_buckets": empty}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,124 @@
+"""Tests for the xplane phase-extraction tool (benchmarks/trace_phases.py).
+
+Builds a synthetic XSpace proto (no accelerator, no jax) so the
+aggregation, plane selection, bucket regexes, and empty-bucket warning
+are pinned hermetically.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "benchmarks")
+)
+
+xplane_pb2 = pytest.importorskip(
+    "tensorflow.tsl.profiler.protobuf.xplane_pb2",
+    reason="xplane proto not available in this environment",
+)
+import trace_phases  # noqa: E402
+
+
+def _write_space(tmp_path, plane_name, events):
+    """events: [(op_name, duration_ps), ...] on one line."""
+    space = xplane_pb2.XSpace()
+    plane = space.planes.add(name=plane_name)
+    line = plane.lines.add(name="ops")
+    for i, (op, ps) in enumerate(events, start=1):
+        plane.event_metadata[i].id = i
+        plane.event_metadata[i].name = op
+        line.events.add(metadata_id=i, duration_ps=ps)
+    d = tmp_path / "plugins" / "profile" / "x"
+    d.mkdir(parents=True)
+    (d / "host.xplane.pb").write_bytes(space.SerializeToString())
+    return tmp_path
+
+
+def test_aggregates_and_buckets(tmp_path, capsys):
+    _write_space(tmp_path, "/device:TPU:0", [
+        ("fusion.while_body.123", 5_000_000_000),      # lloyd, 5 ms
+        ("fori_loop.candidate_dists", 2_000_000_000),  # init, 2 ms
+        ("dot_general.coassoc", 3_000_000_000),        # coassoc, 3 ms
+        ("consensus_hist_kernel", 1_000_000_000),      # hist, 1 ms
+        ("copy-start", 500_000_000),                   # other
+    ])
+    rc = trace_phases.main(["--profile-dir", str(tmp_path), "--top", "3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    b = out["/device:TPU:0"]
+    assert b["buckets_ms"] == {
+        "lloyd": 5.0, "init": 2.0, "coassoc": 3.0, "hist": 1.0}
+    assert b["other_ms"] == 0.5
+    assert b["total_ms"] == 11.5
+    assert b["unmatched_buckets"] == []
+
+
+def test_plane_selection_prefers_device(tmp_path, capsys):
+    space = xplane_pb2.XSpace()
+    for name, op in (("/host:CPU", "tree_map"),
+                     ("/device:TPU:0", "while_loop")):
+        plane = space.planes.add(name=name)
+        plane.event_metadata[1].id = 1
+        plane.event_metadata[1].name = op
+        plane.lines.add(name="l").events.add(
+            metadata_id=1, duration_ps=10**9)
+    d = tmp_path / "p"
+    d.mkdir()
+    (d / "a.xplane.pb").write_bytes(space.SerializeToString())
+    rc = trace_phases.main(["--profile-dir", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert list(out) == ["/device:TPU:0"]
+
+
+def test_empty_bucket_is_flagged_not_dropped(tmp_path, capsys):
+    _write_space(tmp_path, "/device:TPU:0",
+                 [("while_loop", 10**9)])
+    trace_phases.main(["--profile-dir", str(tmp_path)])
+    captured = capsys.readouterr()
+    out = json.loads(captured.out)
+    flagged = out["/device:TPU:0"]["unmatched_buckets"]
+    assert set(flagged) == {"init", "coassoc", "hist"}
+    assert "matched nothing" in captured.err
+
+
+def test_missing_dir_is_clean_error(tmp_path):
+    with pytest.raises(SystemExit, match="no .*xplane"):
+        trace_phases.main(["--profile-dir", str(tmp_path / "nope")])
+
+
+def test_eventless_trace_is_clean_error(tmp_path):
+    # A parseable XSpace with no event-bearing planes must error, not
+    # print an empty-but-successful {}.
+    space = xplane_pb2.XSpace()
+    space.planes.add(name="/host:metadata")
+    d = tmp_path / "p"
+    d.mkdir()
+    (d / "a.xplane.pb").write_bytes(space.SerializeToString())
+    with pytest.raises(SystemExit, match="no planes with events"):
+        trace_phases.main(["--profile-dir", str(tmp_path)])
+
+
+def test_newest_file_by_mtime_wins(tmp_path, capsys):
+    # Two sessions where the OLDER sorts last lexicographically: mtime
+    # must pick the newer one.
+    import time
+
+    old = _write_space(tmp_path, "/device:TPU:0",
+                       [("while_loop.old", 10**9)])
+    newer_dir = tmp_path / "plugins" / "profile" / "a_sorts_first"
+    newer_dir.mkdir(parents=True)
+    space = xplane_pb2.XSpace()
+    plane = space.planes.add(name="/device:TPU:0")
+    plane.event_metadata[1].id = 1
+    plane.event_metadata[1].name = "while_loop.new"
+    plane.lines.add(name="l").events.add(metadata_id=1, duration_ps=10**9)
+    time.sleep(0.05)
+    (newer_dir / "b.xplane.pb").write_bytes(space.SerializeToString())
+    trace_phases.main(["--profile-dir", str(tmp_path), "--top", "2"])
+    captured = capsys.readouterr()
+    assert "while_loop.new" in captured.err
+    assert "reading newest" in captured.err
