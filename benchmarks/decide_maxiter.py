@@ -1,0 +1,94 @@
+"""Decide whether the max_iter cap can be pinned for a bench config.
+
+The rule (benchmarks/maxiter_probe.py, PERF.md "The beyond-elbow Lloyd
+budget"): the cap may become a bench-config default ONLY if the full
+PAC vector is bit-identical at the probe's 5-decimal rounding between
+the capped run and the default-cap (max_iter=100) run, both measured
+on chip at the true shape.  This tool IS that comparison — point it at
+the two probe artifacts and it prints the verdict plus the evidence,
+so the pin decision is a committed, re-runnable check instead of a
+by-hand diff:
+
+    python benchmarks/decide_maxiter.py \
+        --capped benchmarks/onchip_retry_r04/maxiter25_blobs10k.json \
+        --default benchmarks/onchip_followup_r04/maxiter100_blobs10k.json
+
+Exit code 0 = PAC bit-identical (pin allowed, with disclosure beside
+the vs_baseline multiple — the serial baseline ran sklearn's own
+default); 1 = vectors differ (cap stays a user knob); 2 = artifacts
+unusable (missing pac_all, length mismatch).
+"""
+
+import argparse
+import json
+import sys
+
+
+def decide(capped, default):
+    """Returns (verdict_dict, exit_code); pure function for tests."""
+    cap_pac = capped.get("pac_all")
+    def_pac = default.get("pac_all")
+    if not cap_pac or not def_pac:
+        return {"verdict": "unusable",
+                "reason": "pac_all missing from an artifact"}, 2
+    if len(cap_pac) != len(def_pac):
+        return {"verdict": "unusable",
+                "reason": f"pac_all length mismatch "
+                          f"({len(cap_pac)} vs {len(def_pac)})"}, 2
+    deltas = [abs(a - b) for a, b in zip(cap_pac, def_pac)]
+    max_delta = max(deltas)
+    speedup = None
+    if capped.get("value") and default.get("value"):
+        speedup = round(capped["value"] / default["value"], 3)
+    out = {
+        "k_values_compared": len(cap_pac),
+        "max_pac_delta": max_delta,
+        "first_divergent_k": (
+            None if max_delta == 0.0
+            else 2 + next(i for i, d in enumerate(deltas) if d > 0.0)
+        ),
+        "rate_capped": capped.get("value"),
+        "rate_default": default.get("value"),
+        "speedup_capped_over_default": speedup,
+    }
+    if max_delta == 0.0:
+        out["verdict"] = "identical"
+        out["decision"] = (
+            "pin allowed: PAC bit-identical at the artifact rounding; "
+            "disclose the cap beside vs_baseline (serial baseline ran "
+            "sklearn's default max_iter)"
+        )
+        return out, 0
+    out["verdict"] = "divergent"
+    out["decision"] = (
+        "do NOT pin: the cap changes the statistic; it stays a user "
+        "knob (clusterer_options={'max_iter': ...})"
+    )
+    return out, 1
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--capped", required=True,
+                   help="probe artifact for the capped run")
+    p.add_argument("--default", required=True, dest="default_",
+                   help="probe artifact for the default-cap run")
+    args = p.parse_args(argv)
+    artifacts = []
+    for path in (args.capped, args.default_):
+        try:
+            with open(path) as f:
+                artifacts.append(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            print(json.dumps({"verdict": "unusable",
+                              "reason": f"{path}: {e}"}))
+            return 2
+    out, rc = decide(*artifacts)
+    out["capped_artifact"] = args.capped
+    out["default_artifact"] = args.default_
+    print(json.dumps(out))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

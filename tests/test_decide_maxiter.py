@@ -1,0 +1,79 @@
+"""Tests for the max_iter pin-decision rule (benchmarks/decide_maxiter.py)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "benchmarks")
+)
+
+import decide_maxiter  # noqa: E402
+
+
+def _art(pac, value=None):
+    out = {"pac_all": pac}
+    if value is not None:
+        out["value"] = value
+    return out
+
+
+def test_identical_pac_allows_pin():
+    pac = [0.15574, 0.15624, 0.12986, 0.05998]
+    out, rc = decide_maxiter.decide(_art(pac, 1504.45), _art(pac, 1060.74))
+    assert rc == 0
+    assert out["verdict"] == "identical"
+    assert out["max_pac_delta"] == 0.0
+    assert out["first_divergent_k"] is None
+    assert out["speedup_capped_over_default"] == pytest.approx(1.418, abs=1e-3)
+
+
+def test_any_divergence_blocks_pin():
+    a = [0.15574, 0.15624, 0.12986]
+    b = [0.15574, 0.15625, 0.12986]  # one ulp-at-rounding difference
+    out, rc = decide_maxiter.decide(_art(a), _art(b))
+    assert rc == 1
+    assert out["verdict"] == "divergent"
+    assert out["first_divergent_k"] == 3  # K starts at 2
+    assert "NOT pin" in out["decision"]
+
+
+def test_first_divergent_k_is_first_not_largest():
+    # The FIRST nonzero delta wins, even when a later delta is larger.
+    a = [0.5, 0.40001, 0.30002]
+    b = [0.5, 0.40000, 0.30000]
+    out, rc = decide_maxiter.decide(_art(a), _art(b))
+    assert rc == 1
+    assert out["first_divergent_k"] == 3
+    assert out["max_pac_delta"] == pytest.approx(2e-5)
+
+
+def test_unusable_artifacts():
+    out, rc = decide_maxiter.decide({"pac_all": []}, _art([0.1]))
+    assert rc == 2
+    out, rc = decide_maxiter.decide(_art([0.1, 0.2]), _art([0.1]))
+    assert rc == 2
+    assert "length mismatch" in out["reason"]
+
+
+def test_cli_round_trip(tmp_path, capsys):
+    pac = [0.5, 0.4]
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_art(pac, 200.0)))
+    b.write_text(json.dumps(_art(pac, 100.0)))
+    rc = decide_maxiter.main(["--capped", str(a), "--default", str(b)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["verdict"] == "identical"
+    assert out["capped_artifact"] == str(a)
+
+
+def test_cli_missing_file(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(_art([0.1])))
+    rc = decide_maxiter.main(
+        ["--capped", str(a), "--default", str(tmp_path / "nope.json")])
+    assert rc == 2
+    assert json.loads(capsys.readouterr().out)["verdict"] == "unusable"
