@@ -115,7 +115,7 @@ def count(config_name, h_override=None):
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", default="blobs10k",
-                   choices=["headline", "blobs10k"])
+                   choices=["headline", "blobs10k", "blobs20k"])
     p.add_argument("--h", type=int, default=None,
                    help="override H (full-H is the roofline-relevant "
                         "count; smaller H underestimates group maxima)")

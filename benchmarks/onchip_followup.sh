@@ -19,6 +19,9 @@
 #      on-chip win; CPU A/B was neutral.
 #   6/7. split_init A/B at the blobs10k shape (N=10000 H=1000,
 #      cluster_batch=8, chunk 8).
+#   8. exact on-chip Lloyd lockstep counts at the blobs20k shape
+#      (completes the large-N roofline set; validates the CPU-derived
+#      count the way blobs10k's was).
 #
 # Bookkeeping, probe gating, and the driver loop are shared with the
 # session/retry scripts (benchmarks/_onchip_step.sh): .json only on
@@ -41,7 +44,7 @@ RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
 
 STEP_NAMES="maxiter100_blobs10k maxiter25_headline maxiter100_headline \
 splitinit_headline_off splitinit_headline_on \
-splitinit_blobs10k_off splitinit_blobs10k_on"
+splitinit_blobs10k_off splitinit_blobs10k_on lloyd_iters_blobs20k"
 
 # onchip_retry.sh's queue, kept in sync with its STEP_NAMES: the
 # followup yields the tunnel until each of these is settled in
@@ -79,6 +82,9 @@ run_step() {
     splitinit_blobs10k_on)
       step splitinit_blobs10k_on python benchmarks/tune.py \
           --n 10000 --h 1000 --cluster-batches 8 --chunk-size 8 --split-init ;;
+    lloyd_iters_blobs20k)
+      step lloyd_iters_blobs20k python benchmarks/lloyd_iters.py \
+          --config blobs20k ;;
     *) log "run_step: no command registered for step '$1'"; return 1 ;;
   esac
 }

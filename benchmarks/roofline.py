@@ -137,6 +137,21 @@ MEASURED = {
                       "onchip_retry_r04/lloyd_iters_blobs10k.json "
                       "(on-chip Lloyd count)",
     },
+    "blobs20k": {
+        # Full-H CPU measurement — exact, no extrapolation (H=100 is
+        # CPU-tractable; lloyd_iters_blobs20k_cpu.json).  The on-chip
+        # confirmation is queued (onchip_followup.sh); blobs10k's chip
+        # count landed within 1.1% of its CPU-derived estimate.
+        "phase_seconds": {},
+        "traced_device_total": None,
+        # One ungrouped batch of 300 lanes per K (cluster_batch off at
+        # this low-H shape).
+        "lloyd_lane_steps": 73_500,
+        "record_wall": 900 / 395.56,
+        "provenance": "onchip_records_r04.json (wall) + "
+                      "lloyd_iters_blobs20k_cpu.json (CPU-measured "
+                      "full-H Lloyd count)",
+    },
 }
 
 
@@ -339,9 +354,12 @@ def _per_k_lane_steps(config_name):
     import json
 
     here = os.path.dirname(os.path.abspath(__file__))
-    path = os.path.join(here, "onchip_retry_r04",
-                        f"lloyd_iters_{config_name}.json")
-    if not os.path.exists(path):
+    candidates = [
+        os.path.join(here, d, f"lloyd_iters_{config_name}.json")
+        for d in ("onchip_retry_r04", "onchip_followup_r04")
+    ]
+    path = next((p for p in candidates if os.path.exists(p)), None)
+    if path is None:
         return None
     with open(path) as f:
         rec = json.load(f)
@@ -491,7 +509,8 @@ def _parse_mesh(text):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--config", choices=["headline", "blobs10k"],
+    p.add_argument("--config",
+                   choices=["headline", "blobs10k", "blobs20k"],
                    default=None)
     p.add_argument("--mesh", default=None, metavar="k=2,h=2,n=2",
                    help="ALSO project the floors onto a (k,h,n) device "
@@ -501,7 +520,8 @@ def main(argv=None):
                         "(round-robin K assignment) instead of the "
                         "contiguous default")
     args = p.parse_args(argv)
-    names = [args.config] if args.config else ["headline", "blobs10k"]
+    names = ([args.config] if args.config
+             else ["headline", "blobs10k", "blobs20k"])
     print("Chip: TPU v5e — 197 TFLOP/s bf16 MXU, 819 GB/s HBM "
           "(Precision.HIGHEST = 6 bf16 passes)")
     for name in names:
