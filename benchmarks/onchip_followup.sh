@@ -1,35 +1,38 @@
 #!/usr/bin/env bash
-# Round-4 follow-up on-chip steps, run after onchip_retry.sh settles:
+# The consolidated round-4 on-chip queue: everything still tunnel-gated,
+# in DECISION-VALUE order (the wedge history shows healthy windows can
+# be short, so the steps that gate pin decisions go first and the
+# profiler trace — slowest through the tunnel, least decisive — goes
+# last):
 #
-#   1. maxiter100_blobs10k — the DEFAULT-cap (max_iter=100) probe run,
+#   1. maxiter100_blobs10k — the DEFAULT-cap (max_iter=100) probe
 #      printing the full 19-value PAC vector.  The max_iter=25 probe
 #      (onchip_retry_r04/maxiter25_blobs10k.json, 1504.5 r/s vs the
-#      1060.7 default record) can only be pinned if its pac_all is
-#      bit-identical to the default's pac_all at the same rounding —
-#      the preserved records carry only pac_head (3 values), so this
-#      run supplies the other 16.
-#   2/3. the same A/B at the HEADLINE shape (max_iter=25 vs the
-#      default 100 printing pac_all): headline is the config the
-#      driver records, and its K=2..20 sweep over 8-center blobs has
-#      the same beyond-elbow structure the +42% blobs10k win came
-#      from.
-#   4/5. split_init A/B at the headline shape (N=5000 H=500,
-#      cluster_batch=16, chunk 4): PERF.md "Remaining headroom" says
-#      pin SweepConfig.split_init in bench.py only on a reproduced
-#      on-chip win; CPU A/B was neutral.
-#   6/7. split_init A/B at the blobs10k shape (N=10000 H=1000,
-#      cluster_batch=8, chunk 8).
-#   8. exact on-chip Lloyd lockstep counts at the blobs20k shape
-#      (completes the large-N roofline set; validates the CPU-derived
-#      count the way blobs10k's was).
+#      1060.7 default record) can only be pinned if pac_all is
+#      bit-identical (benchmarks/decide_maxiter.py is the committed
+#      decision rule).
+#   2/3. the same A/B at the HEADLINE shape (the config the driver
+#      records; same beyond-elbow K structure the +42% came from).
+#   4/5. split_init A/B at the headline shape (cluster_batch=16,
+#      chunk 4): pin only on a reproduced on-chip win (CPU A/B
+#      neutral).
+#   6/7. split_init A/B at the blobs10k shape (cluster_batch=8,
+#      chunk 8).
+#   8. on-chip Lloyd lockstep counts at the headline shape (unlocks
+#      the headline pod projection; migrated from onchip_retry.sh,
+#      which settled its other steps in the 03:28Z window).
+#   9. on-chip Lloyd counts at the blobs20k shape (confirms the exact
+#      CPU count, lloyd_iters_blobs20k_cpu.json).
+#   10. a blobs10k profiler trace (phase split for the roofline's
+#      measured column; benchmarks/trace_phases.py extracts it).
 #
 # Bookkeeping, probe gating, and the driver loop are shared with the
 # session/retry scripts (benchmarks/_onchip_step.sh): .json only on
 # success, .done markers, fail caps, health probe between failures.
-# The retry queue owns the tunnel first: this script WAITS until every
-# onchip_retry.sh step is done or abandoned before submitting anything
-# — two full-shape sweeps through one 16 GB chip can OOM each other
-# and burn fail caps on steps that would have succeeded serially.
+# The gate below waits only for the steps onchip_retry.sh actually
+# settled — its two unfinished steps (lloyd_iters_headline,
+# blobs10k_trace) are OWNED BY THIS QUEUE now; do not run both
+# watchers at once.
 #
 #   bash benchmarks/onchip_followup.sh
 
@@ -44,13 +47,13 @@ RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
 
 STEP_NAMES="maxiter100_blobs10k maxiter25_headline maxiter100_headline \
 splitinit_headline_off splitinit_headline_on \
-splitinit_blobs10k_off splitinit_blobs10k_on lloyd_iters_blobs20k"
+splitinit_blobs10k_off splitinit_blobs10k_on \
+lloyd_iters_headline lloyd_iters_blobs20k blobs10k_trace"
 
-# onchip_retry.sh's queue, kept in sync with its STEP_NAMES: the
-# followup yields the tunnel until each of these is settled in
-# RETRY_DIR (or the dir doesn't exist — nothing to yield to).
-RETRY_STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k \
-lloyd_iters_headline blobs10k_trace"
+# The retry-queue steps that must be settled in RETRY_DIR before this
+# queue touches the tunnel (the two steps the retry watcher never
+# finished are deliberately absent — they are in STEP_NAMES above).
+RETRY_STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k"
 
 retry_settled() {
   [ -d "$RETRY_DIR" ] || return 0
@@ -82,9 +85,15 @@ run_step() {
     splitinit_blobs10k_on)
       step splitinit_blobs10k_on python benchmarks/tune.py \
           --n 10000 --h 1000 --cluster-batches 8 --chunk-size 8 --split-init ;;
+    lloyd_iters_headline)
+      step lloyd_iters_headline python benchmarks/lloyd_iters.py \
+          --config headline ;;
     lloyd_iters_blobs20k)
       step lloyd_iters_blobs20k python benchmarks/lloyd_iters.py \
           --config blobs20k ;;
+    blobs10k_trace)
+      step blobs10k_trace python bench.py --config blobs10k --repeats 1 \
+          --profile-dir "$OUT/blobs10k_trace" ;;
     *) log "run_step: no command registered for step '$1'"; return 1 ;;
   esac
 }

@@ -36,8 +36,13 @@ PROBE_EVERY=${ONCHIP_RETRY_PROBE_EVERY:-480}
 # name here + a case arm; a name without an arm fails loudly per pass.
 # (onchip_followup.sh mirrors this list as RETRY_STEP_NAMES to know
 # when to take the tunnel — keep them in sync.)
-STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k \
-lloyd_iters_headline blobs10k_trace"
+#
+# lloyd_iters_headline and blobs10k_trace MIGRATED to
+# onchip_followup.sh (05:35Z): the 03:35Z wedge left them unfinished
+# here, and the followup queue's pin-gate steps outrank them — one
+# queue, value-ordered, instead of two contending for the first
+# healthy window.
+STEP_NAMES="spectral gmm maxiter25_blobs10k lloyd_iters_blobs10k"
 
 run_step() {
   case $1 in
