@@ -24,7 +24,9 @@ def _draw_case(seed):
     subsampling = float(rng.uniform(0.5, 1.0))
     n_sub = max(1, int(subsampling * n))
     k_max_cap = min(8, n_sub)
-    n_ks = int(rng.integers(1, 4))
+    # Up to 6 K values so k-sharded draws (k_sh=2 below) exercise
+    # multi-K-per-group slices and padding, not just 1-2 per group.
+    n_ks = int(rng.integers(1, 7))
     ks = tuple(sorted(rng.choice(
         np.arange(2, k_max_cap + 1), size=min(n_ks, k_max_cap - 1),
         replace=False,
