@@ -35,17 +35,37 @@ def decide(capped, default):
         return {"verdict": "unusable",
                 "reason": f"pac_all length mismatch "
                           f"({len(cap_pac)} vs {len(def_pac)})"}, 2
+    cap_kv, def_kv = capped.get("k_values"), default.get("k_values")
+    if cap_kv and def_kv and cap_kv != def_kv:
+        # Same-length sweeps over DIFFERENT K ranges would compare PAC
+        # values for different Ks element-wise; never decide from that.
+        return {"verdict": "unusable",
+                "reason": f"k_values disagree ({cap_kv} vs {def_kv}): "
+                          "the artifacts are from different sweeps"}, 2
     deltas = [abs(a - b) for a, b in zip(cap_pac, def_pac)]
     max_delta = max(deltas)
     speedup = None
     if capped.get("value") and default.get("value"):
         speedup = round(capped["value"] / default["value"], 3)
+    # The K label for a divergence comes from the artifact's own
+    # k_values (maxiter_probe.py records it), never from assuming the
+    # sweep starts at K=2; artifacts predating the field fall back to
+    # index-only reporting.
+    k_values = None
+    for art in (capped, default):
+        kv = art.get("k_values")
+        if isinstance(kv, list) and len(kv) == len(cap_pac):
+            k_values = kv
+            break
+    div_idx = (None if max_delta == 0.0
+               else next(i for i, d in enumerate(deltas) if d > 0.0))
     out = {
         "k_values_compared": len(cap_pac),
         "max_pac_delta": max_delta,
+        "first_divergent_index": div_idx,
         "first_divergent_k": (
-            None if max_delta == 0.0
-            else 2 + next(i for i, d in enumerate(deltas) if d > 0.0)
+            k_values[div_idx]
+            if div_idx is not None and k_values is not None else None
         ),
         "rate_capped": capped.get("value"),
         "rate_default": default.get("value"),

@@ -120,6 +120,9 @@ def main(argv=None):
         "compile_seconds": round(out["timing"]["compile_seconds"], 2),
         "pac_head": [round(float(v), 5) for v in out["pac_area"][:3]],
         "pac_all": [round(float(v), 5) for v in out["pac_area"]],
+        # decide_maxiter.py labels a divergence with the actual K from
+        # here instead of assuming the sweep starts at K=2.
+        "k_values": [int(k) for k in config.k_values],
     }))
     return 0
 

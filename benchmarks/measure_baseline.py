@@ -91,7 +91,7 @@ def build(config_name):
     if config_name == "agglo":
         return (_seed_tolerant_agglomerative(fs["linkage"]), {},
                 corr_after_powertransform(), k_values, fs["h"])
-    if config_name == "spectral":
+    if config_name in ("spectral", "spectral10k"):
         return (SpectralClustering(gamma=fs["gamma"]), {},
                 _blobs64(fs["n"], fs["d"]), k_values, fs["h"])
     if config_name == "gmm":
@@ -104,8 +104,8 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--config", required=True,
-        choices=["headline", "corr", "agglo", "spectral", "gmm",
-                 "blobs10k", "blobs20k"],
+        choices=["headline", "corr", "agglo", "spectral", "spectral10k",
+                 "gmm", "blobs10k", "blobs20k"],
     )
     parser.add_argument(
         "--h-measured", type=int, default=10,

@@ -18,12 +18,16 @@
 #      neutral).
 #   6/7. split_init A/B at the blobs10k shape (cluster_batch=8,
 #      chunk 8).
-#   8. on-chip Lloyd lockstep counts at the headline shape (unlocks
+#   8. spectral10k — BASELINE #5's family executed at the largest
+#      single-chip N (N=10000, K=2..30, lobpcg, cluster_batch=1):
+#      turns the 5.1 GB/device compile-level plan into a measured
+#      point (round-5 queue addition, VERDICT r4 next-#4).
+#   9. on-chip Lloyd lockstep counts at the headline shape (unlocks
 #      the headline pod projection; migrated from onchip_retry.sh,
 #      which settled its other steps in the 03:28Z window).
-#   9. on-chip Lloyd counts at the blobs20k shape (confirms the exact
+#   10. on-chip Lloyd counts at the blobs20k shape (confirms the exact
 #      CPU count, lloyd_iters_blobs20k_cpu.json).
-#   10. a blobs10k profiler trace (phase split for the roofline's
+#   11. a blobs10k profiler trace (phase split for the roofline's
 #      measured column; benchmarks/trace_phases.py extracts it).
 #
 # Bookkeeping, probe gating, and the driver loop are shared with the
@@ -48,7 +52,7 @@ RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
 STEP_NAMES="maxiter100_blobs10k maxiter25_headline maxiter100_headline \
 splitinit_headline_off splitinit_headline_on \
 splitinit_blobs10k_off splitinit_blobs10k_on \
-lloyd_iters_headline lloyd_iters_blobs20k blobs10k_trace"
+spectral10k lloyd_iters_headline lloyd_iters_blobs20k blobs10k_trace"
 
 # The retry-queue steps that must be settled in RETRY_DIR before this
 # queue touches the tunnel (the two steps the retry watcher never
@@ -85,6 +89,8 @@ run_step() {
     splitinit_blobs10k_on)
       step splitinit_blobs10k_on python benchmarks/tune.py \
           --n 10000 --h 1000 --cluster-batches 8 --chunk-size 8 --split-init ;;
+    spectral10k)
+      step spectral10k python bench.py --config spectral10k --repeats 2 ;;
     lloyd_iters_headline)
       step lloyd_iters_headline python benchmarks/lloyd_iters.py \
           --config headline ;;

@@ -48,7 +48,17 @@ DEFAULT_BUCKETS = {
 
 
 def load_planes(profile_dir, plane_re):
-    """Yield (plane_name, {op_name: duration_ps}) for matching planes."""
+    """Returns ({plane_name: {op_name: duration_ps}}, meta).
+
+    Reads EVERY ``*.xplane.pb`` in the newest session directory under
+    ``profile_dir`` — the profiler writes one session dir per run, and
+    multi-host traces put one file per host in the SAME dir, so
+    "newest file only" would silently drop every other host's device
+    planes.  Same-named planes across hosts merge (durations sum).
+    ``meta`` records exactly which files were read and how many other
+    sessions' files were skipped, and is carried into the JSON output
+    so a consumer can detect partial coverage without reading stderr.
+    """
     paths = sorted(glob.glob(
         os.path.join(profile_dir, "**", "*.xplane.pb"), recursive=True))
     if not paths:
@@ -60,35 +70,51 @@ def load_planes(profile_dir, plane_re):
             f"cannot import xplane proto ({e}); this tool needs the "
             "tensorflow wheel that ships tsl/profiler/protobuf"
         )
-    space = xplane_pb2.XSpace()
-    # Newest file by mtime (the profiler writes one session dir per
-    # run; multi-host traces put one file per host in the SAME dir, so
-    # tell the user which file was read).
-    path = max(paths, key=os.path.getmtime)
-    if len(paths) > 1:
-        print(f"note: {len(paths)} xplane files under {profile_dir!r}; "
-              f"reading newest: {path!r}", file=sys.stderr)
-    with open(path, "rb") as f:
-        space.ParseFromString(f.read())
+    session_dir = os.path.dirname(max(paths, key=os.path.getmtime))
+    session_paths = [p for p in paths
+                     if os.path.dirname(p) == session_dir]
+    skipped = len(paths) - len(session_paths)
+    if skipped:
+        print(f"note: {skipped} xplane file(s) from older sessions "
+              f"under {profile_dir!r} skipped; reading "
+              f"{len(session_paths)} from {session_dir!r}",
+              file=sys.stderr)
     pat = re.compile(plane_re, re.IGNORECASE)
-    planes = [p for p in space.planes if p.lines and pat.search(p.name)]
-    if not planes:
-        # Fall back to anything with events so host-only (CPU) traces
-        # still give the calibration listing.
-        planes = [p for p in space.planes
-                  if p.lines and "TFStreamz" not in p.name]
-    if not planes:
-        raise SystemExit(
-            f"{path!r} parsed but contains no planes with events "
-            "(truncated trace?)"
-        )
-    for plane in planes:
+    spaces = []
+    for path in session_paths:
+        space = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            space.ParseFromString(f.read())
+        spaces.append(space)
+    # Select matching planes across the WHOLE session first; only when
+    # no file anywhere yields a match fall back to anything with events
+    # (host-only CPU traces).  A per-file fallback would silently merge
+    # one host's CPU planes into another host's device phase split.
+    selected = [
+        p for space in spaces for p in space.planes
+        if p.lines and pat.search(p.name)
+    ]
+    if not selected:
+        selected = [
+            p for space in spaces for p in space.planes
+            if p.lines and "TFStreamz" not in p.name
+        ]
+    merged = collections.defaultdict(collections.Counter)
+    for plane in selected:
         md = plane.event_metadata
-        agg = collections.Counter()
+        agg = merged[plane.name]
         for line in plane.lines:
             for ev in line.events:
                 agg[md[ev.metadata_id].name] += ev.duration_ps
-        yield plane.name, agg
+    if not merged:
+        raise SystemExit(
+            f"{len(session_paths)} file(s) in {session_dir!r} parsed "
+            "but contain no planes with events (truncated trace?)"
+        )
+    meta = {"session_dir": session_dir,
+            "files_read": [os.path.basename(p) for p in session_paths],
+            "older_session_files_skipped": skipped}
+    return merged, meta
 
 
 def main(argv=None):
@@ -106,8 +132,9 @@ def main(argv=None):
                else DEFAULT_BUCKETS)
     compiled = {k: re.compile(v, re.IGNORECASE) for k, v in buckets.items()}
 
-    out = {}
-    for name, agg in load_planes(args.profile_dir, args.plane):
+    planes, meta = load_planes(args.profile_dir, args.plane)
+    out = {"_meta": meta}
+    for name, agg in planes.items():
         total_ms = sum(agg.values()) / 1e9
         print(f"== plane {name!r}: {total_ms:.1f} ms total over "
               f"{len(agg)} distinct ops", file=sys.stderr)

@@ -163,7 +163,11 @@ class ConsensusClustering:
         compiled program, checkpointing after every batch (needs
         ``checkpoint_dir`` for the resume benefit).  Caps peak HBM when
         storing matrices and bounds how much work a crash can lose, at the
-        cost of one compilation per batch.  None (default) = one program.
+        cost of one compilation per batch.  This is also the device path's
+        progress knob: a compiled sweep is silent from dispatch to
+        completion, but each finished batch emits a ``k_batch_complete``
+        event to ``metrics_path``/the log — batch granularity is the
+        signs-of-life resolution.  None (default) = one program.
     compute_dtype : str, keyword-only
         Working float dtype, "float32" (default) or "float64".  f64 needs
         ``JAX_ENABLE_X64`` and a CPU backend; it is the reference-parity
@@ -411,12 +415,16 @@ class ConsensusClustering:
                     loaded[k] = entry
             missing = [k for k in config.k_values if k not in loaded]
 
+        from consensus_clustering_tpu.utils.metrics import MetricsLogger
+
+        metrics_logger = MetricsLogger(self.metrics_path)
         entries: Dict[int, dict] = {}
         timings = []
         shared_iij = None
         if missing:
             clusterer, is_host = self._resolve_clusterer()
             batch = self.k_batch_size or len(missing)
+            n_batches = -(-len(missing) // batch)
             for i0 in range(0, len(missing), batch):
                 chunk = missing[i0:i0 + batch]
                 run_config = dataclasses.replace(
@@ -453,12 +461,25 @@ class ConsensusClustering:
                         ckpt.save_k(k, chunk_entries[k])
                 entries.update(chunk_entries)
                 timings.append(out["timing"])
+                # Signs of life on the device path: the compiled sweep
+                # is silent from dispatch to completion (the reference
+                # shows per-K tqdm, :115-116), so ``k_batch_size`` is
+                # the progress knob — each completed batch emits one
+                # event to ``metrics_path`` (and the log).
+                metrics_logger.emit(
+                    "k_batch_complete",
+                    batch=i0 // batch + 1,
+                    n_batches=n_batches,
+                    k_values=[int(k) for k in chunk],
+                    run_seconds=float(out["timing"]["run_seconds"]),
+                    resamples_per_second=float(
+                        out["timing"]["resamples_per_second"]
+                    ),
+                )
 
         self._build_results(entries, config, loaded, timings)
 
-        from consensus_clustering_tpu.utils.metrics import MetricsLogger
-
-        MetricsLogger(self.metrics_path).emit(
+        metrics_logger.emit(
             "sweep_complete",
             n_samples=n,
             k_values=list(config.k_values),

@@ -81,6 +81,15 @@ def cmd_run(args):
     from consensus_clustering_tpu.api import ConsensusClustering
 
     x = _load_dataset(args.dataset, args.n_samples, args.n_features, args.seed)
+    if args.k_interleave and args.k_shards <= 1:
+        # k_interleave only reorders work BETWEEN k-groups; without a
+        # 'k'-axis mesh it is a silent no-op (SweepConfig docs) — tell
+        # the user their load-balance knob did nothing.
+        print(
+            "warning: --k-interleave has no effect without --k-shards "
+            ">= 2 (no 'k' mesh axis to spread K values over)",
+            file=sys.stderr,
+        )
     mesh = None
     if args.k_shards > 1 or args.row_shards > 1:
         from consensus_clustering_tpu.parallel.mesh import resample_mesh

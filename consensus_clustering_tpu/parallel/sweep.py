@@ -31,6 +31,7 @@ one XLA program:
 
 from __future__ import annotations
 
+import logging
 import time
 from functools import partial
 from typing import Any, Dict, Optional
@@ -62,6 +63,8 @@ from consensus_clustering_tpu.parallel.mesh import (
     ROW_AXIS,
     resample_mesh,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def pad_to_lane_groups(arr: jax.Array, batch: int) -> jax.Array:
@@ -119,6 +122,21 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     # devices); pad H to a multiple and mark padded rows with indices = -1,
     # which every one-hot builder drops.
     h_pad = -(-h_total // (n_h * n_r)) * (n_h * n_r)
+    # cluster_batch applies to each device's LOCAL resample shard
+    # (config docs): a value tuned on one layout can silently stop
+    # sub-batching when a wider mesh shrinks the shard below it — say
+    # so, because the symptom (lockstep Lloyd waste returns) looks like
+    # a perf regression, not a config one.
+    local_h_shard = h_pad // (n_h * n_r)
+    if (config.cluster_batch is not None
+            and config.cluster_batch >= local_h_shard):
+        logger.warning(
+            "cluster_batch=%d >= the per-device resample shard (%d of "
+            "H=%d over %d devices): sub-batching is a no-op on this "
+            "mesh layout, equivalent to cluster_batch=None; re-tune at "
+            "the deployment mesh (SweepConfig.cluster_batch docs)",
+            config.cluster_batch, local_h_shard, h_total, n_h * n_r,
+        )
     # Pad the K list to a multiple of the k-groups with repeats of the
     # last K (always a valid cluster count); padded slots are redundant
     # compute on the padding groups and are cropped after the shard_map.

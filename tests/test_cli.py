@@ -49,6 +49,29 @@ class TestCli:
         assert a["pac_area"] == b["pac_area"]
         assert a["best_k"] == b["best_k"]
 
+    def test_k_interleave_without_k_shards_warns(self, capsys):
+        # --k-interleave is a no-op without a 'k'-axis mesh (round-4
+        # advisor finding: the load-balance knob silently did nothing).
+        main([
+            "run", "--dataset", "corr", "--k", "2:3",
+            "--iterations", "6", "--seed", "7", "--k-interleave",
+        ])
+        captured = capsys.readouterr()
+        assert "--k-interleave has no effect" in captured.err
+        json.loads(captured.out)  # the run itself still completes
+
+    def test_k_interleave_with_k_shards_does_not_warn(self, tmp_path,
+                                                      capsys):
+        out = tmp_path / "r.json"
+        main([
+            "run", "--dataset", "blobs", "--n-samples", "64",
+            "--n-features", "4", "--k", "2:3", "--iterations", "8",
+            "--seed", "7", "--k-shards", "2", "--k-interleave",
+            "--out", str(out),
+        ])
+        json.loads(out.read_text())
+        assert "--k-interleave has no effect" not in capsys.readouterr().err
+
     def test_unknown_clusterer_exits(self):
         import pytest
 

@@ -13,10 +13,12 @@ sys.path.insert(
 import decide_maxiter  # noqa: E402
 
 
-def _art(pac, value=None):
+def _art(pac, value=None, k_values=None):
     out = {"pac_all": pac}
     if value is not None:
         out["value"] = value
+    if k_values is not None:
+        out["k_values"] = k_values
     return out
 
 
@@ -33,21 +35,73 @@ def test_identical_pac_allows_pin():
 def test_any_divergence_blocks_pin():
     a = [0.15574, 0.15624, 0.12986]
     b = [0.15574, 0.15625, 0.12986]  # one ulp-at-rounding difference
-    out, rc = decide_maxiter.decide(_art(a), _art(b))
+    out, rc = decide_maxiter.decide(
+        _art(a, k_values=[2, 3, 4]), _art(b)
+    )
     assert rc == 1
     assert out["verdict"] == "divergent"
-    assert out["first_divergent_k"] == 3  # K starts at 2
+    assert out["first_divergent_index"] == 1
+    assert out["first_divergent_k"] == 3
     assert "NOT pin" in out["decision"]
+
+
+def test_divergent_k_label_comes_from_artifact_not_an_assumed_start():
+    # A sweep starting at K=5 must be labelled with the artifact's own
+    # k_values (round-4 advisor finding: the old 2 + index hard-coded
+    # a K=2 start).
+    a = [0.5, 0.4, 0.3]
+    b = [0.5, 0.41, 0.3]
+    out, rc = decide_maxiter.decide(
+        _art(a, k_values=[5, 6, 7]), _art(b, k_values=[5, 6, 7])
+    )
+    assert rc == 1
+    assert out["first_divergent_k"] == 6
+    assert out["first_divergent_index"] == 1
+
+
+def test_divergence_without_k_values_reports_index_only():
+    a = [0.5, 0.4]
+    b = [0.5, 0.41]
+    out, rc = decide_maxiter.decide(_art(a), _art(b))
+    assert rc == 1
+    assert out["first_divergent_k"] is None
+    assert out["first_divergent_index"] == 1
+
+
+def test_mismatched_k_values_length_falls_back_to_index_only():
+    # A k_values list that doesn't cover the compared vector must not
+    # label the divergence with a wrong K.
+    a = [0.5, 0.4, 0.3]
+    b = [0.5, 0.41, 0.3]
+    out, rc = decide_maxiter.decide(
+        _art(a, k_values=[2, 3]), _art(b)
+    )
+    assert rc == 1
+    assert out["first_divergent_k"] is None
+    assert out["first_divergent_index"] == 1
 
 
 def test_first_divergent_k_is_first_not_largest():
     # The FIRST nonzero delta wins, even when a later delta is larger.
     a = [0.5, 0.40001, 0.30002]
     b = [0.5, 0.40000, 0.30000]
-    out, rc = decide_maxiter.decide(_art(a), _art(b))
+    out, rc = decide_maxiter.decide(
+        _art(a, k_values=[2, 3, 4]), _art(b)
+    )
     assert rc == 1
     assert out["first_divergent_k"] == 3
     assert out["max_pac_delta"] == pytest.approx(2e-5)
+
+
+def test_disagreeing_k_values_are_unusable():
+    # Same-length sweeps over DIFFERENT K ranges must not be compared
+    # element-wise (each slot would pair PAC values for different Ks).
+    pac = [0.5, 0.4, 0.3]
+    out, rc = decide_maxiter.decide(
+        _art(pac, k_values=[5, 6, 7]), _art(pac, k_values=[2, 3, 4])
+    )
+    assert rc == 2
+    assert "k_values disagree" in out["reason"]
 
 
 def test_unusable_artifacts():

@@ -49,6 +49,30 @@ class TestMetrics:
         assert record["resamples_per_second"] > 0
         assert set(record["pac_area"]) == {"2", "3"}
 
+    def test_k_batched_fit_emits_progress_events(self, tmp_path, blobs):
+        # The device path's signs of life (VERDICT r4 operability gap):
+        # each completed k-batch appends one event, so a multi-minute
+        # compiled sweep shows progress at k_batch_size granularity.
+        from consensus_clustering_tpu import ConsensusClustering
+
+        x, _ = blobs
+        path = tmp_path / "m.jsonl"
+        cc = ConsensusClustering(
+            K_range=(2, 3, 4), n_iterations=6, random_state=1,
+            plot_cdf=False, store_matrices=False, metrics_path=str(path),
+            k_batch_size=2, progress=False,
+        )
+        cc.fit(x)
+        events = [json.loads(line)
+                  for line in path.read_text().strip().splitlines()]
+        batches = [e for e in events if e["event"] == "k_batch_complete"]
+        assert [e["k_values"] for e in batches] == [[2, 3], [4]]
+        assert [e["batch"] for e in batches] == [1, 2]
+        assert all(e["n_batches"] == 2 for e in batches)
+        assert all(e["resamples_per_second"] > 0 for e in batches)
+        # The terminal summary event still closes the stream.
+        assert events[-1]["event"] == "sweep_complete"
+
 
 class TestDistributed:
     def test_single_process_noop(self):
