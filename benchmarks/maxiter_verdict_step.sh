@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Host-only queue step: once the maxiter probe artifacts exist, run the
+# committed decision rule (benchmarks/decide_maxiter.py) for both
+# flagship shapes and write the verdicts as one JSON artifact on
+# stdout.  No accelerator access — this step exists so the pin decision
+# materialises in the SAME tunnel window that produced its inputs,
+# instead of waiting for a human (or a later round) to run the
+# comparison by hand.
+#
+# Exit 0 when both comparisons yielded a usable verdict (identical OR
+# divergent — both are decisions); nonzero only when an input artifact
+# is missing/unusable, so the step retries until steps 1-3 land.
+#
+# Inputs (produced by the queues):
+#   blobs10k:  capped  = $RETRY_DIR/maxiter25_blobs10k.json  (round 4)
+#              default = $OUT/maxiter100_blobs10k.json
+#   headline:  capped  = $OUT/maxiter25_headline.json
+#              default = $OUT/maxiter100_headline.json
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${ONCHIP_FOLLOWUP_DIR:-benchmarks/onchip_followup_r05}
+RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
+
+emit() {  # emit <name> <capped> <default>  -> verdict JSON on stdout
+  python benchmarks/decide_maxiter.py --capped "$2" --default "$3"
+  rc=$?
+  # 0 (identical) and 1 (divergent) are both decisions; 2 is unusable.
+  [ $rc -le 1 ] && return 0
+  return 1
+}
+
+for f in "$RETRY_DIR/maxiter25_blobs10k.json" "$OUT/maxiter100_blobs10k.json" \
+         "$OUT/maxiter25_headline.json" "$OUT/maxiter100_headline.json"; do
+  if [ ! -f "$f" ]; then
+    echo "maxiter_verdict_step: missing input $f" >&2
+    exit 1
+  fi
+done
+
+{
+  printf '{"blobs10k": '
+  emit blobs10k "$RETRY_DIR/maxiter25_blobs10k.json" \
+      "$OUT/maxiter100_blobs10k.json" || exit 1
+  printf ', "headline": '
+  emit headline "$OUT/maxiter25_headline.json" \
+      "$OUT/maxiter100_headline.json" || exit 1
+  printf '}\n'
+}

@@ -50,6 +50,7 @@ RETRY_DIR=${ONCHIP_RETRY_DIR:-benchmarks/onchip_retry_r04}
 . benchmarks/_onchip_step.sh
 
 STEP_NAMES="maxiter100_blobs10k maxiter25_headline maxiter100_headline \
+maxiter_verdicts \
 splitinit_headline_off splitinit_headline_on \
 splitinit_blobs10k_off splitinit_blobs10k_on \
 spectral10k lloyd_iters_headline lloyd_iters_blobs20k blobs10k_trace"
@@ -77,6 +78,10 @@ run_step() {
     maxiter100_headline)
       step maxiter100_headline python benchmarks/maxiter_probe.py \
           --config headline --max-iter 100 ;;
+    maxiter_verdicts)
+      # Host-only: materialise the pin decision in the same window that
+      # produced its probe inputs (steps 1-3).  Retries until they land.
+      step maxiter_verdicts bash benchmarks/maxiter_verdict_step.sh ;;
     splitinit_headline_off)
       step splitinit_headline_off python benchmarks/tune.py \
           --n 5000 --h 500 --cluster-batches 16 --chunk-size 4 ;;
