@@ -148,6 +148,15 @@ class ConsensusClustering:
         independent init stream (see SweepConfig docs).
     progress : bool, keyword-only
         Per-K host progress bars for the host backend.
+    progress_callback : callable, keyword-only, optional
+        Device-path per-K progress: called as ``cb(k: int, pac: float)``
+        exactly once per K, from inside the single compiled sweep, as
+        that K's scan step completes (the reference's per-K tqdm signal,
+        consensus_clustering_parallelised.py:115-116, without splitting
+        the program).  Opt-in: each firing is a device->host round trip,
+        so benchmark paths leave it None.  Composes with
+        ``k_batch_size`` (which reports at batch granularity via
+        ``metrics_path`` instead).
     profile_dir : str, keyword-only, optional
         Capture a ``jax.profiler`` trace of the compiled sweep's execution
         into this directory (view with TensorBoard/xprof).
@@ -217,6 +226,7 @@ class ConsensusClustering:
         reseed_clusterer_per_resample: bool = False,
         checkpoint_dir: Optional[str] = None,
         progress: bool = True,
+        progress_callback=None,
         profile_dir: Optional[str] = None,
         use_pallas: Optional[bool] = None,
         metrics_path: Optional[str] = None,
@@ -280,6 +290,7 @@ class ConsensusClustering:
         self.reseed_clusterer_per_resample = reseed_clusterer_per_resample
         self.checkpoint_dir = checkpoint_dir
         self.progress = progress
+        self.progress_callback = progress_callback
         self.profile_dir = profile_dir
         self.use_pallas = use_pallas
         self.metrics_path = metrics_path
@@ -423,6 +434,13 @@ class ConsensusClustering:
         shared_iij = None
         if missing:
             clusterer, is_host = self._resolve_clusterer()
+            if is_host and self.progress_callback is not None:
+                logger.warning(
+                    "progress_callback is a device-path feature and this "
+                    "clusterer runs on the host backend: the callback "
+                    "will not fire (use progress=True for host-side "
+                    "per-K progress bars)"
+                )
             batch = self.k_batch_size or len(missing)
             n_batches = -(-len(missing) // batch)
             for i0 in range(0, len(missing), batch):
@@ -447,6 +465,7 @@ class ConsensusClustering:
                     out = run_sweep(
                         clusterer, run_config, X, self.random_state,
                         mesh=self.mesh, profile_dir=self.profile_dir,
+                        progress_callback=self.progress_callback,
                     )
                 chunk_entries = self._entries_from_out(
                     out, chunk, config, shared_iij

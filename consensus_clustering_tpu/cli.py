@@ -107,6 +107,20 @@ def cmd_run(args):
     # not computed per swept K.
     store_matrices = {"on": True, "off": False}[args.store_matrices] \
         if args.store_matrices != "auto" else bool(args.plot_dir)
+    progress_cb = None
+    if args.progress:
+        # With a checkpoint dir the fit may resume and sweep only the
+        # non-checkpointed Ks, so a denominator from the full --k list
+        # would never be reached; count without a total in that case.
+        total = ("" if args.checkpoint_dir
+                 else f"/{len(_parse_k(args.k))}")
+        done_count = [0]
+
+        def progress_cb(k, pac):
+            done_count[0] += 1
+            print(f"K={k} done ({done_count[0]}{total}), pac={pac:.5f}",
+                  file=sys.stderr, flush=True)
+
     cc = ConsensusClustering(
         clusterer=_make_clusterer(args.clusterer),
         clusterer_options={} if args.clusterer != "kmeans" else {"n_init": 3},
@@ -127,6 +141,7 @@ def cmd_run(args):
         metrics_path=args.metrics_path,
         k_batch_size=args.k_batch_size,
         compute_dtype=args.compute_dtype,
+        progress_callback=progress_cb,
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -268,6 +283,11 @@ def main(argv=None):
                      help="consensus-histogram kernel selection")
     run.add_argument("--metrics-path", default=None,
                      help="append JSON-lines run metrics to this file")
+    run.add_argument("--progress", action="store_true",
+                     help="print a line per completed K during the "
+                     "compiled device sweep (per-K host callback; off "
+                     "by default because each firing is a device->host "
+                     "round trip)")
     run.add_argument("--compute-dtype", choices=["float32", "float64"],
                      default="float32",
                      help="float64 needs JAX_ENABLE_X64 + CPU backend; "

@@ -86,7 +86,12 @@ def pad_to_lane_groups(arr: jax.Array, batch: int) -> jax.Array:
     )
 
 
-def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mesh] = None):
+def build_sweep(
+    clusterer: JaxClusterer,
+    config: SweepConfig,
+    mesh: Optional[Mesh] = None,
+    progress_callback=None,
+):
     """Return a jitted ``sweep(x, key) -> dict`` over the given mesh.
 
     The returned callable computes, for every K in ``config.k_values``:
@@ -96,6 +101,18 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
     N=20000 the ``iij`` device->host copy alone is 1.6 GB, which through a
     tunnelled PJRT backend costs ~60 s — an order of magnitude more than
     the whole curves-only sweep it would ride along with.
+
+    ``progress_callback``, if given, is invoked as ``cb(k, pac)`` from a
+    ``jax.debug.callback`` staged at the end of each K's scan step — the
+    reference's per-K tqdm signal (consensus_clustering_parallelised.py
+    :115-116) recovered INSIDE the single compiled program.  The
+    callback's arguments are the completed K and its PAC area, so the
+    data dependence pins it after that K's work.  It fires once per
+    participating device per K (shard_map replicates effects) and again
+    on any re-execution of the compiled sweep: callers wanting
+    once-per-K semantics dedupe on k, as :func:`run_sweep` does.
+    Opt-in because every firing is a device->host round trip — through
+    a tunnelled backend that is latency a benchmark must not pay.
     """
     if mesh is None:
         mesh = resample_mesh([jax.devices()[0]])
@@ -309,6 +326,11 @@ def build_sweep(clusterer: JaxClusterer, config: SweepConfig, mesh: Optional[Mes
             hist, cdf, pac = cdf_pac_from_counts(
                 counts, n, lo, hi, config.parity_zeros
             )
+            if progress_callback is not None:
+                # Passing pac (not just k) makes the callback
+                # data-dependent on this K's finished analysis, so XLA
+                # cannot hoist it ahead of the work it reports on.
+                jax.debug.callback(progress_callback, k, pac)
             out = {"hist": hist, "cdf": cdf, "pac_area": pac}
             if config.store_matrices:
                 out["mij"] = mij
@@ -384,6 +406,7 @@ def run_sweep(
     mesh: Optional[Mesh] = None,
     profile_dir: Optional[str] = None,
     repeats: int = 1,
+    progress_callback=None,
 ) -> Dict[str, Any]:
     """Build, compile and execute a sweep; return host-side results + timings.
 
@@ -400,8 +423,34 @@ def run_sweep(
     run-to-run noise on identical programs; best-of filters interference
     from outside the program under test, which is what a throughput claim
     is about.  The profiler, if requested, traces only the first execution.
+
+    ``progress_callback``, if given, is called as ``cb(k: int, pac:
+    float)`` exactly once per K as that K's scan step completes inside
+    the compiled program (see :func:`build_sweep`; per-device and
+    per-repeat duplicates are deduped here).  Opt-in — each firing is a
+    host round trip the benchmark paths must not pay.
     """
-    sweep = build_sweep(clusterer, config, mesh)
+    if progress_callback is not None:
+        import threading
+
+        # The runtime may deliver each device's host callback on its
+        # own thread; the check-then-add must be atomic or two devices
+        # racing on the same K both pass the membership test and the
+        # user callback fires twice.
+        seen = set()
+        seen_lock = threading.Lock()
+        user_cb = progress_callback
+
+        def progress_callback(k, pac):
+            kk = int(k)
+            with seen_lock:
+                if kk in seen:
+                    return
+                seen.add(kk)
+            user_cb(kk, float(pac))
+
+    sweep = build_sweep(clusterer, config, mesh,
+                        progress_callback=progress_callback)
     key = jax.random.PRNGKey(seed)
     xj = jnp.asarray(x, jnp.dtype(config.dtype))
 
@@ -426,6 +475,10 @@ def run_sweep(
             if host is None:
                 host = result
         run_times.append(time.perf_counter() - r0)
+    if progress_callback is not None:
+        # Debug-callback effects are asynchronous; drain them so every
+        # per-K event has fired before the results are handed back.
+        jax.effects_barrier()
     best = min(run_times)
     total_resamples = config.n_iterations * len(config.k_values)
     from consensus_clustering_tpu.utils.metrics import device_memory_stats

@@ -160,6 +160,29 @@ class TestSklearnPluginPath:
         with pytest.raises(AttributeError, match="n_clusters nor n_components"):
             SklearnClusterer(_FitPredictOnly())
 
+    def test_progress_callback_warns_on_host_backend(self, blobs, caplog):
+        # progress_callback is a device-path feature; an sklearn
+        # clusterer routes to the host backend where it never fires —
+        # the silent no-op must be announced (medium review finding).
+        import logging
+
+        from sklearn.cluster import KMeans as SkKMeans
+
+        x, _ = blobs
+        events = []
+        cc = ConsensusClustering(
+            clusterer=SkKMeans(), clusterer_options={"n_init": 1},
+            K_range=(2,), random_state=5, n_iterations=4, plot_cdf=False,
+            progress=False, progress_callback=lambda k, pac: events.append(k),
+        )
+        with caplog.at_level(logging.WARNING,
+                             logger="consensus_clustering_tpu.api"):
+            cc.fit(x)
+        assert events == []
+        assert any("progress_callback" in r.getMessage()
+                   and "host backend" in r.getMessage()
+                   for r in caplog.records)
+
     def test_same_resample_plan_as_jax_backend(self, blobs):
         # Host and compiled backends must draw identical subsamples: Iij is
         # a pure function of the seed, whichever backend runs (SURVEY Q8).

@@ -49,6 +49,38 @@ class TestCli:
         assert a["pac_area"] == b["pac_area"]
         assert a["best_k"] == b["best_k"]
 
+    def test_progress_prints_per_k_lines(self, capsys):
+        main([
+            "run", "--dataset", "corr", "--k", "2:4",
+            "--iterations", "6", "--seed", "7", "--progress",
+        ])
+        captured = capsys.readouterr()
+        json.loads(captured.out)
+        for k in (2, 3, 4):
+            assert f"K={k} done" in captured.err
+        assert "(3/3)" in captured.err
+
+    def test_progress_with_checkpoint_resume_counts_without_total(
+            self, tmp_path, capsys):
+        # A resumed fit sweeps only the non-checkpointed Ks, so the
+        # full --k list is the wrong denominator; with --checkpoint-dir
+        # the counter prints without a total (medium review finding).
+        common = [
+            "run", "--dataset", "corr", "--k", "2:4",
+            "--iterations", "6", "--seed", "7", "--progress",
+            "--checkpoint-dir", str(tmp_path / "ckpt"),
+        ]
+        main(common)
+        first = capsys.readouterr()
+        assert "K=2 done (1)," in first.err
+        assert "/3" not in first.err
+        # Resume: every K checkpointed, nothing recomputed, no
+        # misleading partial count.
+        main(common)
+        second = capsys.readouterr()
+        json.loads(second.out)
+        assert "K=" not in second.err or "done" not in second.err
+
     def test_k_interleave_without_k_shards_warns(self, capsys):
         # --k-interleave is a no-op without a 'k'-axis mesh (round-4
         # advisor finding: the load-balance knob silently did nothing).

@@ -130,6 +130,23 @@ class TestSweepSingleDevice:
         np.testing.assert_array_equal(ref["mij"], out["mij"])
         np.testing.assert_array_equal(ref["pac_area"], out["pac_area"])
 
+    def test_progress_callback_fires_once_per_k(self, blobs):
+        # The device path's per-K signal (reference tqdm analog): the
+        # callback fires exactly once per K from inside the compiled
+        # program, with that K's finished PAC.
+        x, _ = blobs
+        config = _sweep_config(x, store_matrices=False)
+        events = []
+        out = run_sweep(
+            KMeans(n_init=2), config, x, seed=0,
+            progress_callback=lambda k, pac: events.append((k, pac)),
+        )
+        assert sorted(k for k, _ in events) == [2, 3, 4]
+        by_k = dict(events)
+        for i, k in enumerate(config.k_values):
+            assert by_k[k] == pytest.approx(float(out["pac_area"][i]),
+                                            abs=1e-7)
+
     def test_deterministic(self, blobs):
         x, _ = blobs
         config = _sweep_config(x)
@@ -349,6 +366,23 @@ class TestKShardedSweep:
             np.testing.assert_array_equal(
                 contiguous[name], inter[name], err_msg=name
             )
+
+    def test_progress_callback_deduped_on_sharded_interleaved_mesh(
+            self, blobs):
+        # shard_map replicates the debug callback per device and padded
+        # K slots repeat the last K; run_sweep's dedupe must still
+        # deliver exactly one event per ORIGINAL K, k_interleave or not.
+        x, _ = blobs
+        config = _sweep_config(
+            x, n_iterations=8, k_interleave=True, store_matrices=False,
+        )
+        mesh = resample_mesh(jax.devices()[:8], row_shards=2, k_shards=2)
+        events = []
+        run_sweep(
+            KMeans(n_init=2), config, x, seed=7, mesh=mesh,
+            progress_callback=lambda k, pac: events.append(k),
+        )
+        assert sorted(events) == [2, 3, 4]
 
     def test_k_interleave_noop_without_k_axis(self, blobs):
         # No 'k' axis: the knob must change nothing (not even compile a
