@@ -147,9 +147,11 @@ class TestFullShapesTable:
         # The script re-imports bench from the repo root, so its table
         # must be (at minimum) equal to the one under test here.
         assert mb.FULL_SHAPES == bench.FULL_SHAPES
-        # blobs10k/blobs20k joined in round 4: the large-N baselines are
-        # now measured (small --h-measured, linear-in-H extrapolation).
-        for config in ("corr", "gmm", "spectral", "blobs10k", "blobs20k"):
+        # blobs10k/blobs20k joined in round 4, spectral10k in round 5:
+        # the large-N baselines are measured (small --h-measured,
+        # linear-in-H extrapolation).
+        for config in ("corr", "gmm", "spectral", "spectral10k",
+                       "blobs10k", "blobs20k"):
             fs = bench.FULL_SHAPES[config]
             clusterer, options, x, k_values, h_full = mb.build(config)
             assert h_full == fs["h"], config
