@@ -15,6 +15,19 @@ Prints exactly one JSON line:
   {"metric": ..., "value": <resamples/sec>, "unit": "resamples/sec",
    "vs_baseline": <speedup>, ...}
 
+When the requested accelerator is unreachable and the run falls back to
+CPU, the payload is relabelled so it cannot be misread as an accelerator
+rate (see :func:`_mark_cpu_fallback`):
+  {"metric": ..., "value": null, "cpu_fallback_value": <resamples/sec>,
+   "measurement_backend": "cpu-fallback",
+   "last_onchip": {...newest preserved accelerator record, with its own
+                   "provenance" string...}, ...}
+``value`` — the field every naive parser reads — is null; the CPU number
+lives only under ``cpu_fallback_value``; ``measurement_backend`` says
+explicitly what was measured ("cpu-fallback" vs the normal on-chip
+label); and ``last_onchip`` is present only when a prior accelerator
+record for the SAME config exists to preserve.
+
 The other configs run via --config (corr / blobs10k / blobs20k /
 agglo / spectral / gmm — the last is the reference's second demo
 family); shapes scaled down to one chip are marked in the metric string.

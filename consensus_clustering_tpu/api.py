@@ -526,40 +526,32 @@ class ConsensusClustering:
         largest K whose relative area gain Delta(K) still exceeds
         ``delta_k_threshold``).
         """
+        # Shared with the serving executor (ops.analysis.select_best_k) so
+        # both surfaces agree on what "best" means.  A gain that resurges
+        # after a flat (sub-threshold) stretch is honoured deliberately
+        # under 'delta_k': on noisy curves the flat region can be a local
+        # artefact, and "largest K with real gain" is the documented
+        # contract — a first-flattening rule would need a different
+        # docstring and different tests.  The mode check inside is the
+        # backstop for post-construction attribute mutation, which
+        # sklearn-style APIs permit (the constructor already validates).
+        from consensus_clustering_tpu.ops.analysis import select_best_k
+
         mode = self.consensus_matrix_analysis
         ks = list(config.k_values)
-        if mode == "delta_k":
-            # Monti's elbow, exactly as documented: the largest K whose
-            # relative area gain Delta(K) still exceeds delta_k_threshold.
-            # Gains are floored at 0 (noise can dip the CDF area); no
-            # meaningful gain anywhere selects the smallest K.  A gain that
-            # resurges after a flat (sub-threshold) stretch is honoured
-            # deliberately: on noisy curves the flat region can be a local
-            # artefact, and "largest K with real gain" is the documented
-            # contract — a first-flattening rule would need a different
-            # docstring and different tests.
-            gains = np.maximum(np.asarray(self.delta_k_, float), 0.0)
-            chosen = ks[0]
-            for i in range(1, len(ks)):
-                if gains[i] > self.delta_k_threshold:
-                    chosen = ks[i]
-            return int(chosen)
-        if mode != "PAC":
-            # Unreachable through the constructor (which validates the
-            # value); kept as a deliberate backstop for post-construction
-            # attribute mutation, which sklearn-style APIs permit.
-            raise ValueError(
-                f"consensus_matrix_analysis={mode!r} not supported "
-                "(choose 'PAC' or 'delta_k')"
-            )
-        pac = np.asarray(
+        # PAC areas only when the mode reads them: under 'delta_k' the
+        # gains alone decide, and cdf_at_K_data need not even be set.
+        pac_areas = (
             [self.cdf_at_K_data[k]["pac_area"] for k in ks]
+            if mode == "PAC" else None
         )
-        # argmin PAC, breaking near-ties (several Ks perfectly stable, e.g.
-        # clean blobs where both K=2 and K=3 give PAC ~ 0) toward the
-        # largest such K: the finest partition that is still stable.
-        near_min = pac <= pac.min() + 1e-3
-        return int(max(k for k, hit in zip(ks, near_min) if hit))
+        return select_best_k(
+            mode,
+            ks,
+            pac_areas,
+            delta_k_gains=self.delta_k_,
+            delta_k_threshold=self.delta_k_threshold,
+        )
 
     def fit_predict(self, X) -> np.ndarray:
         """Fit the sweep and return consensus labels at ``best_k_``.

@@ -6,6 +6,7 @@ one for benchmarking and batch use:
     python -m consensus_clustering_tpu run --dataset corr --k 2:15 \
         --iterations 100 --seed 23 --out results.json
     python -m consensus_clustering_tpu bench
+    python -m consensus_clustering_tpu serve --port 8000   # docs/SERVING.md
 
 Results are written as JSON (PAC / CDF curves and stability statistics);
 matrices stay out of the JSON by design.
@@ -112,8 +113,10 @@ def cmd_run(args):
         # With a checkpoint dir the fit may resume and sweep only the
         # non-checkpointed Ks, so a denominator from the full --k list
         # would never be reached; count without a total in that case.
+        # Deduplicate: the callback fires once per distinct K, so a
+        # repeated --k entry (e.g. 2,2,3) must not inflate the total.
         total = ("" if args.checkpoint_dir
-                 else f"/{len(_parse_k(args.k))}")
+                 else f"/{len(set(_parse_k(args.k)))}")
         done_count = [0]
 
         def progress_cb(k, pac):
@@ -224,6 +227,61 @@ def _write_figures(cc, plot_dir: str) -> None:
         )
 
 
+def cmd_serve(args):
+    import logging
+    import os
+
+    from consensus_clustering_tpu.serve import (
+        ConsensusService,
+        JobSpec,
+        SweepExecutor,
+    )
+
+    logging.basicConfig(level=logging.INFO)
+    executor = SweepExecutor()
+    service = ConsensusService(
+        store_dir=args.store_dir,
+        host=args.host,
+        port=args.port,
+        max_queue=args.queue_size,
+        job_timeout=args.job_timeout or None,
+        max_retries=args.max_retries,
+        events_path=args.events_path,
+        executor=executor,
+    )
+    for spec_str in args.warmup or ():
+        # n,d,kspec,h — pre-compile the executable for this shape bucket
+        # so the first real request at it skips straight to execution.
+        try:
+            n_s, d_s, k_s, h_s = spec_str.split(",", 3)
+            spec = JobSpec(
+                k_values=_parse_k(k_s.replace(";", ",")),
+                n_iterations=int(h_s),
+            )
+            n, d = int(n_s), int(d_s)
+        except ValueError:
+            raise SystemExit(
+                f"--warmup {spec_str!r}: expected n,d,klo:khi,h "
+                "(e.g. 500,16,2:6,50)"
+            )
+        secs = executor.warmup(spec, n, d)
+        print(
+            f"warmed bucket n={n} d={d} k={spec.k_values} "
+            f"h={spec.n_iterations} in {secs:.1f}s",
+            file=sys.stderr,
+        )
+    print(
+        f"consensus service on http://{args.host}:{service.port} "
+        f"(store: {os.path.abspath(args.store_dir)}, "
+        f"queue: {args.queue_size}, backend: {executor.backend()})",
+        file=sys.stderr,
+    )
+    try:
+        service.serve_forever()
+    except KeyboardInterrupt:
+        service.stop()
+
+
 def cmd_bench(args):
     del args
     import bench  # repo-root benchmark; one-JSON-line contract
@@ -308,6 +366,32 @@ def main(argv=None):
 
     bench_p = sub.add_parser("bench", help="run the benchmark harness")
     bench_p.set_defaults(fn=cmd_bench)
+
+    serve_p = sub.add_parser(
+        "serve",
+        help="run the consensus-clustering HTTP service (docs/SERVING.md)",
+    )
+    serve_p.add_argument("--host", default="127.0.0.1")
+    serve_p.add_argument("--port", type=int, default=8000,
+                         help="0 binds an ephemeral port")
+    serve_p.add_argument("--store-dir", default="serve_store",
+                         help="jobstore directory (results survive "
+                         "restarts; identical submissions dedup)")
+    serve_p.add_argument("--queue-size", type=int, default=16,
+                         help="admission bound; a full queue returns 429")
+    serve_p.add_argument("--job-timeout", type=float, default=0,
+                         help="per-job wall-clock budget in seconds "
+                         "(0 = unlimited)")
+    serve_p.add_argument("--max-retries", type=int, default=2,
+                         help="retries on transient failures "
+                         "(exponential backoff)")
+    serve_p.add_argument("--events-path", default=None,
+                         help="append JSONL lifecycle events here")
+    serve_p.add_argument("--warmup", action="append", default=None,
+                         metavar="N,D,KSPEC,H",
+                         help="pre-compile a shape bucket at startup, "
+                         "e.g. 500,16,2:6,50 (repeatable)")
+    serve_p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     # After parsing: --help / argument errors must not pay the jax

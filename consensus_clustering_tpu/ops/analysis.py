@@ -228,3 +228,47 @@ def delta_k(areas: np.ndarray) -> np.ndarray:
     prev = np.maximum(areas[:-1], 1e-12)
     out[1:] = (areas[1:] - areas[:-1]) / prev
     return out
+
+
+def select_best_k(
+    mode: str,
+    k_values,
+    pac_areas,
+    delta_k_gains=None,
+    delta_k_threshold: float = 0.05,
+) -> int:
+    """Pick the best K per ``consensus_matrix_analysis`` mode.
+
+    Shared by the fit API (``ConsensusClustering._select_best_k``) and the
+    serving executor, so both surfaces agree on what "best" means:
+
+    - ``'PAC'``: argmin PAC, breaking near-ties (several Ks perfectly
+      stable, e.g. clean blobs where both K=2 and K=3 give PAC ~ 0)
+      toward the largest such K — the finest partition still stable.
+    - ``'delta_k'``: Monti's elbow — the largest K whose relative CDF-area
+      gain Delta(K) still exceeds ``delta_k_threshold``.  Gains are
+      floored at 0 (noise can dip the CDF area); no meaningful gain
+      anywhere selects the smallest K.  A gain that resurges after a flat
+      stretch is honoured deliberately (see the API docstring).
+
+    ``k_values``/``pac_areas``/``delta_k_gains`` are parallel sequences in
+    constructor order (which a comma --k list may leave unsorted).
+    """
+    ks = list(k_values)
+    if mode == "delta_k":
+        if delta_k_gains is None:
+            raise ValueError("mode='delta_k' needs delta_k_gains")
+        gains = np.maximum(np.asarray(delta_k_gains, np.float64), 0.0)
+        chosen = ks[0]
+        for i in range(1, len(ks)):
+            if gains[i] > delta_k_threshold:
+                chosen = ks[i]
+        return int(chosen)
+    if mode != "PAC":
+        raise ValueError(
+            f"consensus_matrix_analysis={mode!r} not supported "
+            "(choose 'PAC' or 'delta_k')"
+        )
+    pac = np.asarray(pac_areas, np.float64)
+    near_min = pac <= pac.min() + 1e-3
+    return int(max(k for k, hit in zip(ks, near_min) if hit))

@@ -40,7 +40,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # JAX >= 0.5 promotes shard_map to the top-level namespace
+    from jax import shard_map
+except ImportError:  # JAX 0.4.x: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_04x
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_04x(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
 
 from consensus_clustering_tpu.config import SweepConfig
 from consensus_clustering_tpu.models.protocol import JaxClusterer
@@ -189,19 +199,29 @@ def build_sweep(
     # per-grid-step overhead outweighs the HBM-traffic savings — the XLA
     # Lloyd body is already near the HBM roofline (benchmarks/PERF.md).
 
-    def local_body(x, indices, key_cluster, k_arr_local):
+    def local_body(x, key_resample, key_cluster, k_arr_local):
         """Runs per device.
 
-        ``indices`` is this chip's (h_pad / (n_h * n_r), n_sub) resample
-        shard: clustering is data-parallel over every device.  For the
-        accumulation GEMMs the same chips are re-viewed as an (n_h, n_r)
-        grid: labels/indices are all_gather'd along the 'n' axis (cheap —
-        int32 label rows, not matrices) so each 'h' row holds its full
-        resample shard, each device computes its own (n_local, n_pad) row
-        block of Mij/Iij, and the blocks psum over 'h' only.  The CDF
-        histogram is computed per block and psum'd over 'n'.
+        The (h_pad, n_sub) resample plan is drawn HERE, replicated on
+        every device (same key, same deterministic draws), and each chip
+        slices its own (h_pad / (n_h * n_r), n_sub) shard: clustering is
+        data-parallel over every device.  Drawing in-body rather than
+        sharding a jit-computed plan through ``in_specs`` sidesteps a
+        JAX 0.4.x partitioner miscompile: RNG output computed inside the
+        surrounding jit and resharded into a shard_map over a mesh with
+        an axis the spec doesn't mention arrives with corrupted values
+        (observed: every index exactly doubled on a ('k','h','n') mesh
+        with k>1 and h>1; tests/test_distributed.py guards the parity).
+        The plan is tiny (H x n_sub int32) next to the clustering work.
+        For the accumulation GEMMs the chips are re-viewed as an
+        (n_h, n_r) grid: labels are all_gather'd along the 'n' axis
+        (cheap — int32 label rows, not matrices) so each 'h' row holds
+        its full resample shard, each device computes its own
+        (n_local, n_pad) row block of Mij/Iij, and the blocks psum over
+        'h' only.  The CDF histogram is computed per block and psum'd
+        over 'n'.
         """
-        local_h = indices.shape[0]
+        local_h = h_pad // (n_h * n_r)
         h_idx = jax.lax.axis_index(RESAMPLE_AXIS)
         r_idx = jax.lax.axis_index(ROW_AXIS)
         h_global = (h_idx * n_r + r_idx) * local_h + jnp.arange(
@@ -210,10 +230,33 @@ def build_sweep(
         h_valid = h_global < h_total
         row_start = r_idx * n_local
 
-        # This 'h' row's full resample shard, in global order (tiled gather
-        # along 'n' concatenates the r_idx blocks in index order).
-        indices_row = jax.lax.all_gather(
-            indices, ROW_AXIS, tiled=True, axis=0
+        indices_full = resample_indices(key_resample, n, h_total, n_sub)
+        if h_pad > h_total:
+            indices_full = jnp.concatenate(
+                [
+                    indices_full,
+                    jnp.full((h_pad - h_total, n_sub), -1, jnp.int32),
+                ]
+            )
+        # This chip's resample shard: global rows are blocked h-major
+        # then n (the layout h_global encodes).
+        indices = jax.lax.dynamic_slice(
+            indices_full,
+            (
+                jnp.asarray((h_idx * n_r + r_idx) * local_h, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            ),
+            (local_h, n_sub),
+        )
+        # This 'h' row's full resample shard in global order — the n_r
+        # consecutive blocks starting at the row's first chip.
+        indices_row = jax.lax.dynamic_slice(
+            indices_full,
+            (
+                jnp.asarray(h_idx * n_r * local_h, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            ),
+            (n_r * local_h, n_sub),
         )
         iij = jax.lax.psum(
             cosample_counts(
@@ -357,7 +400,7 @@ def build_sweep(
         mesh=mesh,
         in_specs=(
             P(),
-            P((RESAMPLE_AXIS, ROW_AXIS)),
+            P(),
             P(),
             P(k_axis),
         ),
@@ -369,15 +412,10 @@ def build_sweep(
     def sweep(x: jax.Array, key: jax.Array) -> Dict[str, jax.Array]:
         x = x.astype(jnp.dtype(config.dtype))
         key_resample, key_cluster = jax.random.split(key)
-        indices = resample_indices(key_resample, n, h_total, n_sub)
-        if h_pad > h_total:
-            indices = jnp.concatenate(
-                [
-                    indices,
-                    jnp.full((h_pad - h_total, n_sub), -1, jnp.int32),
-                ]
-            )
-        per_k_out, iij = sharded_body(x, indices, key_cluster, k_arr)
+        # The resample plan is drawn inside local_body (replicated per
+        # device) — see its docstring for the partitioner miscompile this
+        # avoids; only the key crosses the shard_map boundary.
+        per_k_out, iij = sharded_body(x, key_resample, key_cluster, k_arr)
         # Restore k_values order if the groups ran interleaved (a
         # cross-'k'-shard gather — tiny for the (bins,) curves; (N, N)
         # blocks only move when store_matrices is on, see config), then
@@ -389,6 +427,17 @@ def build_sweep(
                 for k, v in per_k_out.items()
             }
         per_k_out = {k: v[:n_ks] for k, v in per_k_out.items()}
+        # PAC re-derived OUTSIDE the shard_map from the assembled CDF: a
+        # single exactly-rounded f32 subtract of values that are already
+        # bitwise mesh-invariant.  The in-body pac (cdf[hi-1] - cdf[lo]
+        # inside per_k) feeds only the progress callback; as an *output*
+        # XLA fuses it differently per mesh layout (observed: a 1-ulp
+        # pac_area split between 8-device and 1-device programs with
+        # identical cdf), which would break the bit-exact device-count
+        # invariance the dryrun asserts.
+        per_k_out["pac_area"] = (
+            per_k_out["cdf"][:, hi - 1] - per_k_out["cdf"][:, lo]
+        )
         if config.store_matrices:
             per_k_out["iij"] = iij[:n, :n]
             per_k_out["mij"] = per_k_out["mij"][:, :n, :n]

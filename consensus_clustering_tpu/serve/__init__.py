@@ -1,0 +1,43 @@
+"""Consensus-as-a-service: job scheduler, executable cache, result store.
+
+The serving subsystem over the batch API — see docs/SERVING.md:
+
+- :mod:`.jobstore`  — persistent dedup-by-fingerprint result store
+- :mod:`.executor`  — compile-cache-aware sweep executor (warm path)
+- :mod:`.scheduler` — bounded FIFO queue, timeout, retry/backoff
+- :mod:`.service`   — stdlib HTTP JSON API (POST /jobs, GET /jobs/<id>,
+  /healthz, /metrics)
+- :mod:`.events`    — structured JSONL lifecycle events
+
+Everything here is stdlib + the existing package; importing
+``consensus_clustering_tpu.serve`` does not initialise JAX (that happens
+on the first executed job / warmup).
+"""
+
+from consensus_clustering_tpu.serve.events import EventLog
+from consensus_clustering_tpu.serve.executor import (
+    JobSpec,
+    JobSpecError,
+    SweepExecutor,
+    parse_job_spec,
+)
+from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.scheduler import (
+    JobTimeout,
+    QueueFull,
+    Scheduler,
+)
+from consensus_clustering_tpu.serve.service import ConsensusService
+
+__all__ = [
+    "ConsensusService",
+    "EventLog",
+    "JobSpec",
+    "JobSpecError",
+    "JobStore",
+    "JobTimeout",
+    "QueueFull",
+    "Scheduler",
+    "SweepExecutor",
+    "parse_job_spec",
+]

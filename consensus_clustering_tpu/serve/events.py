@@ -1,0 +1,58 @@
+"""Structured JSONL event log for the serving subsystem.
+
+One line per lifecycle event, append-only, thread-safe (the HTTP handler
+threads emit ``job_submitted`` while the scheduler worker emits
+``job_started``/``job_done``, and the per-K ``k_batch_complete`` events
+arrive on JAX debug-callback threads).  The schema mirrors
+:class:`~consensus_clustering_tpu.utils.metrics.MetricsLogger` —
+``{"ts": <unix>, "event": <name>, ...fields}`` — so one JSONL consumer
+can tail both a batch run's metrics file and the service's event log.
+
+Events emitted by the service:
+
+- ``job_submitted``   — admission accepted (fields: job_id, fingerprint,
+  shape, cached)
+- ``job_started``     — worker picked the job up (job_id, attempt)
+- ``k_batch_complete``— a K finished inside the compiled sweep (job_id,
+  k, pac); fed by the ``progress_callback`` plumbing ``api.py`` already
+  exposes, forwarded through the executor's per-job dispatcher
+- ``job_done``        — result stored (job_id, fingerprint, seconds)
+- ``job_retry``       — transient failure, will re-run (job_id, attempt,
+  backoff_seconds, error)
+- ``job_failed``      — permanent failure / retries exhausted / timeout
+  (job_id, error, kind)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class EventLog:
+    """Append structured events to a JSONL file and/or the log.
+
+    ``path=None`` logs via :mod:`logging` only — the service always has an
+    event stream, a file just makes it durable.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
+        record = {"ts": round(time.time(), 3), "event": event, **fields}
+        line = json.dumps(record, default=float, sort_keys=True)
+        if self.path:
+            # One lock around the whole append: interleaved writes from
+            # handler threads must not tear a line.
+            with self._lock:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+        logger.info("serve event: %s", line)
+        return record

@@ -1,0 +1,450 @@
+"""Compile-cache-aware sweep executor: the warm-executable path.
+
+A batch CLI run pays the full JAX trace + XLA-compile cost on every
+process start.  A long-lived service should pay it once per *shape
+bucket* — the tuple of everything that determines the compiled program:
+(N, d, K_range, H) plus the semantics-bearing sweep statics (bins,
+subsampling, dtype, clusterer, ...) but NOT the seed or the data values,
+which are runtime inputs.  This executor keeps two cache layers:
+
+- **in-process executable cache** — ``build_sweep(...).lower(...).
+  compile()`` keyed by shape bucket, so the second job at a given bucket
+  skips tracing *and* compilation entirely and goes straight to
+  execution;
+- **persistent XLA compilation cache** — ``utils.platform.
+  enable_compilation_cache()`` — so even the first job after a process
+  restart hits disk instead of recompiling (tracing is re-paid, compile
+  — the dominant cost at these shapes — is not).
+
+Per-K progress events ride the existing ``progress_callback`` plumbing
+(``parallel.sweep.build_sweep`` stages a ``jax.debug.callback`` after
+each K's scan step).  Because the callback is baked into the cached
+executable, the executor bakes in one *dispatcher* and redirects it to
+the current job's callback at run time; per-execution dedup (shard_map
+replicates effects per device) happens here.  After a job timeout the
+slot is cleared, so a still-running abandoned execution's events are
+dropped; if the SAME bucket is re-run while an abandoned execution is
+still live, its stragglers may briefly attribute to the new job — an
+accepted, documented corner of the timeout design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from consensus_clustering_tpu.config import SweepConfig
+
+_CLUSTERERS = ("kmeans", "gmm", "agglomerative", "spectral")
+
+# Every key POST /jobs accepts under "config"; anything else is a 400
+# (a typo silently falling back to a default is worse than an error).
+_CONFIG_KEYS = frozenset(
+    {
+        "k", "iterations", "subsampling", "seed", "clusterer",
+        "clusterer_options", "bins", "pac_interval", "parity_zeros",
+        "analysis", "delta_k_threshold", "dtype", "chunk_size",
+    }
+)
+
+
+class JobSpecError(ValueError):
+    """A submitted job payload failed validation (HTTP 400)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """Validated, JSON-able sweep request (no data — that rides separately).
+
+    Field semantics match the ``ConsensusClustering`` constructor / the
+    CLI ``run`` flags; only the JSON-friendly subset that a serving
+    result (curves, no matrices) needs is exposed.
+    """
+
+    k_values: Tuple[int, ...]
+    n_iterations: int = 25
+    subsampling: float = 0.8
+    seed: int = 23
+    clusterer: str = "kmeans"
+    clusterer_options: Tuple[Tuple[str, Any], ...] = ()
+    bins: int = 20
+    pac_interval: Tuple[float, float] = (0.1, 0.9)
+    parity_zeros: bool = True
+    analysis: str = "PAC"
+    delta_k_threshold: float = 0.05
+    dtype: str = "float32"
+    chunk_size: int = 8
+
+    def fingerprint_payload(self) -> Dict[str, Any]:
+        """The JSON payload hashed into the job fingerprint.
+
+        Everything that determines the RESULT, including the seed;
+        ``chunk_size`` is excluded for the same reason the checkpoint
+        fingerprint pops it — it only shapes the accumulation GEMMs,
+        counts are exact integers either way.
+        """
+        payload = dataclasses.asdict(self)
+        payload.pop("chunk_size")
+        payload["k_values"] = list(self.k_values)
+        payload["pac_interval"] = list(self.pac_interval)
+        payload["clusterer_options"] = dict(self.clusterer_options)
+        return payload
+
+    def bucket(self, n: int, d: int) -> str:
+        """The executable-cache key: fingerprint payload minus the seed
+        (a runtime input to the compiled program) and minus the fields
+        that only steer host-side post-processing (``analysis`` /
+        ``delta_k_threshold`` feed ``select_best_k`` after the sweep
+        returns — two jobs differing only there share one executable),
+        plus the data shape."""
+        payload = self.fingerprint_payload()
+        payload.pop("seed")
+        payload.pop("analysis")
+        payload.pop("delta_k_threshold")
+        payload["shape"] = [int(n), int(d)]
+        return json.dumps(payload, sort_keys=True)
+
+
+def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
+    """Validate a ``POST /jobs`` body into (spec, data matrix).
+
+    Raises :class:`JobSpecError` with a user-facing message on any
+    malformed field — the service maps it to HTTP 400.
+    """
+    if not isinstance(body, dict):
+        raise JobSpecError("body must be a JSON object")
+    data = body.get("data")
+    if data is None:
+        raise JobSpecError("missing 'data': a 2-D array of numbers")
+    cfg = body.get("config", {})
+    if not isinstance(cfg, dict):
+        raise JobSpecError("'config' must be a JSON object")
+    unknown = set(cfg) - _CONFIG_KEYS
+    if unknown:
+        # A typo ("iteration") silently running with the default would
+        # hand back a statistically different result with no warning.
+        raise JobSpecError(
+            f"unknown config key(s) {sorted(unknown)}; "
+            f"valid keys: {sorted(_CONFIG_KEYS)}"
+        )
+
+    # dtype first: the data matrix is materialised at the working dtype
+    # (parsing at float32 then widening would quantise a float64 job).
+    dtype = cfg.get("dtype", "float32")
+    if dtype not in ("float32", "float64"):
+        raise JobSpecError(
+            f"config.dtype must be 'float32' or 'float64', got {dtype!r}"
+        )
+    try:
+        x = np.asarray(data, dtype=np.dtype(dtype))
+    except (TypeError, ValueError) as e:
+        raise JobSpecError(f"'data' is not a numeric array: {e}")
+    if x.ndim != 2 or 0 in x.shape:
+        raise JobSpecError(
+            f"'data' must be a non-empty 2-D array, got shape {x.shape}"
+        )
+    if not np.all(np.isfinite(x)):
+        raise JobSpecError("'data' contains NaN/Inf")
+
+    def _int(name, default, lo, hi):
+        v = cfg.get(name, default)
+        if not isinstance(v, int) or isinstance(v, bool) or not lo <= v <= hi:
+            raise JobSpecError(
+                f"config.{name} must be an integer in [{lo}, {hi}], got {v!r}"
+            )
+        return v
+
+    k_spec = cfg.get("k", [2, 3])
+    if isinstance(k_spec, str):
+        from consensus_clustering_tpu.cli import _parse_k
+
+        try:
+            k_values = _parse_k(k_spec)
+        except ValueError:
+            raise JobSpecError(f"config.k spec {k_spec!r} is not lo:hi or a,b")
+    elif isinstance(k_spec, list) and k_spec:
+        k_values = tuple(k_spec)
+    else:
+        raise JobSpecError("config.k must be a non-empty list or 'lo:hi'")
+    for k in k_values:
+        if not isinstance(k, int) or isinstance(k, bool) or not 2 <= k <= 256:
+            raise JobSpecError(f"config.k entries must be ints in [2, 256], got {k!r}")
+    if max(k_values) >= x.shape[0]:
+        raise JobSpecError(
+            f"config.k max ({max(k_values)}) must be < n_samples ({x.shape[0]})"
+        )
+
+    subsampling = cfg.get("subsampling", 0.8)
+    if not isinstance(subsampling, (int, float)) or not 0.0 < subsampling <= 1.0:
+        raise JobSpecError(
+            f"config.subsampling must be in (0, 1], got {subsampling!r}"
+        )
+    clusterer = cfg.get("clusterer", "kmeans")
+    if clusterer not in _CLUSTERERS:
+        raise JobSpecError(
+            f"config.clusterer {clusterer!r} unknown (choose from "
+            f"{sorted(_CLUSTERERS)})"
+        )
+    options = cfg.get("clusterer_options", {})
+    if not isinstance(options, dict):
+        raise JobSpecError("config.clusterer_options must be an object")
+    analysis = cfg.get("analysis", "PAC")
+    if analysis not in ("PAC", "delta_k"):
+        raise JobSpecError(
+            f"config.analysis must be 'PAC' or 'delta_k', got {analysis!r}"
+        )
+    parity_zeros = cfg.get("parity_zeros", True)
+    if not isinstance(parity_zeros, bool):
+        raise JobSpecError("config.parity_zeros must be a boolean")
+    threshold = cfg.get("delta_k_threshold", 0.05)
+    if (
+        not isinstance(threshold, (int, float))
+        or isinstance(threshold, bool)
+        or not 0.0 <= threshold
+    ):
+        raise JobSpecError(
+            f"config.delta_k_threshold must be a number >= 0, "
+            f"got {threshold!r}"
+        )
+    pac_interval = cfg.get("pac_interval", [0.1, 0.9])
+    if (
+        not isinstance(pac_interval, (list, tuple))
+        or len(pac_interval) != 2
+        or not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in pac_interval)
+        or not 0.0 <= pac_interval[0] < pac_interval[1] <= 1.0
+    ):
+        raise JobSpecError(
+            f"config.pac_interval must be [lo, hi] with 0 <= lo < hi <= 1, "
+            f"got {pac_interval!r}"
+        )
+    spec = JobSpec(
+        k_values=tuple(int(k) for k in k_values),
+        n_iterations=_int("iterations", 25, 2, 100_000),
+        subsampling=float(subsampling),
+        seed=_int("seed", 23, 0, 2**31 - 1),
+        clusterer=clusterer,
+        clusterer_options=tuple(sorted(options.items())),
+        bins=_int("bins", 20, 2, 10_000),
+        pac_interval=(float(pac_interval[0]), float(pac_interval[1])),
+        parity_zeros=parity_zeros,
+        analysis=analysis,
+        delta_k_threshold=float(threshold),
+        dtype=dtype,
+        chunk_size=_int("chunk_size", 8, 1, 4096),
+    )
+    return spec, x
+
+
+class SweepExecutor:
+    """Runs validated jobs as compiled sweeps, caching executables.
+
+    ``run_count`` counts actual sweep executions — the jobstore-dedup
+    test asserts it does NOT advance when a duplicate submission is
+    served from the store.
+    """
+
+    def __init__(self, use_compilation_cache: bool = True):
+        self.run_count = 0
+        self.executable_cache_hits = 0
+        self._compiled: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._job_cb: Optional[Callable[[int, float], None]] = None
+        self._seen: set = set()
+        # Generation counter for the progress slot: an abandoned
+        # (timed-out) execution's cleanup must not clear the slot out
+        # from under the job that owns it now.
+        self._cb_gen = 0
+        self.compilation_cache_dir = None
+        if use_compilation_cache:
+            from consensus_clustering_tpu.utils.platform import (
+                enable_compilation_cache,
+            )
+
+            self.compilation_cache_dir = enable_compilation_cache()
+
+    # -- backend label ---------------------------------------------------
+
+    def backend(self) -> str:
+        """'tpu' / 'gpu' / 'cpu-fallback', mirroring bench.py's
+        ``measurement_backend`` convention: a CPU backend is always
+        labelled as the fallback it is, so no metrics consumer can read
+        a CPU number as an accelerator one."""
+        import jax
+
+        name = jax.default_backend()
+        return "cpu-fallback" if name == "cpu" else name
+
+    # -- executable cache ------------------------------------------------
+
+    def _config_for(self, spec: JobSpec, n: int, d: int) -> SweepConfig:
+        return SweepConfig(
+            n_samples=n,
+            n_features=d,
+            k_values=spec.k_values,
+            n_iterations=spec.n_iterations,
+            subsampling=spec.subsampling,
+            bins=spec.bins,
+            pac_interval=spec.pac_interval,
+            parity_zeros=spec.parity_zeros,
+            store_matrices=False,  # serving results are curves-only JSON
+            chunk_size=spec.chunk_size,
+            dtype=spec.dtype,
+        )
+
+    def _clusterer_for(self, spec: JobSpec):
+        from consensus_clustering_tpu.models.agglomerative import (
+            AgglomerativeClustering,
+        )
+        from consensus_clustering_tpu.models.gmm import GaussianMixture
+        from consensus_clustering_tpu.models.kmeans import KMeans
+        from consensus_clustering_tpu.models.spectral import SpectralClustering
+
+        base = {
+            "kmeans": KMeans,
+            "gmm": GaussianMixture,
+            "agglomerative": AgglomerativeClustering,
+            "spectral": SpectralClustering,
+        }[spec.clusterer]()
+        options = dict(spec.clusterer_options)
+        if not options:
+            return base
+        from consensus_clustering_tpu.api import _apply_options
+
+        try:
+            return _apply_options(base, options)
+        except (TypeError, ValueError) as e:
+            raise JobSpecError(str(e))
+
+    def _dispatch(self, k, pac):
+        """The one progress callback baked into every cached executable;
+        redirects to the current job's callback with per-execution k
+        dedup (shard_map replicates effects per device)."""
+        kk = int(k)
+        with self._lock:
+            cb = self._job_cb
+            if cb is None or kk in self._seen:
+                return
+            self._seen.add(kk)
+        cb(kk, float(pac))
+
+    def _get_compiled(self, spec: JobSpec, n: int, d: int):
+        """(compiled, build_compile_seconds, cached) for the bucket."""
+        import jax.numpy as jnp
+
+        key = spec.bucket(n, d)
+        hit = self._compiled.get(key)
+        if hit is not None:
+            self.executable_cache_hits += 1
+            return hit, 0.0, True
+        from consensus_clustering_tpu.parallel.sweep import build_sweep
+
+        t0 = time.perf_counter()
+        sweep = build_sweep(
+            self._clusterer_for(spec),
+            self._config_for(spec, n, d),
+            progress_callback=self._dispatch,
+        )
+        xz = jnp.zeros((n, d), jnp.dtype(spec.dtype))
+        import jax
+
+        compiled = sweep.lower(xz, jax.random.PRNGKey(0)).compile()
+        seconds = time.perf_counter() - t0
+        self._compiled[key] = compiled
+        return compiled, seconds, False
+
+    def warmup(self, spec: JobSpec, n: int, d: int) -> float:
+        """Pre-compile the executable for a shape bucket; returns the
+        build+compile wall-clock (0.0 when already warm)."""
+        _, seconds, _ = self._get_compiled(spec, n, d)
+        return seconds
+
+    def cancel_events(self) -> None:
+        """Drop the current job's progress slot (called on job timeout so
+        an abandoned execution's stragglers are not emitted)."""
+        with self._lock:
+            self._cb_gen += 1
+            self._job_cb = None
+            self._seen = set()
+
+    # -- execution -------------------------------------------------------
+
+    def run(
+        self,
+        spec: JobSpec,
+        x: np.ndarray,
+        progress_cb: Optional[Callable[[int, float], None]] = None,
+    ) -> Dict[str, Any]:
+        """Execute one sweep; returns the JSON-able serving result."""
+        import jax
+        import jax.numpy as jnp
+
+        from consensus_clustering_tpu.ops.analysis import (
+            area_under_cdf,
+            delta_k,
+            select_best_k,
+        )
+
+        n, d = x.shape
+        compiled, compile_seconds, cached = self._get_compiled(spec, n, d)
+
+        with self._lock:
+            self._cb_gen += 1
+            gen = self._cb_gen
+            self._job_cb = progress_cb
+            self._seen = set()
+        try:
+            xj = jnp.asarray(x, jnp.dtype(spec.dtype))
+            key = jax.random.PRNGKey(spec.seed)
+            t0 = time.perf_counter()
+            out = compiled(xj, key)
+            # Host copy is the completion barrier (run_sweep's rule: on
+            # some platforms block_until_ready returns early).
+            host = jax.tree.map(np.asarray, out)
+            run_seconds = time.perf_counter() - t0
+            if progress_cb is not None:
+                # Debug-callback effects are asynchronous; drain them so
+                # every per-K event lands before job_done.
+                jax.effects_barrier()
+        finally:
+            with self._lock:
+                # Only the slot's current owner may clear it: an abandoned
+                # timed-out execution finishing late finds a newer gen and
+                # leaves the live job's callback alone.
+                if self._cb_gen == gen:
+                    self._job_cb = None
+                self.run_count += 1
+
+        ks = list(spec.k_values)
+        pac = [float(v) for v in host["pac_area"]]
+        areas = np.asarray(
+            [float(area_under_cdf(host["cdf"][i])) for i in range(len(ks))]
+        )
+        gains = delta_k(areas)
+        best_k = select_best_k(
+            spec.analysis, ks, pac,
+            delta_k_gains=gains,
+            delta_k_threshold=spec.delta_k_threshold,
+        )
+        return {
+            "shape": [int(n), int(d)],
+            "K": [int(k) for k in ks],
+            "pac_area": {str(k): p for k, p in zip(ks, pac)},
+            "areas": [float(a) for a in areas],
+            "delta_k": [float(g) for g in gains],
+            "best_k": int(best_k),
+            "analysis": spec.analysis,
+            "backend": self.backend(),
+            "timings": {
+                "compile_seconds": compile_seconds,
+                "run_seconds": run_seconds,
+                "resamples_per_second": spec.n_iterations * len(ks)
+                / max(run_seconds, 1e-9),
+                "executable_cached": cached,
+            },
+        }

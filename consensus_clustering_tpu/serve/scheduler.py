@@ -1,0 +1,383 @@
+"""Bounded FIFO job scheduler: admission control, timeout, retry.
+
+The service's backpressure layer.  A single worker thread drains a
+bounded ``queue.Queue``; a full queue rejects the submission at
+admission time (the HTTP layer maps :class:`QueueFull` to 429) instead
+of buffering unboundedly — on a box where one sweep can take minutes,
+an unbounded queue is an OOM with extra steps.
+
+Each job runs with:
+
+- **dedup**: the jobstore is consulted at submission; an identical
+  (config, data) fingerprint completes instantly from the stored result
+  (``cache_hits``), never entering the queue;
+- **per-job timeout**: the executor call runs on a per-job thread and is
+  abandoned (status ``timeout``) when it exceeds ``job_timeout`` —
+  a compiled XLA program cannot be interrupted, so the thread is left
+  to finish in the background with its progress events dropped;
+- **retry with exponential backoff**: transient failures (anything but
+  :class:`~consensus_clustering_tpu.serve.executor.JobSpecError`,
+  which is the caller's fault and permanent) re-run after
+  ``backoff_base * 2**attempt`` seconds, up to ``max_retries`` times.
+
+Job records live in memory for speed and are mirrored to the jobstore on
+every transition, so ``GET /jobs/<id>`` survives a restart.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from consensus_clustering_tpu.serve.events import EventLog
+from consensus_clustering_tpu.serve.executor import (
+    JobSpec,
+    JobSpecError,
+    SweepExecutor,
+)
+from consensus_clustering_tpu.serve.jobstore import JobStore
+
+
+class QueueFull(Exception):
+    """Admission rejected: the job queue is at capacity (HTTP 429)."""
+
+
+class JobTimeout(Exception):
+    """The executor exceeded the per-job wall-clock budget."""
+
+
+class Scheduler:
+    """FIFO queue + worker loop in front of a :class:`SweepExecutor`."""
+
+    def __init__(
+        self,
+        executor: SweepExecutor,
+        store: JobStore,
+        max_queue: int = 16,
+        job_timeout: Optional[float] = None,
+        max_retries: int = 2,
+        backoff_base: float = 0.5,
+        events: Optional[EventLog] = None,
+        sleep=time.sleep,
+    ):
+        self.executor = executor
+        self.store = store
+        self.events = events or EventLog(None)
+        self.job_timeout = job_timeout
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self._sleep = sleep  # injectable so retry tests need not wait
+        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        # Spec + data ride outside the job record: records mirror to the
+        # jobstore as JSON and must stay serialisable.
+        self._specs: Dict[str, JobSpec] = {}
+        self._data: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        # Counters for GET /metrics; guarded by _lock.
+        self.jobs_completed = 0
+        self.jobs_failed = 0
+        self.jobs_retried = 0
+        self.jobs_timed_out = 0
+        self.cache_hits = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._reconcile_orphans()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="serve-worker", daemon=True
+        )
+        self._worker.start()
+
+    def _reconcile_orphans(self) -> None:
+        """Fail over jobs a previous process left non-terminal.
+
+        A record mirrored as ``queued``/``running`` whose process died
+        can never finish — its spec and data lived only in that
+        process's memory — so without this sweep a client polling from
+        before the restart would wait forever.  Jobs this scheduler
+        tracks in memory are skipped (a stop()/start() cycle within one
+        process must not fail live work).
+        """
+        for job_id, record in self.store.iter_jobs():
+            with self._lock:
+                if job_id in self._jobs:
+                    continue
+            if record.get("status") in ("queued", "running"):
+                record.update(
+                    status="failed",
+                    error="interrupted by service restart",
+                    finished_at=round(time.time(), 3),
+                )
+                self.store.save_job(record)
+                self.events.emit(
+                    "job_failed", job_id=job_id,
+                    error="interrupted by service restart", kind="restart",
+                )
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        try:
+            # Wake a worker blocked on an empty queue; when the queue is
+            # full the worker is busy anyway and will see _stop after the
+            # current job.
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout)
+            self._worker = None
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, spec: JobSpec, x: np.ndarray) -> Dict[str, Any]:
+        """Admit a job; returns its (already jobstore-mirrored) record.
+
+        Identical (config, data) submissions dedup: if the fingerprint's
+        result is stored, the job is born ``done`` with that result and
+        never queues.  Raises :class:`QueueFull` when the queue is at
+        capacity.
+        """
+        fp = self.store.fingerprint(spec.fingerprint_payload(), x)
+        job_id = uuid.uuid4().hex
+        record: Dict[str, Any] = {
+            "job_id": job_id,
+            "fingerprint": fp,
+            "status": "queued",
+            "shape": [int(v) for v in x.shape],
+            "submitted_at": round(time.time(), 3),
+            "attempt": 0,
+        }
+        cached = self.store.get_result(fp)
+        if cached is not None:
+            record["status"] = "done"
+            record["result"] = cached
+            record["from_cache"] = True
+            with self._lock:
+                self.cache_hits += 1
+                self._jobs[job_id] = record
+            self.store.save_job(record)
+            self.events.emit(
+                "job_submitted", job_id=job_id, fingerprint=fp,
+                shape=record["shape"], cached=True,
+            )
+            return record
+
+        record["from_cache"] = False
+        with self._lock:
+            self._jobs[job_id] = record
+            self._specs[job_id] = spec
+            self._data[job_id] = x
+        # Mirror to the jobstore BEFORE enqueueing: once the worker can see
+        # the job it starts writing "running"/"done" transitions, and the
+        # admission-time "queued" snapshot must never land after (and
+        # clobber) them.  Snapshot now for the same reason: the live record
+        # is the worker's to mutate the moment the id enters the queue, and
+        # the caller's HTTP response must serialise a stable "queued" view.
+        self.store.save_job(record)
+        snapshot = dict(record)
+        try:
+            self._queue.put_nowait(job_id)
+        except queue.Full:
+            with self._lock:
+                del self._jobs[job_id]
+                del self._specs[job_id]
+                del self._data[job_id]
+            self.store.delete_job(job_id)
+            raise QueueFull(
+                f"queue full ({self._queue.maxsize} jobs); retry later"
+            )
+        self.events.emit(
+            "job_submitted", job_id=job_id, fingerprint=fp,
+            shape=record["shape"], cached=False,
+        )
+        return snapshot
+
+    def get(self, job_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is not None:
+                return dict(record)
+        return self.store.load_job(job_id)  # pre-restart jobs
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "queue_depth": self._queue.qsize(),
+                "queue_capacity": self._queue.maxsize,
+                "jobs_completed": self.jobs_completed,
+                "jobs_failed": self.jobs_failed,
+                "jobs_retried": self.jobs_retried,
+                "jobs_timed_out": self.jobs_timed_out,
+                "cache_hits": self.cache_hits,
+                "executable_cache_hits": self.executor.executable_cache_hits,
+                "sweeps_executed": self.executor.run_count,
+                "backend": self.executor.backend(),
+            }
+
+    # -- worker ----------------------------------------------------------
+
+    def _update(self, job_id: str, **fields) -> Dict[str, Any]:
+        with self._lock:
+            record = self._jobs[job_id]
+            record.update(fields)
+            snapshot = dict(record)
+        self.store.save_job(snapshot)
+        return snapshot
+
+    def _run_with_timeout(self, spec: JobSpec, x, progress_cb):
+        """Run the executor, bounding wall-clock with a per-job thread.
+
+        A compiled XLA program has no cancellation point, so on timeout
+        the job thread is abandoned (daemon; it dies with the process)
+        and its progress slot cleared — see the executor docstring for
+        the event-attribution corner this accepts.
+        """
+        if self.job_timeout is None:
+            return self.executor.run(spec, x, progress_cb)
+        box: Dict[str, Any] = {}
+
+        def _target():
+            try:
+                box["result"] = self.executor.run(spec, x, progress_cb)
+            except BaseException as e:  # noqa: BLE001 — reraised below
+                box["error"] = e
+
+        t = threading.Thread(target=_target, daemon=True)
+        t.start()
+        t.join(self.job_timeout)
+        if t.is_alive():
+            self.executor.cancel_events()
+            raise JobTimeout(
+                f"job exceeded {self.job_timeout}s wall-clock budget"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            job_id = self._queue.get()
+            if job_id is None or self._stop.is_set():
+                break
+            try:
+                self._execute(job_id)
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                # _execute handles job failures itself; anything escaping
+                # is a scheduler bug, and one bad job must not kill the
+                # worker and strand every queued job behind it.
+                with self._lock:
+                    self.jobs_failed += 1
+                try:
+                    self._update(
+                        job_id, status="failed",
+                        error=f"internal scheduler error: {e}",
+                        finished_at=round(time.time(), 3),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+                self.events.emit(
+                    "job_failed", job_id=job_id, error=str(e),
+                    kind="internal",
+                )
+
+    def _execute(self, job_id: str) -> None:
+        with self._lock:
+            record = self._jobs[job_id]
+            spec = self._specs.pop(job_id)
+            x = self._data.pop(job_id)
+            fp = record["fingerprint"]
+
+        def progress_cb(k: int, pac: float) -> None:
+            # The per-K signal api.py's progress plumbing already emits,
+            # surfaced as a service event (name kept aligned with the
+            # batch path's k_batch_complete metrics event).
+            self.events.emit(
+                "k_batch_complete", job_id=job_id, k=k, pac=pac
+            )
+
+        for attempt in range(self.max_retries + 1):
+            self._update(
+                job_id, status="running", attempt=attempt,
+                started_at=round(time.time(), 3),
+            )
+            self.events.emit("job_started", job_id=job_id, attempt=attempt)
+            t0 = time.perf_counter()
+            try:
+                result = self._run_with_timeout(spec, x, progress_cb)
+            except JobTimeout as e:
+                with self._lock:
+                    self.jobs_timed_out += 1
+                    self.jobs_failed += 1
+                self._update(
+                    job_id, status="timeout", error=str(e),
+                    finished_at=round(time.time(), 3),
+                )
+                self.events.emit(
+                    "job_failed", job_id=job_id, error=str(e), kind="timeout"
+                )
+                return
+            except JobSpecError as e:
+                # The caller's fault, deterministic: retrying cannot help.
+                with self._lock:
+                    self.jobs_failed += 1
+                self._update(
+                    job_id, status="failed", error=str(e),
+                    finished_at=round(time.time(), 3),
+                )
+                self.events.emit(
+                    "job_failed", job_id=job_id, error=str(e),
+                    kind="bad_request",
+                )
+                return
+            except Exception as e:  # transient until retries exhausted
+                if attempt < self.max_retries:
+                    backoff = self.backoff_base * (2 ** attempt)
+                    with self._lock:
+                        self.jobs_retried += 1
+                    self.events.emit(
+                        "job_retry", job_id=job_id, attempt=attempt,
+                        backoff_seconds=backoff, error=str(e),
+                    )
+                    self._sleep(backoff)
+                    continue
+                with self._lock:
+                    self.jobs_failed += 1
+                self._update(
+                    job_id, status="failed", error=str(e),
+                    finished_at=round(time.time(), 3),
+                )
+                self.events.emit(
+                    "job_failed", job_id=job_id, error=str(e),
+                    kind="retries_exhausted",
+                )
+                return
+            seconds = time.perf_counter() - t0
+            # Store first, then flip status: a GET that sees "done" must
+            # always find the result bytes on disk.
+            self.store.put_result(fp, result)
+            stored = self.store.get_result(fp)
+            with self._lock:
+                self.jobs_completed += 1
+            self._update(
+                job_id, status="done", result=stored,
+                finished_at=round(time.time(), 3), seconds=seconds,
+            )
+            self.events.emit(
+                "job_done", job_id=job_id, fingerprint=fp,
+                seconds=round(seconds, 3),
+            )
+            return

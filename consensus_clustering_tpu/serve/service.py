@@ -1,0 +1,201 @@
+"""Consensus-as-a-service: the stdlib-only HTTP JSON API.
+
+``http.server.ThreadingHTTPServer`` in front of the scheduler — no web
+framework, nothing the container doesn't already have.  Endpoints:
+
+- ``POST /jobs``       — submit a sweep; body ``{"data": [[...]],
+  "config": {...}}`` (see :func:`~consensus_clustering_tpu.serve.
+  executor.parse_job_spec` for the config schema).  202 + job record on
+  admission, 200 + completed record when the (config, data) fingerprint
+  dedups against the jobstore, 400 on a malformed body, 429 when the
+  queue is full, 413 when the body exceeds ``max_body_bytes``.
+- ``GET /jobs/<id>``   — poll a job; embeds ``result`` once done.
+- ``GET /healthz``     — liveness: status, backend label, uptime.
+- ``GET /metrics``     — queue depth/capacity, jobs completed/failed/
+  retried/timed-out, jobstore ``cache_hits``, in-process
+  ``executable_cache_hits``, ``sweeps_executed``, and ``backend``
+  (``tpu`` | ``cpu-fallback``, bench.py's ``measurement_backend``
+  convention).
+
+Run it with ``python -m consensus_clustering_tpu serve`` or embed
+:class:`ConsensusService` (``start()``/``stop()``) — the test suite does
+the latter against an ephemeral port.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from consensus_clustering_tpu.serve.events import EventLog
+from consensus_clustering_tpu.serve.executor import (
+    JobSpecError,
+    SweepExecutor,
+    parse_job_spec,
+)
+from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.scheduler import QueueFull, Scheduler
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_MAX_BODY = 64 * 2**20  # 64 MiB of JSON ~ a 2M-cell float matrix
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # The service object is attached to the server instance.
+    @property
+    def service(self) -> "ConsensusService":
+        return self.server.service  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # route access logs to logging
+        logger.debug("http: " + fmt, *args)
+
+    def _send_json(self, code: int, payload: Dict[str, Any]) -> None:
+        blob = json.dumps(payload, sort_keys=True, default=float).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server spelling
+        if self.path.rstrip("/") != "/jobs":
+            self._send_json(404, {"error": f"no such route {self.path}"})
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            # No declared length (absent, zero, or chunked): anything the
+            # client did send would desync keep-alive, so close.
+            self.close_connection = True
+            self._send_json(400, {"error": "missing request body"})
+            return
+        if length > self.service.max_body_bytes:
+            # The body is rejected unread: close the connection rather than
+            # let keep-alive misparse the unread bytes as the next request.
+            self.close_connection = True
+            self._send_json(
+                413,
+                {"error": f"body exceeds {self.service.max_body_bytes} bytes"},
+            )
+            return
+        try:
+            body = json.loads(self.rfile.read(length))
+        except ValueError:
+            self._send_json(400, {"error": "body is not valid JSON"})
+            return
+        try:
+            spec, x = parse_job_spec(body)
+        except JobSpecError as e:
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            record = self.service.scheduler.submit(spec, x)
+        except QueueFull as e:
+            self._send_json(429, {"error": str(e)})
+            return
+        self._send_json(200 if record["status"] == "done" else 202, record)
+
+    def do_GET(self) -> None:  # noqa: N802
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            self._send_json(200, self.service.health())
+            return
+        if path == "/metrics":
+            self._send_json(200, self.service.scheduler.metrics())
+            return
+        if path.startswith("/jobs/"):
+            job_id = path[len("/jobs/"):]
+            if "/" in job_id or not job_id:
+                self._send_json(404, {"error": "bad job path"})
+                return
+            record = self.service.scheduler.get(job_id)
+            if record is None:
+                self._send_json(404, {"error": f"unknown job {job_id}"})
+                return
+            self._send_json(200, record)
+            return
+        self._send_json(404, {"error": f"no such route {self.path}"})
+
+
+class ConsensusService:
+    """The assembled serving stack: jobstore + executor + scheduler + HTTP.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` —
+    how the tests run hermetically).  ``start()`` serves on a daemon
+    thread; ``serve_forever()`` blocks (the CLI path).
+    """
+
+    def __init__(
+        self,
+        store_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        max_queue: int = 16,
+        job_timeout: Optional[float] = None,
+        max_retries: int = 2,
+        backoff_base: float = 0.5,
+        events_path: Optional[str] = None,
+        executor: Optional[SweepExecutor] = None,
+        max_body_bytes: int = _DEFAULT_MAX_BODY,
+    ):
+        self.store = JobStore(store_dir)
+        self.events = EventLog(events_path)
+        self.executor = executor or SweepExecutor()
+        self.scheduler = Scheduler(
+            self.executor,
+            self.store,
+            max_queue=max_queue,
+            job_timeout=job_timeout,
+            max_retries=max_retries,
+            backoff_base=backoff_base,
+            events=self.events,
+        )
+        self.max_body_bytes = max_body_bytes
+        self.started_at = time.time()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.service = self  # type: ignore[attr-defined]
+        self._http_thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "status": "ok",
+            "backend": self.executor.backend(),
+            "uptime_seconds": round(time.time() - self.started_at, 3),
+            "queue_depth": self.scheduler.queue_depth(),
+        }
+
+    def start(self) -> "ConsensusService":
+        self.scheduler.start()
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._http_thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.scheduler.start()
+        logger.info(
+            "consensus service listening on %s:%d (backend=%s)",
+            self._httpd.server_address[0], self.port,
+            self.executor.backend(),
+        )
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(5.0)
+            self._http_thread = None
+        self.scheduler.stop()

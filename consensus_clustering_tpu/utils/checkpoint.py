@@ -40,6 +40,38 @@ def _fingerprint(config: SweepConfig, seed: int) -> str:
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def data_fingerprint(x: np.ndarray) -> str:
+    """Content hash of a data matrix: dtype + shape + raw bytes.
+
+    The serving jobstore's dedup key must distinguish two datasets that
+    happen to share a shape, so the digest covers the actual values (a
+    C-contiguous copy is taken only when needed).
+    """
+    x = np.ascontiguousarray(x)
+    h = hashlib.sha256()
+    h.update(str(x.dtype).encode())
+    h.update(repr(x.shape).encode())
+    h.update(x.tobytes())
+    return h.hexdigest()[:16]
+
+
+def job_fingerprint(payload: Dict, x: np.ndarray) -> str:
+    """Fingerprint of a serving job: the sweep-checkpoint scheme extended
+    with the data content.
+
+    ``payload`` is the JSON-able job config (every semantics-bearing field
+    including the seed); the data rides along as its
+    :func:`data_fingerprint`.  Two submissions with equal payload and
+    equal data bytes collide — which is exactly the dedup the jobstore
+    wants: the second is served from the stored result.
+    """
+    blob = json.dumps(
+        {"config": payload, "data_sha": data_fingerprint(x)},
+        sort_keys=True,
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
 class SweepCheckpoint:
     """Directory of per-K npz checkpoints with a config fingerprint."""
 

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Import smoke gate: every ``consensus_clustering_tpu`` module must import.
+
+Version-skew breaks (a symbol moving between JAX releases, like
+``jax.shard_map`` vs ``jax.experimental.shard_map``) otherwise surface as
+dozens of opaque pytest collection errors.  This gate runs first in the
+tier-1 command (ROADMAP.md) so they fail fast, one module per line, with
+the actual ImportError:
+
+    $ python scripts/check_imports.py
+    ok: 41 modules import cleanly (jax 0.4.37, backend cpu)
+
+    $ python scripts/check_imports.py      # with a broken import
+    FAIL consensus_clustering_tpu.parallel.sweep: ImportError: cannot
+         import name 'shard_map' from 'jax'
+    1 of 41 modules failed to import
+
+Runs on CPU (``JAX_PLATFORMS=cpu`` forced before JAX initialises) so the
+gate never touches — or waits on — an accelerator.
+"""
+
+import importlib
+import os
+import pkgutil
+import sys
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def iter_module_names(package_name: str):
+    pkg = importlib.import_module(package_name)
+    yield package_name
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=package_name + "."):
+        # __main__ runs the CLI at import time, by design; skip it.
+        if info.name.rsplit(".", 1)[-1] == "__main__":
+            continue
+        yield info.name
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    failures = []
+    names = []
+    for name in iter_module_names("consensus_clustering_tpu"):
+        names.append(name)
+        try:
+            importlib.import_module(name)
+        except BaseException:  # noqa: BLE001 — report, keep scanning
+            failures.append((name, traceback.format_exc(limit=3)))
+    if failures:
+        for name, tb in failures:
+            last = tb.strip().splitlines()[-1]
+            print(f"FAIL {name}: {last}", file=sys.stderr)
+            print(tb, file=sys.stderr)
+        print(
+            f"{len(failures)} of {len(names)} modules failed to import",
+            file=sys.stderr,
+        )
+        return 1
+    import jax
+
+    print(
+        f"ok: {len(names)} modules import cleanly "
+        f"(jax {jax.__version__}, backend {jax.default_backend()})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,483 @@
+"""End-to-end and unit tests for the serving subsystem (docs/SERVING.md).
+
+The HTTP tests run a real :class:`ConsensusService` on an ephemeral
+localhost port and speak real HTTP to it — submit → poll → result,
+dedup-from-jobstore, full-queue 429, healthz/metrics schema — per the
+acceptance criteria in ISSUE 1.  Scheduler corner cases (retry, timeout,
+worker survival) run against a stub executor so they need no compile.
+"""
+
+import importlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.serve import (
+    ConsensusService,
+    JobSpecError,
+    JobStore,
+    QueueFull,
+    Scheduler,
+    SweepExecutor,
+    parse_job_spec,
+)
+from consensus_clustering_tpu.serve.jobstore import canonical_result_bytes
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers
+
+
+def _req(base, path, body=None):
+    """(status, parsed json, raw bytes) for one HTTP round trip."""
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            raw = r.read()
+            return r.status, json.loads(raw), raw
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw), raw
+
+
+def _poll(base, job_id, budget=120.0):
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        code, rec, _ = _req(base, f"/jobs/{job_id}")
+        assert code == 200
+        if rec["status"] in ("done", "failed", "timeout"):
+            return rec
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} still {rec['status']} after {budget}s")
+
+
+def _job_body(rng, n=60, d=4, k=(2, 3), iters=10, seed=23):
+    half = n // 2
+    x = np.concatenate(
+        [rng.normal(0.0, 0.3, (half, d)), rng.normal(3.0, 0.3, (n - half, d))]
+    )
+    return {
+        "data": x.tolist(),
+        "config": {"k": list(k), "iterations": iters, "seed": seed},
+    }
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: a real service, a real sweep, real HTTP
+
+
+@pytest.fixture(scope="module")
+def service(tmp_path_factory):
+    svc = ConsensusService(
+        store_dir=str(tmp_path_factory.mktemp("serve_store")),
+        port=0,  # ephemeral — hermetic under parallel test runs
+        executor=SweepExecutor(use_compilation_cache=False),
+        events_path=str(tmp_path_factory.mktemp("serve_events") / "ev.jsonl"),
+    ).start()
+    yield svc
+    svc.stop()
+
+
+@pytest.fixture(scope="module")
+def base(service):
+    return f"http://127.0.0.1:{service.port}"
+
+
+def test_submit_poll_result_roundtrip(base, service):
+    body = _job_body(np.random.default_rng(1))
+    code, rec, _ = _req(base, "/jobs", body)
+    assert code == 202
+    assert rec["status"] == "queued" and rec["from_cache"] is False
+    done = _poll(base, rec["job_id"])
+    assert done["status"] == "done"
+    result = done["result"]
+    assert result["K"] == [2, 3]
+    assert result["best_k"] in (2, 3)
+    assert set(result["pac_area"]) == {"2", "3"}
+    assert result["backend"] == service.executor.backend()
+    assert result["timings"]["run_seconds"] > 0
+
+
+def test_duplicate_submission_served_from_jobstore(base, service):
+    """Acceptance criterion: two identical POST /jobs return byte-identical
+    results, the second from the store with no sweep re-executed."""
+    body = _job_body(np.random.default_rng(2), seed=99)
+    code1, rec1, _ = _req(base, "/jobs", body)
+    assert code1 == 202
+    done = _poll(base, rec1["job_id"])
+    runs_before = service.executor.run_count
+
+    code2, rec2, _ = _req(base, "/jobs", body)
+    assert code2 == 200  # completed instantly from the store
+    assert rec2["status"] == "done" and rec2["from_cache"] is True
+    assert rec2["fingerprint"] == rec1["fingerprint"]
+    assert service.executor.run_count == runs_before  # no sweep re-executed
+
+    # Byte identity, not just value equality: both records carry the one
+    # canonical serialisation the jobstore wrote.
+    assert canonical_result_bytes(rec2["result"]) == canonical_result_bytes(
+        done["result"]
+    )
+
+    code, metrics, _ = _req(base, "/metrics")
+    assert code == 200
+    assert metrics["cache_hits"] >= 1
+    assert metrics["queue_depth"] >= 0
+    assert metrics["backend"] in ("tpu", "gpu", "cpu-fallback")
+
+
+def test_different_seed_is_not_a_cache_hit(base, service):
+    """The fingerprint covers the seed: changing it must re-run."""
+    body = _job_body(np.random.default_rng(2), seed=100)
+    code, rec, _ = _req(base, "/jobs", body)
+    assert code == 202 and rec["from_cache"] is False
+    assert _poll(base, rec["job_id"])["status"] == "done"
+
+
+def test_healthz_schema(base):
+    code, health, _ = _req(base, "/healthz")
+    assert code == 200
+    assert health["status"] == "ok"
+    assert health["backend"] in ("tpu", "gpu", "cpu-fallback")
+    assert health["uptime_seconds"] >= 0
+    assert isinstance(health["queue_depth"], int)
+
+
+def test_metrics_schema(base):
+    code, m, _ = _req(base, "/metrics")
+    assert code == 200
+    for field in (
+        "queue_depth", "queue_capacity", "jobs_completed", "jobs_failed",
+        "jobs_retried", "jobs_timed_out", "cache_hits",
+        "executable_cache_hits", "sweeps_executed", "backend",
+    ):
+        assert field in m, field
+
+
+def test_events_jsonl_lifecycle(base, service):
+    """The event log carries the documented lifecycle for a finished job."""
+    body = _job_body(np.random.default_rng(3), seed=7)
+    _, rec, _ = _req(base, "/jobs", body)
+    _poll(base, rec["job_id"])
+    with open(service.events.path) as f:
+        events = [json.loads(line) for line in f]
+    mine = [e for e in events if e.get("job_id") == rec["job_id"]]
+    names = [e["event"] for e in mine]
+    assert names[0] == "job_submitted" and names[-1] == "job_done"
+    assert "job_started" in names
+    ks = sorted(e["k"] for e in mine if e["event"] == "k_batch_complete")
+    assert ks == [2, 3]  # once per K, per-device replication deduped
+
+
+def test_bad_requests_rejected(base):
+    for body, why in [
+        ({"config": {"k": [2, 3]}}, "missing data"),
+        ({"data": [[1, 2], [3, 4]], "config": {"k": [9]}}, "k >= n_samples"),
+        ({"data": [1, 2, 3], "config": {}}, "not 2-D"),
+        ({"data": [[1, float("nan")], [3, 4]]}, "NaN"),
+        ({"data": [[1, 2], [3, 4], [5, 6]], "config": {"clusterer": "dbscan"}},
+         "unknown clusterer"),
+        ({"data": [[1, 2], [3, 4], [5, 6]], "config": {"iteration": 500}},
+         "unknown config key (typo) must 400, not silently run defaults"),
+        ({"data": [[1, 2], [3, 4], [5, 6]],
+          "config": {"delta_k_threshold": "high"}},
+         "non-numeric delta_k_threshold must 400, not crash the handler"),
+        ({"data": [[1, 2], [3, 4], [5, 6]],
+          "config": {"pac_interval": [0.9, 0.1]}},
+         "inverted pac_interval"),
+        ({"data": [[1, 2], [3, 4], [5, 6]], "config": {"dtype": "int8"}},
+         "unsupported dtype"),
+    ]:
+        code, rec, _ = _req(base, "/jobs", body)
+        assert code == 400, why
+        assert "error" in rec
+
+
+def test_unknown_routes_and_jobs_404(base):
+    assert _req(base, "/nope")[0] == 404
+    assert _req(base, "/jobs/deadbeef")[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# Scheduler semantics against a stub executor (no compiles)
+
+
+class _StubExecutor:
+    """Duck-typed SweepExecutor: scripted results, no JAX."""
+
+    def __init__(self, script=None, block=None):
+        self.run_count = 0
+        self.executable_cache_hits = 0
+        self._script = list(script or [])
+        self._block = block
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, spec, x, progress_cb=None):
+        self.run_count += 1
+        if self._block is not None:
+            self._block.wait()
+        step = self._script.pop(0) if self._script else {"ok": True}
+        if isinstance(step, Exception):
+            raise step
+        return {"result": step, "shape": [int(v) for v in x.shape]}
+
+
+def _spec(seed=23):
+    spec, x = parse_job_spec(
+        {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0], [3.0, 3.0]],
+         "config": {"k": [2], "iterations": 5, "seed": seed}}
+    )
+    return spec, x
+
+
+def test_full_queue_rejected_with_429_over_http(tmp_path):
+    """Admission control end-to-end: a stalled worker + bounded queue ⇒
+    HTTP 429 for the submission that does not fit."""
+    gate = threading.Event()
+    svc = ConsensusService(
+        store_dir=str(tmp_path / "store"),
+        port=0,
+        max_queue=1,
+        executor=_StubExecutor(block=gate),
+    ).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        # Job A occupies the worker (blocked on the gate) ...
+        a = _job_body(np.random.default_rng(4), n=8, d=2, seed=1)
+        code_a, rec_a, _ = _req(base, "/jobs", a)
+        assert code_a == 202
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if _req(base, f"/jobs/{rec_a['job_id']}")[1]["status"] == "running":
+                break
+            time.sleep(0.02)
+        # ... job B fills the queue's single slot ...
+        b = _job_body(np.random.default_rng(4), n=8, d=2, seed=2)
+        code_b, _, _ = _req(base, "/jobs", b)
+        assert code_b == 202
+        # ... and job C is rejected at admission.
+        c = _job_body(np.random.default_rng(4), n=8, d=2, seed=3)
+        code_c, rec_c, _ = _req(base, "/jobs", c)
+        assert code_c == 429
+        assert "queue full" in rec_c["error"]
+        gate.set()
+    finally:
+        gate.set()
+        svc.stop()
+
+
+def test_retry_with_exponential_backoff(tmp_path):
+    sleeps = []
+    ex = _StubExecutor(
+        script=[RuntimeError("transient 1"), RuntimeError("transient 2"), 42]
+    )
+    sched = Scheduler(
+        ex, JobStore(str(tmp_path)), max_retries=2, backoff_base=0.5,
+        sleep=sleeps.append,
+    )
+    sched.start()
+    try:
+        spec, x = _spec()
+        rec = sched.submit(spec, x)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            cur = sched.get(rec["job_id"])
+            if cur["status"] == "done":
+                break
+            time.sleep(0.02)
+        assert cur["status"] == "done" and cur["attempt"] == 2
+        assert sleeps == [0.5, 1.0]  # backoff_base * 2**attempt
+        assert sched.metrics()["jobs_retried"] == 2
+    finally:
+        sched.stop()
+
+
+def test_retries_exhausted_fails_permanently(tmp_path):
+    ex = _StubExecutor(script=[RuntimeError("down")] * 3)
+    sched = Scheduler(
+        ex, JobStore(str(tmp_path)), max_retries=2, sleep=lambda _s: None
+    )
+    sched.start()
+    try:
+        spec, x = _spec()
+        rec = sched.submit(spec, x)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            cur = sched.get(rec["job_id"])
+            if cur["status"] == "failed":
+                break
+            time.sleep(0.02)
+        assert cur["status"] == "failed" and "down" in cur["error"]
+        assert ex.run_count == 3  # initial + 2 retries
+    finally:
+        sched.stop()
+
+
+def test_bad_spec_failure_is_permanent_no_retry(tmp_path):
+    ex = _StubExecutor(script=[JobSpecError("bad options"), 1, 2])
+    sched = Scheduler(ex, JobStore(str(tmp_path)), max_retries=2)
+    sched.start()
+    try:
+        spec, x = _spec()
+        rec = sched.submit(spec, x)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            cur = sched.get(rec["job_id"])
+            if cur["status"] == "failed":
+                break
+            time.sleep(0.02)
+        assert cur["status"] == "failed"
+        assert ex.run_count == 1  # caller's fault: never retried
+    finally:
+        sched.stop()
+
+
+def test_job_timeout(tmp_path):
+    gate = threading.Event()  # never set: the job hangs
+    ex = _StubExecutor(block=gate)
+    sched = Scheduler(ex, JobStore(str(tmp_path)), job_timeout=0.2)
+    sched.start()
+    try:
+        spec, x = _spec()
+        rec = sched.submit(spec, x)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            cur = sched.get(rec["job_id"])
+            if cur["status"] == "timeout":
+                break
+            time.sleep(0.02)
+        assert cur["status"] == "timeout"
+        assert sched.metrics()["jobs_timed_out"] == 1
+    finally:
+        gate.set()
+        sched.stop()
+
+
+def test_queue_full_direct(tmp_path):
+    gate = threading.Event()
+    ex = _StubExecutor(block=gate)
+    sched = Scheduler(ex, JobStore(str(tmp_path)), max_queue=1)
+    sched.start()
+    try:
+        specs = [_spec(seed=i) for i in range(3)]
+        sched.submit(*specs[0])
+        deadline = time.time() + 10
+        while sched.queue_depth() > 0 and time.time() < deadline:
+            time.sleep(0.02)  # worker picked job 0 up (now blocked)
+        sched.submit(*specs[1])
+        with pytest.raises(QueueFull):
+            sched.submit(*specs[2])
+        gate.set()
+    finally:
+        gate.set()
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Jobstore persistence
+
+
+def test_jobstore_results_survive_restart(tmp_path):
+    store = JobStore(str(tmp_path))
+    spec, x = _spec()
+    fp = store.fingerprint(spec.fingerprint_payload(), x)
+    blob = store.put_result(fp, {"best_k": 2, "pac_area": {"2": 0.01}})
+    # A fresh JobStore over the same directory (process restart) serves
+    # the identical bytes.
+    again = JobStore(str(tmp_path))
+    assert again.get_result_bytes(fp) == blob
+    # First-writer-wins: a second put with different content keeps the
+    # original bytes (dedup correctness > last-writer).
+    assert again.put_result(fp, {"best_k": 3}) == blob
+
+
+def test_jobstore_rejects_traversal_ids(tmp_path):
+    store = JobStore(str(tmp_path))
+    # A crafted id never escapes the store: reads map to "unknown job"
+    # (the ValueError is folded into the 404 path), writes refuse.
+    assert store.load_job("../../etc/passwd") is None
+    with pytest.raises(ValueError):
+        store.save_job({"job_id": "../../etc/passwd"})
+
+
+def test_bucket_ignores_host_side_analysis_fields():
+    """analysis / delta_k_threshold only steer post-sweep selection: jobs
+    differing only there must share one compiled executable (and one
+    --warmup), while still fingerprinting as distinct results."""
+    pac, x = parse_job_spec(
+        {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
+         "config": {"k": [2], "analysis": "PAC"}}
+    )
+    dk, _ = parse_job_spec(
+        {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
+         "config": {"k": [2], "analysis": "delta_k",
+                    "delta_k_threshold": 0.2}}
+    )
+    n, d = x.shape
+    assert pac.bucket(n, d) == dk.bucket(n, d)
+    assert pac.fingerprint_payload() != dk.fingerprint_payload()
+
+
+def test_restart_reconciliation_fails_orphaned_jobs(tmp_path):
+    """A job mirrored as queued/running by a dead process can never
+    finish (its spec/data died with the process): a fresh scheduler over
+    the same store must fail it so pre-restart pollers terminate."""
+    store = JobStore(str(tmp_path))
+    store.save_job({"job_id": "deadjob1", "status": "running"})
+    store.save_job({"job_id": "deadjob2", "status": "queued"})
+    store.save_job({"job_id": "okjob", "status": "done", "result": {}})
+    sched = Scheduler(_StubExecutor(), store)
+    sched.start()
+    try:
+        assert sched.get("deadjob1")["status"] == "failed"
+        assert "restart" in sched.get("deadjob1")["error"]
+        assert sched.get("deadjob2")["status"] == "failed"
+        assert sched.get("okjob")["status"] == "done"  # terminal: untouched
+    finally:
+        sched.stop()
+
+
+def test_fingerprint_sensitivity(tmp_path):
+    store = JobStore(str(tmp_path))
+    spec, x = _spec()
+    fp = store.fingerprint(spec.fingerprint_payload(), x)
+    spec2, x2 = _spec(seed=24)
+    assert store.fingerprint(spec2.fingerprint_payload(), x2) != fp
+    y = x.copy()
+    y[0, 0] += 1.0  # same shape, different bytes
+    assert store.fingerprint(spec.fingerprint_payload(), y) != fp
+
+
+# ---------------------------------------------------------------------------
+# Version tolerance: parallel.sweep must import without jax.shard_map
+
+
+def test_sweep_imports_without_toplevel_shard_map(monkeypatch):
+    """Regression for the seed break: ``from jax import shard_map`` fails
+    on JAX 0.4.x; parallel.sweep must fall back to the experimental home
+    and still expose a working ``shard_map`` symbol."""
+    import jax
+
+    import consensus_clustering_tpu.parallel.sweep as sweep_mod
+
+    monkeypatch.delattr(jax, "shard_map", raising=False)
+    try:
+        reloaded = importlib.reload(sweep_mod)
+        assert callable(reloaded.shard_map)
+    finally:
+        monkeypatch.undo()
+        importlib.reload(sweep_mod)
