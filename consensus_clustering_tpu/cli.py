@@ -7,6 +7,7 @@ one for benchmarking and batch use:
         --iterations 100 --seed 23 --out results.json
     python -m consensus_clustering_tpu bench
     python -m consensus_clustering_tpu serve --port 8000   # docs/SERVING.md
+    python -m consensus_clustering_tpu lint                # docs/LINT.md
 
 Results are written as JSON (PAC / CDF curves and stability statistics);
 matrices stay out of the JSON by design.
@@ -291,13 +292,13 @@ def cmd_bench(args):
     bench.main([])
 
 
-def main(argv=None):
-    from consensus_clustering_tpu.utils.platform import (
-        enable_compilation_cache,
-        pin_platform_from_env,
-    )
+def cmd_lint(args):
+    from consensus_clustering_tpu.lint.runner import run as lint_run
 
-    pin_platform_from_env()
+    raise SystemExit(lint_run(args))
+
+
+def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="consensus_clustering_tpu",
         description="TPU-native consensus clustering",
@@ -393,10 +394,31 @@ def main(argv=None):
                          "e.g. 500,16,2:6,50 (repeatable)")
     serve_p.set_defaults(fn=cmd_serve)
 
+    lint_p = sub.add_parser(
+        "lint",
+        help="run jaxlint, the JAX-aware static analyzer (docs/LINT.md)",
+    )
+    from consensus_clustering_tpu.lint.runner import add_arguments
+
+    add_arguments(lint_p)
+    lint_p.set_defaults(fn=cmd_lint)
+
     args = parser.parse_args(argv)
-    # After parsing: --help / argument errors must not pay the jax
-    # import this call needs (it only has to precede the first compile).
-    enable_compilation_cache()
+    if args.cmd != "lint":
+        # Everything below needs (or will need) jax; the lint subcommand
+        # must stay import-free of it — a pure-AST pass has to run in
+        # milliseconds on CI boxes with no accelerator stack, and must
+        # not hang on a wedged TPU tunnel at device discovery.
+        from consensus_clustering_tpu.utils.platform import (
+            enable_compilation_cache,
+            pin_platform_from_env,
+        )
+
+        pin_platform_from_env()
+        # After parsing: --help / argument errors must not pay the jax
+        # import this call needs (it only has to precede the first
+        # compile).
+        enable_compilation_cache()
     args.fn(args)
 
 

@@ -1,0 +1,40 @@
+"""jaxlint: JAX-aware static analysis for this repo's hazard idioms.
+
+Zero-dependency (stdlib ``ast`` only — importing this package never
+imports jax), rule-registry based, with per-line suppressions and a
+committed baseline.  See docs/LINT.md for the rule catalogue and
+workflow; ``python -m consensus_clustering_tpu lint`` to run.
+
+Public surface:
+
+- :func:`lint_paths` / :func:`lint_file` — programmatic linting
+- :func:`main` — the CLI (also the ``jaxlint`` console script)
+- :class:`Finding`, :class:`Baseline` — the data model
+- :class:`Rule`, :func:`register`, :func:`all_rules` — extension API
+  (later PRs add rules by subclassing Rule in lint/rules.py)
+"""
+
+from consensus_clustering_tpu.lint.findings import Baseline, Finding
+from consensus_clustering_tpu.lint.registry import (
+    ModuleContext,
+    Rule,
+    all_rules,
+    register,
+)
+from consensus_clustering_tpu.lint.runner import (
+    lint_file,
+    lint_paths,
+    main,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "all_rules",
+    "register",
+    "lint_file",
+    "lint_paths",
+    "main",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from consensus_clustering_tpu.lint.runner import main
+
+sys.exit(main())

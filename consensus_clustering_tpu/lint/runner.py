@@ -1,0 +1,227 @@
+"""jaxlint entry point: walk files, run rules, apply suppressions and
+baseline, report, exit.
+
+Invoked as ``python -m consensus_clustering_tpu lint [paths ...]`` (the
+CLI subcommand), ``python -m consensus_clustering_tpu.lint`` or the
+``jaxlint`` console script.  Deliberately zero-dependency — stdlib only,
+no jax import — so it runs anywhere, including CI runners with no
+accelerator stack, in milliseconds.
+
+Exit codes: 0 clean (no new findings), 1 new findings (or unparseable
+files), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from consensus_clustering_tpu.lint.findings import (
+    Baseline,
+    Finding,
+    is_suppressed,
+    suppressions_for_source,
+)
+from consensus_clustering_tpu.lint.registry import ModuleContext, all_rules
+from consensus_clustering_tpu.lint.reporters import (
+    report_json,
+    report_text,
+)
+
+DEFAULT_BASELINE = ".jaxlint-baseline.json"
+
+# Walking a directory skips these wherever they appear: caches, VCS
+# internals, and anything hidden.
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", ".eggs"}
+
+
+def _normalize(path: str) -> str:
+    """Canonical reported path, independent of invocation spelling.
+
+    ``./mod.py``, ``mod.py`` and ``/abs/cwd/mod.py`` must all
+    fingerprint identically or a committed baseline green in CI goes
+    red for anyone spelling the path differently: paths under the cwd
+    become cwd-relative with forward slashes; paths outside stay
+    normpath'd absolute/relative as given.
+    """
+    rel = os.path.relpath(os.path.abspath(path), os.getcwd())
+    out = rel if not rel.startswith("..") else os.path.normpath(path)
+    return out.replace(os.sep, "/")
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield _normalize(path)
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in _SKIP_DIRS and not d.startswith(".")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield _normalize(os.path.join(root, name))
+        else:
+            raise FileNotFoundError(path)
+
+
+def lint_file(
+    path: str, rules=None
+) -> Tuple[List[Finding], List[Finding], Optional[str]]:
+    """Lint one file: returns (active, suppressed, error).
+
+    ``error`` is a human-readable parse failure; an unparseable file
+    yields no findings but must still fail the run (a syntax error in a
+    scanned tree is never 'clean').
+    """
+    if rules is None:
+        rules = all_rules()
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return [], [], f"{path}:{e.lineno}: syntax error: {e.msg}"
+    suppressions = suppressions_for_source(source)
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    seen = set()
+    for rule in rules:
+        for finding in rule.check(ctx):
+            # Nested scopes can re-derive the same finding (e.g. a
+            # timing pair visible from both an outer function and a
+            # closure): report each location once.
+            key = (finding.rule, finding.line, finding.col,
+                   finding.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            if is_suppressed(finding, suppressions):
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+    return active, suppressed, None
+
+
+def lint_paths(
+    paths: Iterable[str], rules=None
+) -> Tuple[List[Finding], List[Finding], List[str], int]:
+    """Lint every .py under ``paths``.
+
+    Returns (active, suppressed, errors, n_files); ``active`` has not
+    yet been partitioned against a baseline.
+    """
+    if rules is None:
+        rules = all_rules()
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    errors: List[str] = []
+    n_files = 0
+    for path in iter_python_files(paths):
+        n_files += 1
+        a, s, err = lint_file(path, rules)
+        active.extend(a)
+        suppressed.extend(s)
+        if err is not None:
+            errors.append(err)
+    return active, suppressed, errors, n_files
+
+
+def add_arguments(parser: argparse.ArgumentParser) -> None:
+    """Shared flag definitions for the CLI subcommand and the console
+    script (one source of truth, cli.py reuses it)."""
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: "
+        "consensus_clustering_tpu tests bench.py benchmarks examples "
+        "scripts, whichever exist)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable JSON report instead of text",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help=f"baseline file of grandfathered findings (default: "
+        f"{DEFAULT_BASELINE}; a missing file is an empty baseline)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline to grandfather every current "
+        "unsuppressed finding, then exit 0",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: every unsuppressed finding is new",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+
+
+def run(args: argparse.Namespace) -> int:
+    rules = all_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.id} {rule.name}: {rule.summary}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        # Everything the repo gates: the suppression comments under
+        # benchmarks/ (and any future hazard there) must be exercised
+        # by the default run, not only by an explicit path list.
+        paths = [
+            p for p in (
+                "consensus_clustering_tpu", "tests", "bench.py",
+                "benchmarks", "examples", "scripts",
+            )
+            if os.path.exists(p)
+        ] or ["."]
+    try:
+        active, suppressed, errors, n_files = lint_paths(paths, rules)
+    except FileNotFoundError as e:
+        print(f"jaxlint: no such path: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        Baseline.from_findings(active).save(args.baseline)
+        print(
+            f"jaxlint: wrote {len(active)} finding(s) to {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.no_baseline:
+        new, grandfathered = active, []
+    else:
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (ValueError, KeyError, TypeError) as e:
+            print(f"jaxlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        new, grandfathered = baseline.partition(active)
+
+    reporter = report_json if args.json else report_text
+    reporter(new, grandfathered, suppressed, errors, n_files, sys.stdout)
+    return 1 if new or errors else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description=(
+            "JAX-aware static analysis: tracer, PRNG and recompile "
+            "hazards, before they hit the TPU (docs/LINT.md)"
+        ),
+    )
+    add_arguments(parser)
+    return run(parser.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

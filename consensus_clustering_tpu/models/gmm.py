@@ -40,7 +40,7 @@ def _masked_log_prob(
     d = x.shape[1]
 
     def per_component(mu, l):
-        diff = (x - mu).T  # (d, n)
+        diff = (x - mu[None, :]).T  # (d, n)
         z = jax.scipy.linalg.solve_triangular(l, diff, lower=True)
         maha = jnp.sum(z * z, axis=0)
         log_det = 2.0 * jnp.sum(jnp.log(jnp.diagonal(l)))

@@ -49,7 +49,7 @@ def _pairwise_sqdist(x: jax.Array, centroids: jax.Array) -> jax.Array:
     x_sq = jnp.sum(x * x, axis=1, keepdims=True)
     c_sq = jnp.sum(centroids * centroids, axis=1)
     cross = jnp.matmul(x, centroids.T, precision=jax.lax.Precision.HIGHEST)
-    return jnp.maximum(x_sq - 2.0 * cross + c_sq, 0.0)
+    return jnp.maximum(x_sq - 2.0 * cross + c_sq[None, :], 0.0)
 
 
 def _kmeanspp_init(
@@ -72,7 +72,7 @@ def _kmeanspp_init(
     key0, key_rest = jax.random.split(key)
     first = jax.random.randint(key0, (), 0, n, dtype=jnp.int32)
     centroids0 = jnp.broadcast_to(x[first], (k_max, x.shape[1]))
-    d2_0 = jnp.sum((x - x[first]) ** 2, axis=1)
+    d2_0 = jnp.sum((x - x[first][None, :]) ** 2, axis=1)
     # Hoisted for the per-step candidate distances: |x - c|^2 as a GEMM
     # (|x|^2 - 2 x.c + |c|^2) keeps the (T, n) distance step on the MXU —
     # the broadcast-subtract form materialises a (T, n, d) intermediate on

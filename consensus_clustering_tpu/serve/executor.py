@@ -254,6 +254,12 @@ class SweepExecutor:
         self.executable_cache_hits = 0
         self._compiled: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        # Serialises build+compile per process, separate from _lock: a
+        # timed-out job's abandoned thread and the next job can reach
+        # _get_compiled concurrently, and holding _lock for a
+        # minutes-long compile would stall the progress _dispatch of
+        # whatever is still running.
+        self._compile_lock = threading.Lock()
         self._job_cb: Optional[Callable[[int, float], None]] = None
         self._seen: set = set()
         # Generation counter for the progress slot: an abandoned
@@ -334,29 +340,43 @@ class SweepExecutor:
         cb(kk, float(pac))
 
     def _get_compiled(self, spec: JobSpec, n: int, d: int):
-        """(compiled, build_compile_seconds, cached) for the bucket."""
+        """(compiled, build_compile_seconds, cached) for the bucket.
+
+        Reachable from two threads at once (a timed-out job's abandoned
+        thread plus the next job's fresh one), so the whole
+        check-build-insert runs under ``_compile_lock``: the loser of
+        the race blocks and then hits the cache instead of paying a
+        duplicate minutes-long compile serialized behind one device.
+        """
         import jax.numpy as jnp
 
         key = spec.bucket(n, d)
-        hit = self._compiled.get(key)
-        if hit is not None:
-            self.executable_cache_hits += 1
-            return hit, 0.0, True
-        from consensus_clustering_tpu.parallel.sweep import build_sweep
+        with self._compile_lock:
+            hit = self._compiled.get(key)
+            if hit is not None:
+                with self._lock:
+                    self.executable_cache_hits += 1
+                return hit, 0.0, True
+            from consensus_clustering_tpu.parallel.sweep import build_sweep
 
-        t0 = time.perf_counter()
-        sweep = build_sweep(
-            self._clusterer_for(spec),
-            self._config_for(spec, n, d),
-            progress_callback=self._dispatch,
-        )
-        xz = jnp.zeros((n, d), jnp.dtype(spec.dtype))
-        import jax
+            t0 = time.perf_counter()
+            sweep = build_sweep(
+                self._clusterer_for(spec),
+                self._config_for(spec, n, d),
+                progress_callback=self._dispatch,
+            )
+            xz = jnp.zeros((n, d), jnp.dtype(spec.dtype))
+            import jax
 
-        compiled = sweep.lower(xz, jax.random.PRNGKey(0)).compile()
-        seconds = time.perf_counter() - t0
-        self._compiled[key] = compiled
-        return compiled, seconds, False
+            compiled = sweep.lower(xz, jax.random.PRNGKey(0)).compile()
+            # This delta times trace+compile, and .compile() blocks on
+            # the host until XLA returns; the only device ops in the
+            # region are the zeros placeholder and the PRNGKey constant,
+            # which lower() consumes synchronously — no async execution
+            # to barrier on.
+            seconds = time.perf_counter() - t0  # jaxlint: disable=JL007
+            self._compiled[key] = compiled
+            return compiled, seconds, False
 
     def warmup(self, spec: JobSpec, n: int, d: int) -> float:
         """Pre-compile the executable for a shape bucket; returns the

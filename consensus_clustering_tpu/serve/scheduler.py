@@ -47,6 +47,11 @@ class QueueFull(Exception):
     """Admission rejected: the job queue is at capacity (HTTP 429)."""
 
 
+# Statuses that never transition again: once mirrored to the jobstore,
+# records in these states are served from disk and evicted from memory.
+_TERMINAL = frozenset({"done", "failed", "timeout"})
+
+
 class JobTimeout(Exception):
     """The executor exceeded the per-job wall-clock budget."""
 
@@ -165,7 +170,9 @@ class Scheduler:
             record["from_cache"] = True
             with self._lock:
                 self.cache_hits += 1
-                self._jobs[job_id] = record
+            # Born terminal: mirrored to the jobstore only — GET serves
+            # it from disk, and _jobs never holds it (see _update's
+            # eviction rationale).
             self.store.save_job(record)
             self.events.emit(
                 "job_submitted", job_id=job_id, fingerprint=fp,
@@ -236,6 +243,14 @@ class Scheduler:
             record.update(fields)
             snapshot = dict(record)
         self.store.save_job(snapshot)
+        if snapshot.get("status") in _TERMINAL:
+            # Terminal records (which embed the full result JSON) are
+            # served from the jobstore from here on; keeping every
+            # finished job in process memory forever would grow RSS
+            # monotonically on a long-lived service.  get() already
+            # falls back to store.load_job, so eviction is invisible.
+            with self._lock:
+                self._jobs.pop(job_id, None)
         return snapshot
 
     def _run_with_timeout(self, spec: JobSpec, x, progress_cb):

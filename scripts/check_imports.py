@@ -27,6 +27,20 @@ import traceback
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# Subpackages the gate must SEE, not merely survive: pkgutil silently
+# yields nothing for a subpackage whose __init__.py went missing or
+# whose directory got renamed, and every one of its modules would then
+# skip the import check while pytest collection (or production import)
+# still dies.  Keep in sync when adding a subpackage.
+EXPECTED_SUBPACKAGES = (
+    "consensus_clustering_tpu.lint",
+    "consensus_clustering_tpu.models",
+    "consensus_clustering_tpu.ops",
+    "consensus_clustering_tpu.parallel",
+    "consensus_clustering_tpu.serve",
+    "consensus_clustering_tpu.utils",
+)
+
 
 def iter_module_names(package_name: str):
     pkg = importlib.import_module(package_name)
@@ -48,13 +62,23 @@ def main() -> int:
             importlib.import_module(name)
         except BaseException:  # noqa: BLE001 — report, keep scanning
             failures.append((name, traceback.format_exc(limit=3)))
-    if failures:
+    missing = [p for p in EXPECTED_SUBPACKAGES if p not in names]
+    if missing:
+        for pkg in missing:
+            print(
+                f"FAIL {pkg}: subpackage not discovered by pkgutil "
+                "(deleted __init__.py / renamed directory?)",
+                file=sys.stderr,
+            )
+    if failures or missing:
         for name, tb in failures:
             last = tb.strip().splitlines()[-1]
             print(f"FAIL {name}: {last}", file=sys.stderr)
             print(tb, file=sys.stderr)
         print(
-            f"{len(failures)} of {len(names)} modules failed to import",
+            f"{len(failures)} of {len(names)} modules failed to import"
+            + (f"; {len(missing)} expected subpackage(s) missing"
+               if missing else ""),
             file=sys.stderr,
         )
         return 1

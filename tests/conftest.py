@@ -23,6 +23,43 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+# -- strict-numerics mode ----------------------------------------------------
+#
+# The runtime counterpart of jaxlint (docs/LINT.md): the fast lane runs
+# with jax_numpy_rank_promotion="raise" so silent cross-rank
+# broadcasting — the shape-bug class that static analysis cannot see —
+# fails loudly at trace time.  jax_debug_nans is opt-in
+# (CCTPU_DEBUG_NANS=1): it re-executes ops for NaN checks, which the
+# 870s tier-1 budget cannot absorb suite-wide, and several numerical
+# paths legitimately produce transient non-finite values.
+#
+#   CCTPU_STRICT=0        disable the whole mode (seed-parity escape hatch)
+#   CCTPU_DEBUG_NANS=1    additionally enable jax_debug_nans
+#   @pytest.mark.relaxed_numerics("why")   per-test opt-out where
+#                                          rank promotion is deliberate
+
+_STRICT = os.environ.get("CCTPU_STRICT", "1") not in ("0", "off", "no")
+_DEBUG_NANS = os.environ.get("CCTPU_DEBUG_NANS", "0") not in (
+    "0", "off", "no", "",
+)
+
+
+@pytest.fixture(autouse=True)
+def _strict_numerics(request):
+    if not _STRICT or request.node.get_closest_marker("relaxed_numerics"):
+        yield
+        return
+    prev_rank = jax.config.jax_numpy_rank_promotion
+    prev_nans = jax.config.jax_debug_nans
+    jax.config.update("jax_numpy_rank_promotion", "raise")
+    if _DEBUG_NANS:
+        jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_numpy_rank_promotion", prev_rank)
+        jax.config.update("jax_debug_nans", prev_nans)
+
 
 @pytest.fixture(scope="session")
 def rng():

@@ -1,0 +1,624 @@
+"""jaxlint: per-rule firing/non-firing fixtures, suppression, baseline,
+reporters and exit codes.
+
+Pure-stdlib tests (no jax import): every fixture is a source *string*
+parsed by the linter, so hazard patterns live here without being hazards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from consensus_clustering_tpu.lint import (
+    Baseline,
+    all_rules,
+    lint_file,
+)
+from consensus_clustering_tpu.lint.runner import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = (
+    "import time\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "from jax.experimental.shard_map import shard_map\n"
+)
+
+
+def lint_source(tmp_path, source, name="snippet.py"):
+    """Write ``source`` (prefixed with the import prelude) and lint it."""
+    path = tmp_path / name
+    path.write_text(_PRELUDE + source)
+    active, suppressed, error = lint_file(str(path))
+    assert error is None, error
+    return active, suppressed
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# one firing and one non-firing fixture per rule
+
+CASES = {
+    "JL001": {
+        "fires": """
+def draw(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))
+    return a + b
+""",
+        "clean": """
+def draw(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (3,))
+    b = jax.random.uniform(k2, (3,))
+    return a + b
+
+
+def streams(key):
+    # fold_in derives an independent stream per datum: reuse is the idiom
+    a = jax.random.normal(jax.random.fold_in(key, 0), (3,))
+    b = jax.random.normal(jax.random.fold_in(key, 1), (3,))
+    return a + b
+
+
+def loop(key):
+    total = 0.0
+    for i in range(4):
+        key, sub = jax.random.split(key)
+        total = total + jax.random.normal(sub, ())
+    return total
+""",
+    },
+    "JL002": {
+        "fires": """
+@jax.jit
+def f(x):
+    print("x is", x)
+    return x * 2
+""",
+        "clean": """
+@jax.jit
+def f(x):
+    jax.debug.print("x is {}", x)
+    return x * 2
+
+
+def host_f(x):
+    print("host code may print", x)
+    return x
+""",
+    },
+    "JL003": {
+        "fires": """
+@jax.jit
+def f(x):
+    return float(x.sum())
+""",
+        "clean": """
+@jax.jit
+def f(x):
+    return x.sum()
+
+
+def host_f(x):
+    return float(x.sum())
+""",
+    },
+    "JL004": {
+        "fires": """
+def g(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v + 1)
+        out.append(f(x))
+    return out
+""",
+        "clean": """
+def _step(v):
+    return v + 1
+
+
+_step_jit = jax.jit(_step)
+
+
+def g(xs):
+    return [_step_jit(x) for x in xs]
+""",
+    },
+    "JL005": {
+        "fires": """
+@jax.jit
+def f(x):
+    if x.sum() > 0:
+        return x
+    return -x
+""",
+        "clean": """
+@jax.jit
+def f(x, scale=None):
+    if scale is None:
+        scale = 1.0
+    return jnp.where(x.sum() > 0, x, -x) * scale
+""",
+    },
+    "JL006": {
+        "fires": """
+f = jax.jit(lambda v, k: v * k, static_argnums=(1.5,))
+""",
+        "clean": """
+def _mul(v, k):
+    return v * k
+
+
+f = jax.jit(_mul, static_argnums=(1,))
+g = jax.jit(_mul, static_argnames=("k",))
+""",
+    },
+    "JL007": {
+        "fires": """
+def timed(x):
+    t0 = time.perf_counter()
+    y = jnp.dot(x, x)
+    t1 = time.perf_counter()
+    return y, t1 - t0
+""",
+        "clean": """
+def timed(x):
+    t0 = time.perf_counter()
+    y = jax.block_until_ready(jnp.dot(x, x))
+    t1 = time.perf_counter()
+    return y, t1 - t0
+
+
+def timed_host_copy(x):
+    t0 = time.perf_counter()
+    y = np.asarray(jnp.dot(x, x))
+    t1 = time.perf_counter()
+    return y, t1 - t0
+""",
+    },
+    "JL008": {
+        # The PR-1 GSPMD miscompile trigger: a mesh axis ('k') that no
+        # spec or collective mentions.
+        "fires": """
+def body(x):
+    return jax.lax.psum(x, "h")
+
+
+def run(x):
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("h", "k"))
+    return shard_map(body, mesh=mesh, in_specs=P("h"), out_specs=P("h"))(x)
+""",
+        "clean": """
+def body(x):
+    return jax.lax.psum(x, "h")
+
+
+def run(x):
+    mesh = Mesh(np.array(jax.devices()), ("h",))
+    return shard_map(body, mesh=mesh, in_specs=P("h"), out_specs=P("h"))(x)
+""",
+    },
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_rule_fires(tmp_path, rule_id):
+    active, _ = lint_source(tmp_path, CASES[rule_id]["fires"])
+    assert rule_id in rule_ids(active), (
+        f"{rule_id} did not fire; got {sorted(rule_ids(active))}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_rule_does_not_fire(tmp_path, rule_id):
+    active, _ = lint_source(tmp_path, CASES[rule_id]["clean"])
+    assert rule_id not in rule_ids(active), [
+        (f.rule, f.line, f.message)
+        for f in active if f.rule == rule_id
+    ]
+
+
+def test_all_eight_rules_registered():
+    ids = sorted(r.id for r in all_rules())
+    assert ids == [f"JL{i:03d}" for i in range(1, 9)]
+
+
+def test_finding_names_file_line_and_rule(tmp_path):
+    active, _ = lint_source(tmp_path, CASES["JL001"]["fires"])
+    f = next(f for f in active if f.rule == "JL001")
+    assert f.path.endswith("snippet.py")
+    # The second consumption (the uniform call) is the flagged line.
+    assert "jax.random.uniform" in f.text
+    assert f.line > 0
+
+
+def test_axis_not_in_mesh_fires(tmp_path):
+    active, _ = lint_source(tmp_path, """
+def body(x):
+    return jax.lax.psum(x, "n")
+
+
+def run(x):
+    mesh = Mesh(np.array(jax.devices()), ("h",))
+    return shard_map(body, mesh=mesh, in_specs=P("h"), out_specs=P("h"))(x)
+""")
+    assert any(
+        f.rule == "JL008" and "'n'" in f.message for f in active
+    )
+
+
+def test_split_loop_target_is_not_reuse(tmp_path):
+    # `for key in split(master, n)` binds a DISTINCT key per iteration:
+    # the canonical correct idiom must not read as reuse.
+    active, _ = lint_source(tmp_path, """
+def draw(master_key):
+    out = []
+    for key in jax.random.split(master_key, 4):
+        out.append(jax.random.normal(key, ()))
+    return out
+""")
+    assert "JL001" not in rule_ids(active)
+
+
+def test_loop_carried_key_reuse_fires(tmp_path):
+    # The same key consumed on every iteration IS reuse.
+    active, _ = lint_source(tmp_path, """
+def draw(key):
+    total = 0.0
+    for i in range(4):
+        total = total + jax.random.normal(key, ())
+    return total
+""")
+    assert "JL001" in rule_ids(active)
+
+
+def test_module_level_jit_lambda_is_fine(tmp_path):
+    # Evaluated once at import; its cache persists — not retrace-per-call.
+    active, _ = lint_source(tmp_path, """
+square = jax.jit(lambda v: v * v)
+
+
+def use(xs):
+    return [square(x) for x in xs]
+""")
+    assert "JL004" not in rule_ids(active)
+
+
+def test_same_line_reuse_is_not_called_a_loop(tmp_path):
+    active, _ = lint_source(tmp_path, """
+def f(key):
+    return jax.random.normal(key, (2,)) + jax.random.uniform(key, (2,))
+""")
+    jl1 = [f for f in active if f.rule == "JL001"]
+    assert jl1 and "loop" not in jl1[0].message
+
+
+def test_shard_map_axes_resolve_module_constants(tmp_path):
+    # PR 1's actual miscompile site spells every axis as a module
+    # constant (KSHARD_AXIS = "k"), not a literal: the rule must see
+    # through that or it skips the one file it exists for.
+    active, _ = lint_source(tmp_path, """
+KSHARD_AXIS = "k"
+RESAMPLE_AXIS = "h"
+
+
+def body(x):
+    return jax.lax.psum(x, RESAMPLE_AXIS)
+
+
+def run(x):
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1),
+                (RESAMPLE_AXIS, KSHARD_AXIS))
+    return shard_map(body, mesh=mesh, in_specs=P(RESAMPLE_AXIS),
+                     out_specs=P(RESAMPLE_AXIS))(x)
+""")
+    assert any(
+        f.rule == "JL008" and "'k'" in f.message for f in active
+    )
+
+
+def test_shard_map_ambiguous_mesh_name_is_skipped(tmp_path):
+    # Two scopes binding the same name to different meshes: verifying
+    # against either binding could be wrong, so the rule must skip.
+    active, _ = lint_source(tmp_path, """
+def body(x):
+    return jax.lax.psum(x, "h")
+
+
+def one(x):
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("h", "k"))
+    return shard_map(body, mesh=mesh, in_specs=P("h"),
+                     out_specs=P("h"))(x)
+
+
+def two(x):
+    mesh = Mesh(np.array(jax.devices()), ("h",))
+    return shard_map(body, mesh=mesh, in_specs=P("h"),
+                     out_specs=P("h"))(x)
+""")
+    assert "JL008" not in rule_ids(active)
+
+
+def test_static_params_are_not_tracers(tmp_path):
+    # A param named in static_argnames is a Python value inside the
+    # trace: branching on it is legitimate (the pallas_hist.py pattern).
+    active, _ = lint_source(tmp_path, """
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("bins",))
+def f(x, bins):
+    if bins > 128:
+        raise ValueError(bins)
+    return x * bins
+""")
+    assert "JL005" not in rule_ids(active)
+
+
+def test_host_callback_functions_are_exempt(tmp_path):
+    # Functions handed to jax.debug.callback run on the host: side
+    # effects inside them are the point, not a hazard.
+    active, _ = lint_source(tmp_path, """
+def report(k):
+    print("done", k)
+
+
+@jax.jit
+def f(x):
+    jax.debug.callback(report, x.shape[0])
+    return x * 2
+""")
+    assert "JL002" not in rule_ids(active)
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+def test_per_line_suppression(tmp_path):
+    src = CASES["JL001"]["fires"].replace(
+        "b = jax.random.uniform(key, (3,))",
+        "b = jax.random.uniform(key, (3,))  "
+        "# jaxlint: disable=JL001 -- intentional reuse",
+    )
+    active, suppressed = lint_source(tmp_path, src)
+    assert "JL001" not in rule_ids(active)
+    assert "JL001" in rule_ids(suppressed)
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    # Suppressing a different rule on the line does not silence JL001.
+    src = CASES["JL001"]["fires"].replace(
+        "b = jax.random.uniform(key, (3,))",
+        "b = jax.random.uniform(key, (3,))  # jaxlint: disable=JL007",
+    )
+    active, _ = lint_source(tmp_path, src)
+    assert "JL001" in rule_ids(active)
+
+
+def test_suppress_all(tmp_path):
+    src = CASES["JL001"]["fires"].replace(
+        "b = jax.random.uniform(key, (3,))",
+        "b = jax.random.uniform(key, (3,))  # jaxlint: disable=all",
+    )
+    active, suppressed = lint_source(tmp_path, src)
+    assert "JL001" not in rule_ids(active)
+    assert "JL001" in rule_ids(suppressed)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+def test_baseline_round_trip(tmp_path):
+    active, _ = lint_source(tmp_path, CASES["JL001"]["fires"])
+    baseline_path = tmp_path / "baseline.json"
+    Baseline.from_findings(active).save(str(baseline_path))
+    loaded = Baseline.load(str(baseline_path))
+    new, grandfathered = loaded.partition(active)
+    assert new == []
+    assert len(grandfathered) == len(active)
+
+
+def test_baseline_multiset_semantics(tmp_path):
+    # One baselined occurrence grandfathers exactly one finding: a
+    # second identical hazard is NEW and must fail the run.
+    active, _ = lint_source(tmp_path, CASES["JL001"]["fires"])
+    jl1 = [f for f in active if f.rule == "JL001"]
+    baseline = Baseline.from_findings(jl1)
+    doubled = jl1 + jl1
+    new, grandfathered = baseline.partition(doubled)
+    assert len(grandfathered) == len(jl1)
+    assert len(new) == len(jl1)
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    loaded = Baseline.load(str(tmp_path / "nope.json"))
+    assert loaded.entries == []
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    # Fingerprints use line *text*, not numbers: inserting code above a
+    # grandfathered finding must not invalidate it.
+    active, _ = lint_source(tmp_path, CASES["JL001"]["fires"])
+    baseline = Baseline.from_findings(active)
+    shifted, _ = lint_source(
+        tmp_path, "\n\nPAD = 1\n\n" + CASES["JL001"]["fires"],
+        name="shifted.py",
+    )
+    # Re-key the path: same file identity in a real run.
+    from consensus_clustering_tpu.lint import Finding
+
+    rekeyed = [
+        Finding(f.rule, active[0].path, f.line, f.col, f.message, f.text)
+        for f in shifted
+    ]
+    new, grandfathered = baseline.partition(rekeyed)
+    assert new == []
+    assert len(grandfathered) == len(active)
+
+
+# ---------------------------------------------------------------------------
+# runner: exit codes, reporters, CLI
+
+def _write_bad(tmp_path, name="bad.py"):
+    path = tmp_path / name
+    path.write_text(_PRELUDE + CASES["JL001"]["fires"])
+    return path
+
+
+def _write_clean(tmp_path, name="clean.py"):
+    path = tmp_path / name
+    path.write_text(_PRELUDE + CASES["JL001"]["clean"])
+    return path
+
+
+def test_exit_zero_on_clean_tree(tmp_path, capsys):
+    path = _write_clean(tmp_path)
+    rc = lint_main([str(path), "--baseline", str(tmp_path / "b.json")])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_exit_nonzero_on_new_finding(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    rc = lint_main([str(path), "--baseline", str(tmp_path / "b.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "JL001" in out and "bad.py" in out
+
+
+def test_exit_two_on_missing_path(tmp_path, capsys):
+    rc = lint_main([str(tmp_path / "missing.py")])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_syntax_error_fails_the_run(tmp_path, capsys):
+    path = tmp_path / "broken.py"
+    path.write_text("def f(:\n")
+    rc = lint_main([str(path), "--baseline", str(tmp_path / "b.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "syntax error" in out
+
+
+def test_write_baseline_then_clean_exit(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    baseline = str(tmp_path / "b.json")
+    assert lint_main(
+        [str(path), "--baseline", baseline, "--write-baseline"]
+    ) == 0
+    capsys.readouterr()
+    # Grandfathered: the same finding no longer fails the run ...
+    assert lint_main([str(path), "--baseline", baseline]) == 0
+    capsys.readouterr()
+    # ... but --no-baseline still shows the truth.
+    assert lint_main(
+        [str(path), "--baseline", baseline, "--no-baseline"]
+    ) == 1
+    capsys.readouterr()
+
+
+def test_baseline_is_invocation_spelling_independent(tmp_path, capsys, monkeypatch):
+    # `jaxlint mod.py`, `jaxlint ./mod.py` and `jaxlint /abs/mod.py`
+    # must fingerprint identically or a committed baseline goes red for
+    # anyone spelling the path differently.
+    monkeypatch.chdir(tmp_path)
+    _write_bad(tmp_path)
+    baseline = str(tmp_path / "b.json")
+    assert lint_main(["bad.py", "--baseline", baseline,
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    for spelling in ("bad.py", "./bad.py", str(tmp_path / "bad.py")):
+        assert lint_main([spelling, "--baseline", baseline]) == 0, spelling
+        capsys.readouterr()
+
+
+def test_json_reporter_schema(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    rc = lint_main(
+        [str(path), "--json", "--baseline", str(tmp_path / "b.json")]
+    )
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["version"] == 1
+    assert set(payload["summary"]) == {
+        "new", "baseline", "suppressed", "files", "errors",
+    }
+    assert payload["summary"]["new"] >= 1
+    assert payload["summary"]["files"] == 1
+    for entry in payload["findings"]:
+        assert set(entry) == {
+            "rule", "path", "line", "col", "message", "text", "status",
+        }
+        assert entry["status"] in ("new", "baseline", "suppressed")
+    statuses = [e["status"] for e in payload["findings"]]
+    assert "new" in statuses
+
+
+def test_json_statuses_cover_baseline_and_suppressed(tmp_path, capsys):
+    src = _PRELUDE + CASES["JL001"]["fires"] + (
+        "\n\ndef more(key):\n"
+        "    a = jax.random.normal(key, (3,))\n"
+        "    b = jax.random.uniform(key, (3,))"
+        "  # jaxlint: disable=JL001\n"
+        "    return a + b\n"
+    )
+    path = tmp_path / "mix.py"
+    path.write_text(src)
+    baseline = str(tmp_path / "b.json")
+    lint_main([str(path), "--baseline", baseline, "--write-baseline"])
+    capsys.readouterr()
+    rc = lint_main([str(path), "--json", "--baseline", baseline])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    statuses = {e["status"] for e in payload["findings"]}
+    assert statuses == {"baseline", "suppressed"}
+
+
+def test_cli_subcommand_end_to_end(tmp_path):
+    # `python -m consensus_clustering_tpu lint` must work without jax
+    # ever importing (it has to run on accelerator-less CI runners and
+    # must not hang on a wedged TPU tunnel at device discovery).
+    path = _write_bad(tmp_path)
+    proc = subprocess.run(
+        [
+            sys.executable, "-X", "importtime", "-m",
+            "consensus_clustering_tpu", "lint", str(path),
+            "--baseline", str(tmp_path / "b.json"),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stderr
+    assert "JL001" in proc.stdout
+    imported = {
+        line.split("|")[-1].strip()
+        for line in proc.stderr.splitlines()
+        if line.startswith("import time:")
+    }
+    assert "jax" not in imported, "lint subcommand imported jax"
+
+
+def test_repo_tree_is_lint_clean():
+    # The acceptance gate: the committed tree (package, tests, bench.py)
+    # has zero new findings against the committed baseline.
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "consensus_clustering_tpu", "lint",
+            "consensus_clustering_tpu", "tests", "bench.py",
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
