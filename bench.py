@@ -475,6 +475,25 @@ def main(argv=None):
         "--profile-dir", default=None,
         help="capture a jax.profiler trace of the first execution here",
     )
+    parser.add_argument(
+        "--stream", type=int, default=0, metavar="H_BLOCK",
+        help="run the streaming H-block engine with this block size "
+        "(0 = the monolithic single-program sweep); the record gains "
+        "h_effective and the per-block PAC trajectory",
+    )
+    parser.add_argument(
+        "--adaptive-tol", type=float, default=None,
+        help="with --stream: early-stop tolerance on the per-block PAC "
+        "trajectory (resamples actually run land in h_effective)",
+    )
+    parser.add_argument(
+        "--adaptive-patience", type=int, default=2,
+        help="consecutive quiet blocks before an adaptive stop",
+    )
+    parser.add_argument(
+        "--adaptive-min-h", type=int, default=0,
+        help="resample floor before an adaptive stop may trigger",
+    )
     args = parser.parse_args(argv)
 
     from consensus_clustering_tpu.utils.platform import (
@@ -516,16 +535,42 @@ def main(argv=None):
     ready.set()
     small = args.small or backend == "cpu"
 
-    from consensus_clustering_tpu.parallel.sweep import run_sweep
-
     clusterer, config, x, metric, baseline_key = _build(args.config, small)
     repeats = 1 if backend == "cpu" else max(1, args.repeats)
-    out = run_sweep(
-        clusterer, config, x, seed=SEED,
-        profile_dir=args.profile_dir, repeats=repeats,
-    )
+    if args.stream:
+        import dataclasses
 
-    total_resamples = config.n_iterations * len(config.k_values)
+        from consensus_clustering_tpu.parallel.streaming import (
+            run_streaming_sweep,
+        )
+
+        config = dataclasses.replace(
+            config, stream_h_block=args.stream,
+            adaptive_tol=args.adaptive_tol,
+            adaptive_patience=args.adaptive_patience,
+            adaptive_min_h=args.adaptive_min_h,
+        )
+        mode = ("adaptive" if args.adaptive_tol is not None
+                else "full-H")
+        metric += f" [streamed h_block={args.stream} {mode}]"
+        out = run_streaming_sweep(
+            clusterer, config, x, seed=SEED, repeats=repeats,
+            profile_dir=args.profile_dir,
+        )
+        # The rate divides by resamples actually RUN (h_effective), so
+        # an adaptive record's r/s stays a true throughput, not a
+        # budget-skipped inflation.
+        total_resamples = (
+            out["streaming"]["h_effective"] * len(config.k_values)
+        )
+    else:
+        from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+        out = run_sweep(
+            clusterer, config, x, seed=SEED,
+            profile_dir=args.profile_dir, repeats=repeats,
+        )
+        total_resamples = config.n_iterations * len(config.k_values)
     rate = out["timing"]["resamples_per_second"]
     wall = out["timing"]["run_seconds"]
 
@@ -574,6 +619,16 @@ def main(argv=None):
         "pac_all": [round(float(p), 5) for p in out["pac_area"]],
         "k_values": [int(k) for k in config.k_values],
     }
+    if args.stream:
+        s = out["streaming"]
+        record["stream_h_block"] = s["h_block"]
+        record["h_effective"] = s["h_effective"]
+        record["h_requested"] = s["h_requested"]
+        record["stopped_early"] = s["stopped_early"]
+        record["pac_trajectory"] = [
+            [round(float(p), 5) for p in row]
+            for row in s["pac_trajectory"]
+        ]
     peak = out["timing"].get("device_memory", {}).get("peak_bytes_in_use")
     if peak:
         record["peak_device_bytes"] = peak
@@ -595,12 +650,14 @@ def main(argv=None):
             )
             record["last_onchip"] = dict(preserved, provenance=provenance)
     elif (backend != "cpu" and not small
-            and args.profile_dir is None):
-        # Full-shape, unprofiled accelerator runs only: a --small smoke
-        # run or a profiler-instrumented run (trace capture is a ~5x
-        # slowdown through the tunnel) would otherwise become the
-        # "newest" record for its config and shadow the real
-        # measurement in a later fallback payload.
+            and args.profile_dir is None and not args.stream):
+        # Full-shape, unprofiled, MONOLITHIC accelerator runs only: a
+        # --small smoke run, a profiler-instrumented run (trace capture
+        # is a ~5x slowdown through the tunnel) or a streamed A/B run
+        # (per-block overhead / adaptive h_effective change the rate
+        # basis) would otherwise become the "newest" record for its
+        # config and shadow the real measurement in a later fallback
+        # payload.
         _append_onchip_record(record, args.config)
     done.set()
     print(json.dumps(record))

@@ -1,0 +1,154 @@
+"""A/B the streaming H-block engine against the monolithic sweep.
+
+Reproduces the numbers in benchmarks/PERF.md ("Streaming H-block
+engine"): on the current backend it measures
+
+1. **blocked-vs-monolithic overhead** at full H — same config, same
+   seed, one monolithic program vs the streamed driver at several block
+   sizes.  The streamed result is asserted bit-identical before any
+   timing is reported (a wrong answer's speed is not a measurement);
+   per-block cost is dominated by the extra per-K consensus-histogram
+   pass each block pays (the monolithic sweep pays it once).
+2. **adaptive early stop** on a stable synthetic config (well-separated
+   blobs: PAC flat from the first blocks) — ``h_effective`` vs the H
+   budget, and the max |ΔPAC| of the early answer vs the full-H answer
+   (must be <= the tolerance, the acceptance bar).
+
+Run:  python benchmarks/stream_ab.py [--n 800] [--h 200] [--repeats 3]
+Emits one JSON document on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n", type=int, default=800)
+    parser.add_argument("--d", type=int, default=16)
+    parser.add_argument("--h", type=int, default=200)
+    parser.add_argument("--k-hi", type=int, default=6)
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument(
+        "--blocks", default="25,50,100",
+        help="comma list of stream_h_block sizes to A/B",
+    )
+    args = parser.parse_args(argv)
+
+    from consensus_clustering_tpu.utils.platform import (
+        enable_compilation_cache,
+        pin_platform_from_env,
+    )
+
+    pin_platform_from_env()
+    enable_compilation_cache()
+
+    import jax
+    from sklearn.datasets import make_blobs
+
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.streaming import (
+        run_streaming_sweep,
+    )
+    from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+    x, _ = make_blobs(
+        n_samples=args.n, n_features=args.d, centers=8, cluster_std=3.0,
+        random_state=0,
+    )
+    x = x.astype(np.float32)
+    config = SweepConfig(
+        n_samples=args.n, n_features=args.d,
+        k_values=tuple(range(2, args.k_hi + 1)),
+        n_iterations=args.h, store_matrices=False,
+    )
+    seed = 23
+    result = {
+        "backend": jax.default_backend(),
+        "shape": [args.n, args.d],
+        "h": args.h,
+        "k_values": list(config.k_values),
+        "repeats": args.repeats,
+    }
+
+    mono = run_sweep(
+        KMeans(n_init=3), config, x, seed=seed, repeats=args.repeats
+    )
+    mono_wall = mono["timing"]["run_seconds"]
+    result["monolithic"] = {
+        "run_seconds": round(mono_wall, 4),
+        "compile_seconds": round(mono["timing"]["compile_seconds"], 2),
+    }
+
+    result["streamed"] = []
+    for block in (int(b) for b in args.blocks.split(",")):
+        out = run_streaming_sweep(
+            KMeans(n_init=3),
+            dataclasses.replace(config, stream_h_block=block),
+            x, seed=seed, repeats=args.repeats,
+        )
+        np.testing.assert_array_equal(mono["pac_area"], out["pac_area"])
+        np.testing.assert_array_equal(mono["cdf"], out["cdf"])
+        wall = out["timing"]["run_seconds"]
+        result["streamed"].append({
+            "h_block": block,
+            "n_blocks": out["streaming"]["n_blocks_run"],
+            "run_seconds": round(wall, 4),
+            "warmup_seconds": round(
+                out["timing"]["compile_seconds"], 2
+            ),
+            "overhead_vs_monolithic": round(wall / mono_wall - 1.0, 3),
+            "bit_identical": True,  # asserted above
+        })
+
+    # Adaptive: a stable two-cluster input where PAC flattens early.
+    rng = np.random.default_rng(1)
+    half = args.n // 2
+    stable = np.concatenate([
+        rng.normal(0.0, 0.3, (half, args.d)),
+        rng.normal(8.0, 0.3, (args.n - half, args.d)),
+    ]).astype(np.float32)
+    stable_config = dataclasses.replace(config, k_values=(2, 3, 4))
+    full = run_sweep(
+        KMeans(n_init=3), stable_config, stable, seed=seed,
+        repeats=args.repeats,
+    )
+    tol = 0.01
+    adaptive = run_streaming_sweep(
+        KMeans(n_init=3),
+        dataclasses.replace(
+            stable_config, stream_h_block=25, adaptive_tol=tol,
+            adaptive_patience=2, adaptive_min_h=50,
+        ),
+        stable, seed=seed, repeats=args.repeats,
+    )
+    s = adaptive["streaming"]
+    delta = float(np.max(np.abs(
+        np.asarray(adaptive["pac_area"]) - full["pac_area"]
+    )))
+    result["adaptive"] = {
+        "tol": tol,
+        "h_budget": args.h,
+        "h_effective": s["h_effective"],
+        "stopped_early": s["stopped_early"],
+        "max_pac_delta_vs_full_h": round(delta, 6),
+        "within_tol": delta <= tol,
+        "run_seconds": round(adaptive["timing"]["run_seconds"], 4),
+        "full_h_run_seconds": round(full["timing"]["run_seconds"], 4),
+    }
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -183,6 +183,24 @@ class ConsensusClustering:
         mode for ill-conditioned problems (e.g. full-covariance GMM when
         the subsample size is below the feature count) — see
         ``SweepConfig.dtype``.
+    stream_h_block : int, keyword-only, optional
+        Run the device sweep as a STREAM of compiled H-blocks of this
+        many resamples, with the per-K accumulators held device-resident
+        between blocks (donated argnums) — bit-identical to the
+        monolithic program at full H, H-agnostic executable, and the
+        prerequisite for adaptive early stopping.  None (default) keeps
+        the single-program sweep.  See ``SweepConfig.stream_h_block``;
+        ignored (with a log message) for host-backend clusterers.
+    adaptive_tol : float, keyword-only, optional
+        With ``stream_h_block``: stop the stream early once every K's
+        PAC moved less than this for ``adaptive_patience`` consecutive
+        blocks (after ``adaptive_min_h`` resamples).  ``metrics_`` then
+        carries ``h_effective`` and the per-block PAC trajectory.
+        Requires matrices off — ``store_matrices='auto'`` resolves to
+        False when this is set; an explicit True raises.
+    adaptive_patience, adaptive_min_h : keyword-only
+        Early-stop patience (consecutive quiet blocks, default 2) and
+        resample floor (default 0) — see ``SweepConfig``.
 
     Attributes
     ----------
@@ -233,6 +251,10 @@ class ConsensusClustering:
         k_batch_size: Optional[int] = None,
         compute_dtype: str = "float32",
         delta_k_threshold: float = _DELTA_K_THRESHOLD,
+        stream_h_block: Optional[int] = None,
+        adaptive_tol: Optional[float] = None,
+        adaptive_patience: int = 2,
+        adaptive_min_h: int = 0,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -300,6 +322,13 @@ class ConsensusClustering:
         # Validated by SweepConfig; "float64" needs JAX_ENABLE_X64 + CPU
         # backend (see SweepConfig.dtype for when that is worth it).
         self.compute_dtype = compute_dtype
+        # Streaming knobs validated by SweepConfig at fit time (the
+        # adaptive/store_matrices interaction needs the resolved
+        # store_matrices, which depends on N).
+        self.stream_h_block = stream_h_block
+        self.adaptive_tol = adaptive_tol
+        self.adaptive_patience = adaptive_patience
+        self.adaptive_min_h = adaptive_min_h
 
     # -- clusterer resolution -------------------------------------------
 
@@ -363,6 +392,12 @@ class ConsensusClustering:
 
     def _resolve_store_matrices(self, n: int) -> bool:
         if self.store_matrices == "auto":
+            if self.adaptive_tol is not None:
+                # Adaptive streaming is curves-only by construction (an
+                # early-stopped run's accumulators can trail h_effective
+                # by the one in-flight block); an EXPLICIT True still
+                # reaches SweepConfig's ValueError.
+                return False
             n_k = len(tuple(self.K_range))
             # stacked mij (int32) + cij (f32) on host
             approx_bytes = 2 * n_k * n * n * 4
@@ -405,6 +440,10 @@ class ConsensusClustering:
             split_init=self.split_init,
             k_interleave=self.k_interleave,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
+            stream_h_block=self.stream_h_block,
+            adaptive_tol=self.adaptive_tol,
+            adaptive_patience=self.adaptive_patience,
+            adaptive_min_h=self.adaptive_min_h,
             use_pallas=self.use_pallas,
             dtype=self.compute_dtype,
         )
@@ -432,8 +471,16 @@ class ConsensusClustering:
         entries: Dict[int, dict] = {}
         timings = []
         shared_iij = None
+        streaming_infos = []
         if missing:
             clusterer, is_host = self._resolve_clusterer()
+            if is_host and self.stream_h_block is not None:
+                logger.info(
+                    "stream_h_block is a device-path feature; the host "
+                    "backend labels resamples in a Python loop and has "
+                    "no compiled block to stream — running the host "
+                    "sweep normally"
+                )
             if is_host and self.progress_callback is not None:
                 logger.warning(
                     "progress_callback is a device-path feature and this "
@@ -457,6 +504,30 @@ class ConsensusClustering:
                         clusterer, run_config, X, self.random_state,
                         progress=self.progress, n_jobs=self.n_jobs,
                     )
+                elif run_config.stream_h_block is not None:
+                    from consensus_clustering_tpu.parallel.streaming import (
+                        run_streaming_sweep,
+                    )
+
+                    def block_cb(block, h_done, pac):
+                        metrics_logger.emit(
+                            "h_block_complete",
+                            block=block, h_done=h_done, pac_area=pac,
+                        )
+
+                    out = run_streaming_sweep(
+                        clusterer, run_config, X, self.random_state,
+                        mesh=self.mesh, block_callback=block_cb,
+                        profile_dir=self.profile_dir,
+                    )
+                    if self.progress_callback is not None:
+                        # The streaming driver has the final curves on
+                        # the host — the per-K signal needs no staged
+                        # debug callback; same once-per-K contract.
+                        for i, k in enumerate(chunk):
+                            self.progress_callback(
+                                int(k), float(out["pac_area"][i])
+                            )
                 else:
                     from consensus_clustering_tpu.parallel.sweep import (
                         run_sweep,
@@ -480,6 +551,8 @@ class ConsensusClustering:
                         ckpt.save_k(k, chunk_entries[k])
                 entries.update(chunk_entries)
                 timings.append(out["timing"])
+                if "streaming" in out:
+                    streaming_infos.append(out["streaming"])
                 # Signs of life on the device path: the compiled sweep
                 # is silent from dispatch to completion (the reference
                 # shows per-K tqdm, :115-116), so ``k_batch_size`` is
@@ -497,6 +570,13 @@ class ConsensusClustering:
                 )
 
         self._build_results(entries, config, loaded, timings)
+        if streaming_infos:
+            # Last batch's streaming stats headline metrics_ (single
+            # program for most fits); k-batched streams keep every
+            # batch's section so per-batch h_effective stays auditable.
+            self.metrics_["streaming"] = streaming_infos[-1]
+            if len(streaming_infos) > 1:
+                self.metrics_["streaming_batches"] = streaming_infos
 
         metrics_logger.emit(
             "sweep_complete",

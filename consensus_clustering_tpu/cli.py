@@ -125,6 +125,18 @@ def cmd_run(args):
             print(f"K={k} done ({done_count[0]}{total}), pac={pac:.5f}",
                   file=sys.stderr, flush=True)
 
+    if args.adaptive is not None and not args.stream:
+        raise SystemExit(
+            "--adaptive needs --stream: early stopping is a property of "
+            "the streaming driver loop (per-block PAC deltas)"
+        )
+    if args.adaptive is not None and store_matrices:
+        raise SystemExit(
+            "--adaptive is curves-only (an early-stopped run's matrices "
+            "would disagree with its h_effective); drop --plot-dir / "
+            "--store-matrices on, or run without --adaptive"
+        )
+
     cc = ConsensusClustering(
         clusterer=_make_clusterer(args.clusterer),
         clusterer_options={} if args.clusterer != "kmeans" else {"n_init": 3},
@@ -146,6 +158,10 @@ def cmd_run(args):
         k_batch_size=args.k_batch_size,
         compute_dtype=args.compute_dtype,
         progress_callback=progress_cb,
+        stream_h_block=args.stream or None,
+        adaptive_tol=args.adaptive,
+        adaptive_patience=args.adaptive_patience,
+        adaptive_min_h=args.adaptive_min_h,
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -239,7 +255,7 @@ def cmd_serve(args):
     )
 
     logging.basicConfig(level=logging.INFO)
-    executor = SweepExecutor()
+    executor = SweepExecutor(default_h_block=args.stream_block)
     service = ConsensusService(
         store_dir=args.store_dir,
         host=args.host,
@@ -266,9 +282,12 @@ def cmd_serve(args):
                 "(e.g. 500,16,2:6,50)"
             )
         secs = executor.warmup(spec, n, d)
+        # The streamed block program is H-agnostic, so one warmup covers
+        # every iterations value at this shape (the H in the spec string
+        # is accepted for compatibility but does not split the bucket).
         print(
             f"warmed bucket n={n} d={d} k={spec.k_values} "
-            f"h={spec.n_iterations} in {secs:.1f}s",
+            f"(any H) in {secs:.1f}s",
             file=sys.stderr,
         )
     print(
@@ -355,6 +374,24 @@ def main(argv=None):
     run.add_argument("--k-batch-size", type=int, default=None,
                      help="compile/run the sweep in batches of this many "
                           "K values, checkpointing after each")
+    run.add_argument("--stream", type=int, default=0, metavar="H_BLOCK",
+                     help="stream the sweep in compiled blocks of this "
+                     "many resamples with device-resident accumulators "
+                     "(0 = one monolithic program); bit-identical at "
+                     "full H, H-agnostic executable")
+    run.add_argument("--adaptive", nargs="?", const=0.01, default=None,
+                     type=float, metavar="TOL",
+                     help="with --stream: stop early once every K's PAC "
+                     "moves < TOL (bare flag: 0.01) for "
+                     "--adaptive-patience consecutive blocks; the "
+                     "result metrics carry h_effective and the "
+                     "per-block PAC trajectory")
+    run.add_argument("--adaptive-patience", type=int, default=2,
+                     help="consecutive quiet blocks before an adaptive "
+                     "stop (default 2)")
+    run.add_argument("--adaptive-min-h", type=int, default=0,
+                     help="resample floor before an adaptive stop may "
+                     "trigger")
     run.add_argument("--store-matrices", choices=["auto", "on", "off"],
                      default="auto",
                      help="keep Iij/Mij/Cij in results (auto: only when "
@@ -388,6 +425,10 @@ def main(argv=None):
                          "(exponential backoff)")
     serve_p.add_argument("--events-path", default=None,
                          help="append JSONL lifecycle events here")
+    serve_p.add_argument("--stream-block", type=int, default=32,
+                         help="default resamples per streamed H-block "
+                         "for jobs that don't set stream_h_block "
+                         "(part of the executable bucket)")
     serve_p.add_argument("--warmup", action="append", default=None,
                          metavar="N,D,KSPEC,H",
                          help="pre-compile a shape bucket at startup, "

@@ -80,6 +80,35 @@ class SweepConfig:
         for multi-optimum clusterers like full-covariance GMMs.  True gives
         every resample an independent init stream (honest resampling
         variance; documented divergence).
+      stream_h_block: resamples per streaming block (None: the monolithic
+        single-program sweep).  When set, the sweep runs as repeated
+        executions of ONE compiled block program over ``stream_h_block``
+        resamples with the per-K Mij row blocks and Iij held
+        device-resident between calls (donated argnums), and only the
+        (nK, bins) curves returning to the host per block.  The block
+        program is H-agnostic — H enters as a traced scalar — so one
+        warm executable serves any ``n_iterations`` at the same shape,
+        and the full-H streamed result is bit-identical to the
+        monolithic sweep (the resample plan folds keys with the GLOBAL
+        resample index, so block boundaries cannot change any draw).
+        Cost: all nK per-K accumulators stay resident (the monolithic
+        curves-only sweep holds one K's row block at a time), which the
+        'n' row-sharding axis divides; plus one consensus-histogram
+        pass per K per block instead of per K.  The block is padded up
+        to a multiple of the mesh's resample shards.
+      adaptive_tol: early-stop tolerance on the per-block PAC trajectory
+        (None: always run the full H).  With streaming on, the driver
+        stops once every K's PAC moved less than this for
+        ``adaptive_patience`` consecutive blocks (and at least
+        ``adaptive_min_h`` resamples accumulated) — Monti et al. (2003)
+        define consensus as a resampling *convergence* process, which
+        is what flattening PAC curves witness.  Requires
+        ``stream_h_block``; incompatible with ``store_matrices`` (an
+        early-stopped run's matrices would disagree with its reported
+        ``h_effective`` under the dispatch pipelining).
+      adaptive_patience: consecutive sub-tolerance blocks required
+        before stopping (default 2 — one quiet block can be luck).
+      adaptive_min_h: resample floor before early stop may trigger.
       use_pallas: True forces the Pallas consensus-histogram kernel, False
         forces the XLA fallback, None picks by backend (Pallas on TPU).
       dtype: working float dtype for the data and the inner clusterers
@@ -106,6 +135,10 @@ class SweepConfig:
     split_init: bool = False
     k_interleave: bool = False
     reseed_clusterer_per_resample: bool = False
+    stream_h_block: Optional[int] = None
+    adaptive_tol: Optional[float] = None
+    adaptive_patience: int = 2
+    adaptive_min_h: int = 0
     use_pallas: Optional[bool] = None
     dtype: str = "float32"
 
@@ -122,6 +155,45 @@ class SweepConfig:
             raise ValueError(
                 f"cluster_batch must be an int >= 1, got "
                 f"{self.cluster_batch!r}"
+            )
+        if self.stream_h_block is not None and (
+            isinstance(self.stream_h_block, bool)
+            or not isinstance(self.stream_h_block, (int, np.integer))
+            or self.stream_h_block < 1
+        ):
+            raise ValueError(
+                f"stream_h_block must be an int >= 1, got "
+                f"{self.stream_h_block!r}"
+            )
+        if self.adaptive_tol is not None:
+            if not isinstance(
+                self.adaptive_tol, (int, float)
+            ) or isinstance(self.adaptive_tol, bool) or self.adaptive_tol < 0:
+                raise ValueError(
+                    f"adaptive_tol must be a number >= 0, got "
+                    f"{self.adaptive_tol!r}"
+                )
+            if self.stream_h_block is None:
+                raise ValueError(
+                    "adaptive_tol needs stream_h_block: early stopping is "
+                    "a property of the streaming driver loop"
+                )
+            if self.store_matrices:
+                raise ValueError(
+                    "adaptive_tol is incompatible with store_matrices: an "
+                    "early-stopped run's accumulators can include one "
+                    "in-flight block beyond the reported h_effective "
+                    "(SweepConfig.adaptive_tol docs) — pass "
+                    "store_matrices=False"
+                )
+        if self.adaptive_patience < 1:
+            raise ValueError(
+                f"adaptive_patience must be >= 1, got "
+                f"{self.adaptive_patience}"
+            )
+        if self.adaptive_min_h < 0:
+            raise ValueError(
+                f"adaptive_min_h must be >= 0, got {self.adaptive_min_h}"
             )
         if not self.k_values:
             raise ValueError("k_values must be non-empty")

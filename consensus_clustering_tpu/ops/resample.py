@@ -36,6 +36,7 @@ def resample_indices(
     n_samples: int,
     n_iterations: int,
     n_sub: int,
+    h_start=0,
 ) -> jax.Array:
     """Draw the (H, n_sub) no-replacement subsample index plan on device.
 
@@ -44,6 +45,12 @@ def resample_indices(
     (consensus_clustering_parallelised.py:231-238), so the plan is a pure
     function of ``(key, N, H, subsampling)`` and is identical for every K
     (quirk Q8: the plan is drawn once, shared by the whole K sweep).
+
+    ``h_start`` (static or traced) offsets the fold data: row ``i`` of the
+    result is GLOBAL resample ``h_start + i``.  The streaming engine draws
+    each H-block this way, and because each row depends only on its global
+    index the blocked plan is bit-identical to the monolithic one — block
+    boundaries cannot change any draw.
 
     Returns int32 (H, n_sub).
     """
@@ -58,7 +65,9 @@ def resample_indices(
         return jax.random.permutation(k, n_samples)[:n_sub].astype(jnp.int32)
 
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-        key, jnp.arange(n_iterations, dtype=jnp.uint32)
+        key,
+        jnp.asarray(h_start, jnp.uint32)
+        + jnp.arange(n_iterations, dtype=jnp.uint32),
     )
     return jax.vmap(draw_one)(keys)
 

@@ -2,6 +2,13 @@
 
 from consensus_clustering_tpu.parallel import distributed
 from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.streaming import (
+    StreamingSweep,
+    run_streaming_sweep,
+)
 from consensus_clustering_tpu.parallel.sweep import build_sweep, run_sweep
 
-__all__ = ["distributed", "resample_mesh", "build_sweep", "run_sweep"]
+__all__ = [
+    "distributed", "resample_mesh", "build_sweep", "run_sweep",
+    "StreamingSweep", "run_streaming_sweep",
+]

@@ -1,0 +1,601 @@
+"""Streaming H-block sweep engine: device-resident accumulators,
+adaptive early stop, H-agnostic warm executables.
+
+The monolithic sweep (:func:`~consensus_clustering_tpu.parallel.sweep.
+build_sweep`) compiles ONE XLA program over all H resamples.  That is
+the right shape for a throughput benchmark, but (a) compile is the wall
+at small shapes (corr on chip: 16.31 s compile vs 0.24 s run,
+benchmarks/PERF.md), (b) the executable is pinned to one H, so a serving
+process recompiles for every new ``n_iterations``, and (c) the full H
+budget is always paid even when the consensus CDF has stabilised far
+earlier — Monti et al. (2003) define consensus as a resampling
+*convergence* process, which invites stopping once the PAC trajectory
+flattens.
+
+This engine compiles ONE block program
+
+    ``step(state, x, key, h_start, h_total) -> (state, curves)``
+
+over a fixed ``stream_h_block`` of resamples and drives it from a host
+loop:
+
+- **Device-resident accumulators.**  ``state`` is the per-K ``Mij`` row
+  blocks plus ``Iij``, int32, laid out exactly as the monolithic
+  program's shard_map shards them (``P('k', 'n', None)`` / ``P('n',
+  None)``) and donated back into every call (``donate_argnums``): XLA
+  aliases the buffers, so no HBM copy and no host round trip per block —
+  only the (nK, bins)-sized CDF/PAC curves come home.
+- **H-agnostic executable.**  ``h_start``/``h_total`` are traced
+  scalars; nothing about the compiled block depends on ``n_iterations``,
+  so one warm executable serves ANY H at a given shape — the serve
+  executor's bucket key drops ``n_iterations`` on the strength of this.
+- **Bit-exact at full H.**  Every resample's draw folds the key with its
+  GLOBAL index (:func:`~consensus_clustering_tpu.ops.resample.
+  resample_indices` ``h_start``), the lane clusterer keys derive from
+  the global index too (:func:`~consensus_clustering_tpu.parallel.sweep.
+  resample_lane_keys`), and the count accumulators are exact integers
+  (int32 partial sums, each block's f32 GEMM accumulation exact below
+  2^24) — so block boundaries cannot change any draw or any count, and
+  the streamed full-H ``Mij``/``Iij``/``cdf``/``pac_area`` equal the
+  monolithic sweep's bit for bit (tests/test_streaming.py,
+  tests/test_fuzz_configs.py).
+- **Pipelined driver.**  JAX dispatch is asynchronous: the loop
+  dispatches block b+1, then evaluates block b's curves (the
+  device->host copy doubles as the completion barrier) while b+1
+  computes — the host-side PAC-delta analysis rides for free.  When an
+  adaptive stop triggers, the one speculative in-flight block is
+  discarded (its compute is the price of the overlap; its results never
+  enter the answer).
+- **Adaptive early stop.**  With ``adaptive_tol`` set, the driver stops
+  once every K's PAC moved less than the tolerance for
+  ``adaptive_patience`` consecutive blocks (after ``adaptive_min_h``
+  resamples), reporting ``h_effective`` and the full per-block PAC
+  trajectory.
+
+Memory trade: the monolithic curves-only sweep holds ONE K's row block
+at a time (scan temp); the streaming state must persist all nK of them
+across calls.  The 'n' row-sharding axis divides that footprint exactly
+as it divides the monolithic matrices (benchmarks/memory_scaling.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.protocol import JaxClusterer
+from consensus_clustering_tpu.ops.analysis import (
+    cdf_pac_from_counts,
+    consensus_matrix,
+)
+from consensus_clustering_tpu.ops.coassoc import coassociation_counts
+from consensus_clustering_tpu.ops.pallas_hist import (
+    consensus_hist_counts,
+    kernel_available,
+)
+from consensus_clustering_tpu.ops.resample import (
+    cosample_counts,
+    resample_indices,
+)
+from consensus_clustering_tpu.parallel.mesh import (
+    KSHARD_AXIS,
+    RESAMPLE_AXIS,
+    ROW_AXIS,
+    resample_mesh,
+)
+from consensus_clustering_tpu.parallel.sweep import (
+    fit_resample_lanes,
+    resample_lane_keys,
+    shard_map,
+    sweep_geometry,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class StreamingSweep:
+    """One compiled H-block step plus the host driver that streams it.
+
+    Build once per (shape, mesh, config-minus-H) bucket and call
+    :meth:`run` for any ``n_iterations``: the block executable is
+    H-agnostic, so a warm instance never recompiles across H values
+    (asserted via ``jit._cache_size()`` in tests/test_streaming.py and
+    via the serve executor's hit/miss counters).
+    """
+
+    def __init__(
+        self,
+        clusterer: JaxClusterer,
+        config: SweepConfig,
+        mesh: Optional[Mesh] = None,
+    ):
+        if config.stream_h_block is None:
+            raise ValueError(
+                "StreamingSweep needs SweepConfig.stream_h_block (the "
+                "resamples-per-block size); use build_sweep for the "
+                "monolithic program"
+            )
+        if config.adaptive_tol is not None and config.store_matrices:
+            # Also rejected by SweepConfig itself; kept here so a
+            # dataclasses.replace that bypassed __post_init__ still
+            # cannot reach an inconsistent matrices/h_effective pair.
+            raise ValueError(
+                "adaptive early stop is incompatible with store_matrices"
+            )
+        if mesh is None:
+            mesh = resample_mesh([jax.devices()[0]])
+        self.mesh = mesh
+        self.config = config
+        self.clusterer = clusterer
+
+        n = config.n_samples
+        n_sub = config.n_sub
+        k_max = config.k_max
+        lo, hi = config.pac_idx
+        # All padding / K-permutation rules come from the geometry
+        # helper SHARED with build_sweep (SweepGeometry): the
+        # streamed-vs-monolithic bit-parity rests on the two engines
+        # agreeing on them, so there is exactly one implementation.
+        geo = sweep_geometry(config, mesh, config.stream_h_block)
+        n_h, n_r = geo.n_h, geo.n_r
+        n_local, n_pad = geo.n_local, geo.n_pad
+        hb_pad, local_hb = geo.h_pad, geo.local_h
+        n_ks, k_unperm = geo.n_ks, geo.k_unperm
+        self._k_arr = geo.k_arr
+        self._hb_pad = hb_pad
+        self._n_ks = n_ks
+        self._nk_pad = len(geo.k_values_pad)
+        self._n_pad = n_pad
+        self._k_unperm = k_unperm
+        use_pallas = config.use_pallas
+        if use_pallas is None:
+            use_pallas = kernel_available()
+
+        k_axis = KSHARD_AXIS if KSHARD_AXIS in mesh.axis_names else None
+        mij_spec = P(k_axis, ROW_AXIS, None)
+        iij_spec = P(ROW_AXIS, None)
+        self._state_shardings = {
+            "mij": NamedSharding(mesh, mij_spec),
+            "iij": NamedSharding(mesh, iij_spec),
+        }
+
+        def local_step(
+            mij_blk, iij_blk, x, key_resample, key_cluster, k_arr_local,
+            h_start, h_total,
+        ):
+            """Per-device block step.
+
+            ``mij_blk``: this device's (k_local, n_local, n_pad) slices
+            of the per-K accumulators; ``iij_blk``: its (n_local, n_pad)
+            Iij rows (replicated over 'k' and 'h').  The block's
+            resample rows [h_start, h_start + hb_pad) are drawn
+            replicated (see build_sweep.local_body for the partitioner
+            miscompile this sidesteps) with rows >= h_total masked to
+            -1, each chip slices its shard, and the partial counts psum
+            over 'h' exactly as in the monolithic program — then ADD to
+            the carried accumulators instead of being the whole answer.
+            """
+            h_idx = jax.lax.axis_index(RESAMPLE_AXIS)
+            r_idx = jax.lax.axis_index(ROW_AXIS)
+            h_global = h_start + (
+                (h_idx * n_r + r_idx) * local_hb
+                + jnp.arange(local_hb, dtype=jnp.int32)
+            )
+            h_valid = h_global < h_total
+            row_start = r_idx * n_local
+
+            indices_full = resample_indices(
+                key_resample, n, hb_pad, n_sub, h_start=h_start
+            )
+            block_rows = h_start + jnp.arange(hb_pad, dtype=jnp.int32)
+            indices_full = jnp.where(
+                (block_rows < h_total)[:, None], indices_full, -1
+            )
+            indices = jax.lax.dynamic_slice(
+                indices_full,
+                (
+                    jnp.asarray(
+                        (h_idx * n_r + r_idx) * local_hb, jnp.int32
+                    ),
+                    jnp.asarray(0, jnp.int32),
+                ),
+                (local_hb, n_sub),
+            )
+            indices_row = jax.lax.dynamic_slice(
+                indices_full,
+                (
+                    jnp.asarray(h_idx * n_r * local_hb, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                ),
+                (n_r * local_hb, n_sub),
+            )
+            # int32 partial + int32 accumulator: each block's counts are
+            # exact (f32 GEMM accumulation below 2^24), so the running
+            # sum equals the monolithic single-program count bit for bit.
+            iij_new = iij_blk + jax.lax.psum(
+                cosample_counts(
+                    indices_row, n,
+                    n_cols=n_pad, row_start=row_start, n_rows=n_local,
+                ),
+                RESAMPLE_AXIS,
+            )
+
+            x_sub = x[jnp.where(indices >= 0, indices, 0)]
+
+            def per_k(_, scanned):
+                k, mij_acc = scanned
+                keys = resample_lane_keys(
+                    config, key_cluster, k, h_global
+                )
+                labels = fit_resample_lanes(
+                    clusterer, config, keys, x_sub, k, k_max
+                )
+                labels = jnp.where(h_valid[:, None], labels, -1)
+                labels_row = jax.lax.all_gather(
+                    labels, ROW_AXIS, tiled=True, axis=0
+                )
+                mij_new = mij_acc + jax.lax.psum(
+                    coassociation_counts(
+                        labels_row, indices_row, n, k_max,
+                        config.chunk_size,
+                        n_cols=n_pad, row_start=row_start,
+                        n_rows=n_local,
+                    ),
+                    RESAMPLE_AXIS,
+                )
+                # Curves from the ACCUMULATED counts: the consensus over
+                # every resample streamed so far, which at the final
+                # block is exactly the monolithic sweep's input.
+                cij = consensus_matrix(
+                    mij_new, iij_new, row_offset=row_start
+                )
+                counts = jax.lax.psum(
+                    consensus_hist_counts(
+                        cij, n, row_start, config.bins,
+                        use_pallas=use_pallas,
+                    ),
+                    ROW_AXIS,
+                )
+                hist, cdf, pac = cdf_pac_from_counts(
+                    counts, n, lo, hi, config.parity_zeros
+                )
+                return 0, {
+                    "mij": mij_new, "hist": hist, "cdf": cdf,
+                    "pac_area": pac,
+                }
+
+            _, out = jax.lax.scan(per_k, 0, (k_arr_local, mij_blk))
+            curves = {
+                "hist": out["hist"], "cdf": out["cdf"],
+                "pac_area": out["pac_area"],
+            }
+            return out["mij"], iij_new, curves
+
+        per_k_specs = {
+            "hist": P(k_axis), "cdf": P(k_axis), "pac_area": P(k_axis),
+        }
+        sharded_step = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(
+                mij_spec, iij_spec, P(), P(), P(), P(k_axis), P(), P(),
+            ),
+            out_specs=(mij_spec, iij_spec, per_k_specs),
+            check_vma=False,
+        )
+
+        def step(state, x, key, h_start, h_total):
+            x = x.astype(jnp.dtype(config.dtype))
+            key_resample, key_cluster = jax.random.split(key)
+            mij, iij, curves = sharded_step(
+                state["mij"], state["iij"], x, key_resample, key_cluster,
+                self._k_arr, h_start, h_total,
+            )
+            if k_unperm is not None:
+                curves = {
+                    name: jnp.take(v, k_unperm, axis=0)
+                    for name, v in curves.items()
+                }
+            curves = {name: v[:n_ks] for name, v in curves.items()}
+            # Same exactly-rounded f32 subtract, staged outside the
+            # shard_map, as build_sweep's pac_area output — the 1-ulp
+            # mesh-layout split it avoids applies here identically.
+            curves["pac_area"] = (
+                curves["cdf"][:, hi - 1] - curves["cdf"][:, lo]
+            )
+            return {"mij": mij, "iij": iij}, curves
+
+        def finalize(state):
+            """Cropped host-facing matrices from the final accumulators
+            (full-H runs with ``store_matrices`` only)."""
+            mij = state["mij"]
+            if k_unperm is not None:
+                mij = jnp.take(mij, k_unperm, axis=0)
+            mij = mij[:n_ks, :n, :n]
+            iij = state["iij"][:n, :n]
+            cij = jax.vmap(lambda m: consensus_matrix(m, iij))(mij)
+            return {"mij": mij, "iij": iij, "cij": cij}
+
+        # The state is donated back into every call: XLA aliases the
+        # accumulator buffers, so blocks mutate HBM in place (no copy,
+        # no host round trip).  Bound ONCE here — the jit cache lives on
+        # this instance, which is what keeps the executable warm across
+        # runs with different H.  The output state shardings are PINNED
+        # to the input ones: on a trivial mesh GSPMD normalises an
+        # output's spec to P(), and the fed-back state would then key a
+        # second (identical) cache entry — pinning keeps the loop at
+        # exactly one entry, which the H-agnostic tests assert.  The
+        # curves pin to replicated: (nK, bins)-sized, about to be
+        # copied to the host anyway.
+        #
+        # CPU CAVEAT: on jaxlib 0.4.36's CPU backend, a donated-argnums
+        # executable DESERIALIZED from the persistent XLA compilation
+        # cache corrupts the glibc heap when executed ("corrupted
+        # double-linked list" / segfault; deterministic — cold cache
+        # runs fine, the warm reload crashes; reproduced with
+        # benchmarks/stream_ab.py, 2026-08).  Donation there buys only a
+        # host-RAM copy anyway, so it defaults off on CPU and on for
+        # accelerator backends; CCTPU_STREAM_DONATE=1/0 forces either
+        # way (the knob exists so an accelerator hitting a similar
+        # plugin bug has a mitigation that isn't a code change).
+        donate = os.environ.get("CCTPU_STREAM_DONATE", "auto")
+        if donate == "auto":
+            donate_state = jax.default_backend() != "cpu"
+        else:
+            donate_state = donate not in ("0", "off", "no")
+        replicated = NamedSharding(mesh, P())
+        self._step = jax.jit(
+            step,
+            donate_argnums=(0,) if donate_state else (),
+            out_shardings=(
+                dict(self._state_shardings),
+                {
+                    "hist": replicated, "cdf": replicated,
+                    "pac_area": replicated,
+                },
+            ),
+        )
+        self.donates_state = donate_state
+        self._finalize = jax.jit(finalize)
+
+        def init_state_fn():
+            return {
+                "mij": jnp.zeros(
+                    (self._nk_pad, self._n_pad, self._n_pad), jnp.int32
+                ),
+                "iij": jnp.zeros((self._n_pad, self._n_pad), jnp.int32),
+            }
+
+        # Zeros materialise ON DEVICE, already sharded: a device_put of
+        # host zeros would pay a full state-sized host->device transfer
+        # per run (GBs at the large-N shapes) for buffers whose content
+        # is constant.
+        self._init = jax.jit(
+            init_state_fn, out_shardings=dict(self._state_shardings)
+        )
+
+    # -- state -----------------------------------------------------------
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        """Fresh zeroed accumulators, created on device, mesh-sharded."""
+        return self._init()
+
+    def warmup(self, x: Optional[np.ndarray] = None) -> float:
+        """Compile the block program; returns the wall-clock it took.
+
+        Runs one all-masked block (``h_total=0``): every resample row is
+        padding, so the accumulators stay zero and the clusterer runs on
+        clamped x[0] lanes that converge immediately — the cheapest
+        execution that still populates the jit cache with the exact
+        program every later block reuses.
+        """
+        if x is None:
+            x = np.zeros(
+                (self.config.n_samples, self.config.n_features),
+                np.dtype(self.config.dtype),
+            )
+        xj = jnp.asarray(x, jnp.dtype(self.config.dtype))
+        t0 = time.perf_counter()
+        state = self.init_state()
+        state, curves = self._step(
+            state, xj, jax.random.PRNGKey(0),
+            jnp.int32(0), jnp.int32(0),
+        )
+        jax.tree.map(np.asarray, curves)  # completion barrier
+        del state
+        return time.perf_counter() - t0
+
+    # -- driver ----------------------------------------------------------
+
+    def run(
+        self,
+        x: np.ndarray,
+        seed: int,
+        n_iterations: int,
+        block_callback: Optional[
+            Callable[[int, int, List[float]], None]
+        ] = None,
+        adaptive_tol: Optional[float] = None,
+        adaptive_patience: Optional[int] = None,
+        adaptive_min_h: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Stream the sweep; returns host-side results + streaming stats.
+
+        ``n_iterations`` is a RUNTIME argument — the compiled block is
+        H-agnostic — and so are the adaptive knobs (they steer only the
+        host driver loop): a serving executor can run one warm engine
+        for jobs with different H AND different early-stop settings.
+        The knob arguments default to the build config's values; passing
+        them overrides per run.  ``block_callback``, if given, is called
+        as ``cb(block_index, h_done, pac_list)`` after each block's
+        curves land on the host (the serve path forwards it to the JSONL
+        event log).
+
+        The loop is double-buffered: block b+1 is dispatched before
+        block b's curves are pulled to the host, so the host-side
+        analysis (and any callback) overlaps device compute.  With
+        adaptive stopping on, a stop decided on block b discards the
+        already-dispatched block b+1.
+        """
+        if n_iterations < 1:
+            raise ValueError(
+                f"n_iterations must be >= 1, got {n_iterations}"
+            )
+        config = self.config
+        if adaptive_tol is None:
+            adaptive_tol = config.adaptive_tol
+        if adaptive_patience is None:
+            adaptive_patience = config.adaptive_patience
+        if adaptive_min_h is None:
+            adaptive_min_h = config.adaptive_min_h
+        adaptive = adaptive_tol is not None
+        if adaptive and config.store_matrices:
+            raise ValueError(
+                "adaptive early stop is incompatible with store_matrices"
+            )
+        xj = jnp.asarray(x, jnp.dtype(config.dtype))
+        key = jax.random.PRNGKey(seed)
+        h_total = jnp.int32(n_iterations)
+        n_blocks = -(-n_iterations // self._hb_pad)
+
+        t0 = time.perf_counter()
+        state = self.init_state()
+        trajectory: List[List[float]] = []
+        prev_pac: Optional[np.ndarray] = None
+        quiet = 0
+        stopped_early = False
+        result_curves: Optional[Dict[str, np.ndarray]] = None
+        h_effective = 0
+        pending = None  # (block_index, device curves) not yet on host
+
+        def h_done(b: int) -> int:
+            return min((b + 1) * self._hb_pad, n_iterations)
+
+        def evaluate(b: int, curves) -> bool:
+            """Pull block b's curves to host; True when the run should
+            stop early.  The np.asarray copy is the completion barrier —
+            while it blocks, the next block already computes."""
+            nonlocal prev_pac, quiet, result_curves, h_effective
+            host = {
+                name: np.asarray(v) for name, v in curves.items()
+            }
+            result_curves = host
+            h_effective = h_done(b)
+            pac = host["pac_area"]
+            trajectory.append([float(v) for v in pac])
+            if block_callback is not None:
+                block_callback(b, h_effective, trajectory[-1])
+            stop = False
+            if adaptive:
+                if prev_pac is not None:
+                    if np.max(np.abs(pac - prev_pac)) < adaptive_tol:
+                        quiet += 1
+                    else:
+                        quiet = 0
+                stop = (
+                    quiet >= adaptive_patience
+                    and h_effective >= adaptive_min_h
+                    and h_effective < n_iterations
+                )
+            prev_pac = pac
+            return stop
+
+        for b in range(n_blocks):
+            state, curves = self._step(
+                state, xj, key, jnp.int32(b * self._hb_pad), h_total
+            )
+            if pending is not None and evaluate(*pending):
+                # Block b is the speculative in-flight dispatch; its
+                # state and curves never enter the answer.
+                stopped_early = True
+                pending = None
+                break
+            pending = (b, curves)
+        if pending is not None:
+            evaluate(*pending)
+
+        out: Dict[str, Any] = dict(result_curves)
+        if config.store_matrices and not stopped_early:
+            # Full-H only: __init__ rejects adaptive + store_matrices,
+            # and a non-adaptive run always streams every block.
+            matrices = jax.tree.map(np.asarray, self._finalize(state))
+            out.update(matrices)
+        del state
+        run_seconds = time.perf_counter() - t0
+        total_resamples = h_effective * self._n_ks
+
+        from consensus_clustering_tpu.utils.metrics import (
+            device_memory_stats,
+        )
+
+        out["streaming"] = {
+            "h_block": int(config.stream_h_block),
+            "h_block_padded": int(self._hb_pad),
+            "h_requested": int(n_iterations),
+            "h_effective": int(h_effective),
+            "n_blocks_run": len(trajectory),
+            "stopped_early": stopped_early,
+            "pac_trajectory": trajectory,
+        }
+        out["timing"] = {
+            "run_seconds": run_seconds,
+            "resamples_per_second": total_resamples / max(
+                run_seconds, 1e-9
+            ),
+            "device_memory": device_memory_stats(),
+        }
+        return out
+
+
+def run_streaming_sweep(
+    clusterer: JaxClusterer,
+    config: SweepConfig,
+    x: np.ndarray,
+    seed: int,
+    mesh: Optional[Mesh] = None,
+    repeats: int = 1,
+    block_callback=None,
+    profile_dir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Build, warm and drive a streaming sweep; mirror of
+    :func:`~consensus_clustering_tpu.parallel.sweep.run_sweep`.
+
+    ``timing`` carries ``compile_seconds`` (the warmup block: trace +
+    XLA compile + one all-masked execution) and the best-of-``repeats``
+    ``run_seconds``; the result dict adds the ``streaming`` section
+    (``h_effective``, per-block PAC trajectory, early-stop flag).
+    ``profile_dir`` captures a ``jax.profiler`` trace of the FIRST
+    streamed run (the warmup block is outside the trace).
+    """
+    engine = StreamingSweep(clusterer, config, mesh)
+    compile_seconds = engine.warmup(x)
+    best = None
+    run_times = []
+    for rep in range(max(1, repeats)):
+        if rep == 0 and profile_dir is not None:
+            with jax.profiler.trace(profile_dir):
+                out = engine.run(
+                    x, seed, config.n_iterations,
+                    block_callback=block_callback,
+                )
+        else:
+            out = engine.run(
+                x, seed, config.n_iterations,
+                block_callback=block_callback,
+            )
+        run_times.append(out["timing"]["run_seconds"])
+        if best is None or out["timing"]["run_seconds"] < best[
+            "timing"
+        ]["run_seconds"]:
+            best = out
+    best["timing"]["compile_seconds"] = compile_seconds
+    best["timing"]["all_run_seconds"] = run_times
+    return best

@@ -34,7 +34,7 @@ from __future__ import annotations
 import logging
 import time
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -96,6 +96,191 @@ def pad_to_lane_groups(arr: jax.Array, batch: int) -> jax.Array:
     )
 
 
+class SweepGeometry(NamedTuple):
+    """Mesh-geometry derivation shared by the monolithic and streaming
+    engines: row-block tiling of N over 'n', resample padding of the
+    H rows over the ('h' x 'n') shards, K padding / interleave over the
+    'k' groups.  One implementation so the padding and permutation rules
+    cannot drift between the engines — the streamed-vs-monolithic
+    bit-parity guarantee rests on them agreeing."""
+
+    n_h: int
+    n_r: int
+    n_k: int
+    n_local: int
+    n_pad: int
+    h_pad: int
+    local_h: int
+    n_ks: int
+    k_values_pad: Tuple[int, ...]
+    k_unperm: Optional[np.ndarray]
+    k_arr: jax.Array
+
+
+def sweep_geometry(
+    config: SweepConfig, mesh: Mesh, h_rows: int
+) -> SweepGeometry:
+    """Derive :class:`SweepGeometry` for ``h_rows`` resample rows
+    (``config.n_iterations`` for the monolithic program, the block size
+    for the streaming engine)."""
+    n_h = mesh.shape[RESAMPLE_AXIS]
+    n_r = mesh.shape[ROW_AXIS]
+    # Optional third axis: k-groups each run the scan over their own
+    # slice of k_values — the reference's SEQUENTIAL K loop
+    # (consensus_clustering_parallelised.py:112) becomes the outermost
+    # parallel dimension.  Meshes without the axis (pre-'k' callers)
+    # behave as k_shards=1.
+    n_k = dict(mesh.shape).get(KSHARD_AXIS, 1)
+    n = config.n_samples
+    # Row sharding: each of the n_r devices on the 'n' axis owns n_local
+    # consensus-matrix rows; N is padded so the blocks tile evenly
+    # (padded rows/cols receive no scatters and are cropped after the
+    # shard_map).
+    n_local = -(-n // n_r)
+    n_pad = n_local * n_r
+    # Resamples shard over BOTH axes for the clustering work (n_h * n_r
+    # devices); pad the row count to a multiple and mark padded rows
+    # with indices = -1, which every one-hot builder drops.
+    h_pad = -(-h_rows // (n_h * n_r)) * (n_h * n_r)
+    local_h = h_pad // (n_h * n_r)
+    # cluster_batch applies to each device's LOCAL resample shard
+    # (config docs): a value tuned on one layout can silently stop
+    # sub-batching when a wider mesh (or a small streaming block)
+    # shrinks the shard below it — say so, because the symptom (lockstep
+    # Lloyd waste returns) looks like a perf regression, not a config
+    # one.
+    if (config.cluster_batch is not None
+            and config.cluster_batch >= local_h):
+        logger.warning(
+            "cluster_batch=%d >= the per-device resample shard (%d of "
+            "%d rows over %d devices): sub-batching is a no-op on this "
+            "mesh layout, equivalent to cluster_batch=None; re-tune at "
+            "the deployment mesh (SweepConfig.cluster_batch docs)",
+            config.cluster_batch, local_h, h_rows, n_h * n_r,
+        )
+    # Pad the K list to a multiple of the k-groups with repeats of the
+    # last K (always a valid cluster count); padded slots are redundant
+    # compute on the padding groups and are cropped after the shard_map.
+    n_ks = len(config.k_values)
+    k_local = -(-n_ks // n_k)
+    k_values_pad = tuple(config.k_values) + (config.k_values[-1],) * (
+        k_local * n_k - n_ks
+    )
+    # Optional round-robin K assignment (config.k_interleave): the 'k'
+    # axis shards the scan array in CONTIGUOUS blocks, so laying the
+    # padded list out as [group0's strided picks, group1's, ...] gives
+    # group g exactly k_values_pad[g::n_k] — spreading the slow
+    # beyond-elbow Ks across groups instead of piling them on the tail
+    # block.  k_unperm maps each original K position to its row in the
+    # stacked per-K outputs so callers always see k_values order.
+    if config.k_interleave and n_k > 1:
+        perm = [g + j * n_k for g in range(n_k) for j in range(k_local)]
+        k_values_pad = tuple(k_values_pad[i] for i in perm)
+        k_unperm = np.argsort(np.asarray(perm))
+    else:
+        k_unperm = None
+    return SweepGeometry(
+        n_h=n_h, n_r=n_r, n_k=n_k, n_local=n_local, n_pad=n_pad,
+        h_pad=h_pad, local_h=local_h, n_ks=n_ks,
+        k_values_pad=k_values_pad, k_unperm=k_unperm,
+        k_arr=jnp.asarray(k_values_pad, jnp.int32),
+    )
+
+
+def resample_lane_keys(
+    config: SweepConfig, key_cluster: jax.Array, k: jax.Array,
+    h_global: jax.Array,
+) -> jax.Array:
+    """Per-lane clusterer keys for one K over the given GLOBAL resample ids.
+
+    Shared by the monolithic and streaming engines: the derivation
+    (``fold_in(key_cluster, k)`` then, under
+    ``reseed_clusterer_per_resample``, ``fold_in(key_k, h_global)``)
+    depends only on the global resample index, so any partition of the
+    resamples into blocks or shards draws identical keys.
+    """
+    key_k = jax.random.fold_in(key_cluster, k)
+    if config.reseed_clusterer_per_resample:
+        return jax.vmap(
+            lambda h: jax.random.fold_in(key_k, h)
+        )(h_global)
+    # Reference semantics: every fit re-seeds identically (fixed
+    # random_state per estimator), correlating inits across resamples —
+    # see SweepConfig docs.
+    return jnp.broadcast_to(key_k, (h_global.shape[0],) + key_k.shape)
+
+
+def fit_resample_lanes(
+    clusterer: JaxClusterer,
+    config: SweepConfig,
+    keys: jax.Array,
+    x_sub: jax.Array,
+    k: jax.Array,
+    k_max: int,
+) -> jax.Array:
+    """Cluster one device's resample lanes for one K, honouring the
+    ``cluster_batch``/``split_init`` sub-batching semantics.
+
+    One implementation for both the monolithic sweep and the streaming
+    H-block engine: labels are a pure per-lane function of (key, x_sub,
+    k), and the grouped paths are bit-identical to the single batch
+    (frozen lanes never change), so sharing the code is what makes the
+    engines' full-H parity a structural property rather than a test
+    coincidence.
+    """
+    local_h = x_sub.shape[0]
+    fit_batch = jax.vmap(
+        lambda kk, xs: clusterer.fit_predict(kk, xs, k, k_max)
+    )
+    batch = config.cluster_batch
+    if batch is None or batch >= local_h:
+        return fit_batch(keys, x_sub)
+    # Sub-batch the clustering: a vmapped while_loop freezes converged
+    # lanes (selects) but iterates until the batch's slowest lane
+    # converges, so one big batch pays the global worst case on every
+    # lane.  lax.map over groups lets each group stop at ITS slowest
+    # member — labels bit-identical, lockstep waste reduced, groups
+    # serialised.  Group-count padding repeats row 0 (clustered
+    # redundantly, cropped).
+    n_groups = -(-local_h // batch)
+    keys_g = pad_to_lane_groups(keys, batch)
+    x_g = pad_to_lane_groups(x_sub, batch)
+    if config.split_init and hasattr(clusterer, "init_centroids"):
+        # Init has a k-determined trip count (no lockstep waste), so run
+        # it ONCE over the full lane batch — full-width GEMMs — and
+        # group only the Lloyd while_loop.  Same key derivation, so
+        # labels are bit-identical to the self-seeding grouped path
+        # (SweepConfig.split_init).
+        inits = jax.vmap(
+            lambda kk, xs: clusterer.init_centroids(kk, xs, k, k_max)
+        )(keys, x_sub)
+        inits_g = pad_to_lane_groups(inits, batch)
+        fit_from = jax.vmap(
+            lambda kk, xs, c0: clusterer.fit_predict(
+                kk, xs, k, k_max, init_centroids=c0
+            )
+        )
+        labels_g = jax.lax.map(
+            lambda args: fit_from(*args),
+            (
+                keys_g.reshape((n_groups, batch) + keys.shape[1:]),
+                x_g.reshape((n_groups, batch) + x_sub.shape[1:]),
+                inits_g.reshape((n_groups, batch) + inits.shape[1:]),
+            ),
+        )
+    else:
+        labels_g = jax.lax.map(
+            lambda args: fit_batch(*args),
+            (
+                keys_g.reshape((n_groups, batch) + keys.shape[1:]),
+                x_g.reshape((n_groups, batch) + x_sub.shape[1:]),
+            ),
+        )
+    return labels_g.reshape(
+        (n_groups * batch,) + labels_g.shape[2:]
+    )[:local_h]
+
+
 def build_sweep(
     clusterer: JaxClusterer,
     config: SweepConfig,
@@ -126,66 +311,18 @@ def build_sweep(
     """
     if mesh is None:
         mesh = resample_mesh([jax.devices()[0]])
-    n_h = mesh.shape[RESAMPLE_AXIS]
-    n_r = mesh.shape[ROW_AXIS]
-    # Optional third axis: k-groups each run the scan over their own
-    # slice of k_values — the reference's SEQUENTIAL K loop
-    # (consensus_clustering_parallelised.py:112) becomes the outermost
-    # parallel dimension.  Meshes without the axis (pre-'k' callers)
-    # behave as k_shards=1.
-    n_k = dict(mesh.shape).get(KSHARD_AXIS, 1)
+    # All padding / K-permutation rules come from the shared geometry
+    # helper (also used by the streaming engine — see SweepGeometry).
+    geo = sweep_geometry(config, mesh, config.n_iterations)
+    n_h, n_r = geo.n_h, geo.n_r
+    n_local, n_pad, h_pad = geo.n_local, geo.n_pad, geo.h_pad
+    n_ks, k_unperm, k_arr = geo.n_ks, geo.k_unperm, geo.k_arr
 
     n = config.n_samples
     h_total = config.n_iterations
     n_sub = config.n_sub
     k_max = config.k_max
     lo, hi = config.pac_idx
-    # Row sharding: each of the n_r devices on the 'n' axis owns n_local
-    # consensus-matrix rows; N is padded so the blocks tile evenly (padded
-    # rows/cols receive no scatters and are cropped after the shard_map).
-    n_local = -(-n // n_r)
-    n_pad = n_local * n_r
-    # Resamples shard over BOTH axes for the clustering work (n_h * n_r
-    # devices); pad H to a multiple and mark padded rows with indices = -1,
-    # which every one-hot builder drops.
-    h_pad = -(-h_total // (n_h * n_r)) * (n_h * n_r)
-    # cluster_batch applies to each device's LOCAL resample shard
-    # (config docs): a value tuned on one layout can silently stop
-    # sub-batching when a wider mesh shrinks the shard below it — say
-    # so, because the symptom (lockstep Lloyd waste returns) looks like
-    # a perf regression, not a config one.
-    local_h_shard = h_pad // (n_h * n_r)
-    if (config.cluster_batch is not None
-            and config.cluster_batch >= local_h_shard):
-        logger.warning(
-            "cluster_batch=%d >= the per-device resample shard (%d of "
-            "H=%d over %d devices): sub-batching is a no-op on this "
-            "mesh layout, equivalent to cluster_batch=None; re-tune at "
-            "the deployment mesh (SweepConfig.cluster_batch docs)",
-            config.cluster_batch, local_h_shard, h_total, n_h * n_r,
-        )
-    # Pad the K list to a multiple of the k-groups with repeats of the
-    # last K (always a valid cluster count); padded slots are redundant
-    # compute on the padding groups and are cropped after the shard_map.
-    n_ks = len(config.k_values)
-    k_local = -(-n_ks // n_k)
-    k_values_pad = tuple(config.k_values) + (config.k_values[-1],) * (
-        k_local * n_k - n_ks
-    )
-    # Optional round-robin K assignment (config.k_interleave): the 'k'
-    # axis shards the scan array in CONTIGUOUS blocks, so laying the
-    # padded list out as [group0's strided picks, group1's, ...] gives
-    # group g exactly k_values_pad[g::n_k] — spreading the slow
-    # beyond-elbow Ks across groups instead of piling them on the tail
-    # block.  k_unperm maps each original K position to its row in the
-    # stacked per-K outputs so callers always see k_values order.
-    if config.k_interleave and n_k > 1:
-        perm = [g + j * n_k for g in range(n_k) for j in range(k_local)]
-        k_values_pad = tuple(k_values_pad[i] for i in perm)
-        k_unperm = np.argsort(np.asarray(perm))
-    else:
-        k_unperm = None
-    k_arr = jnp.asarray(k_values_pad, jnp.int32)
     # Resolve the histogram path NOW, outside the traced program: the
     # kernel-availability probe compiles and runs the Pallas kernel once on
     # the active backend (ops/pallas_hist.py), which must not happen inside
@@ -271,82 +408,10 @@ def build_sweep(
         x_sub = x[jnp.where(indices >= 0, indices, 0)]
 
         def per_k(_, k):
-            key_k = jax.random.fold_in(key_cluster, k)
-            if config.reseed_clusterer_per_resample:
-                keys = jax.vmap(
-                    lambda h: jax.random.fold_in(key_k, h)
-                )(h_global)
-            else:
-                # Reference semantics: every fit re-seeds identically
-                # (fixed random_state per estimator), correlating inits
-                # across resamples — see SweepConfig docs.
-                keys = jnp.broadcast_to(key_k, (local_h,) + key_k.shape)
-            fit_batch = jax.vmap(
-                lambda kk, xs: clusterer.fit_predict(kk, xs, k, k_max)
+            keys = resample_lane_keys(config, key_cluster, k, h_global)
+            labels = fit_resample_lanes(
+                clusterer, config, keys, x_sub, k, k_max
             )
-            batch = config.cluster_batch
-            if batch is None or batch >= local_h:
-                labels = fit_batch(keys, x_sub)
-            else:
-                # Sub-batch the clustering: a vmapped while_loop freezes
-                # converged lanes (selects) but iterates until the batch's
-                # slowest lane converges, so one big batch pays the global
-                # worst case on every lane.  lax.map over groups lets each
-                # group stop at ITS slowest member — labels bit-identical,
-                # lockstep waste reduced, groups serialised.  Group-count
-                # padding repeats row 0 (clustered redundantly, cropped).
-                n_groups = -(-local_h // batch)
-                keys_g = pad_to_lane_groups(keys, batch)
-                x_g = pad_to_lane_groups(x_sub, batch)
-                if config.split_init and hasattr(
-                    clusterer, "init_centroids"
-                ):
-                    # Init has a k-determined trip count (no lockstep
-                    # waste), so run it ONCE over the full lane batch —
-                    # full-width GEMMs — and group only the Lloyd
-                    # while_loop.  Same key derivation, so labels are
-                    # bit-identical to the self-seeding grouped path
-                    # (SweepConfig.split_init).
-                    inits = jax.vmap(
-                        lambda kk, xs: clusterer.init_centroids(
-                            kk, xs, k, k_max
-                        )
-                    )(keys, x_sub)
-                    inits_g = pad_to_lane_groups(inits, batch)
-                    fit_from = jax.vmap(
-                        lambda kk, xs, c0: clusterer.fit_predict(
-                            kk, xs, k, k_max, init_centroids=c0
-                        )
-                    )
-                    labels_g = jax.lax.map(
-                        lambda args: fit_from(*args),
-                        (
-                            keys_g.reshape(
-                                (n_groups, batch) + keys.shape[1:]
-                            ),
-                            x_g.reshape(
-                                (n_groups, batch) + x_sub.shape[1:]
-                            ),
-                            inits_g.reshape(
-                                (n_groups, batch) + inits.shape[1:]
-                            ),
-                        ),
-                    )
-                else:
-                    labels_g = jax.lax.map(
-                        lambda args: fit_batch(*args),
-                        (
-                            keys_g.reshape(
-                                (n_groups, batch) + keys.shape[1:]
-                            ),
-                            x_g.reshape(
-                                (n_groups, batch) + x_sub.shape[1:]
-                            ),
-                        ),
-                    )
-                labels = labels_g.reshape(
-                    (n_groups * batch,) + labels_g.shape[2:]
-                )[:local_h]
             labels = jnp.where(h_valid[:, None], labels, -1)
             labels_row = jax.lax.all_gather(
                 labels, ROW_AXIS, tiled=True, axis=0

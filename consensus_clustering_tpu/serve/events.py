@@ -13,9 +13,12 @@ Events emitted by the service:
 - ``job_submitted``   — admission accepted (fields: job_id, fingerprint,
   shape, cached)
 - ``job_started``     — worker picked the job up (job_id, attempt)
-- ``k_batch_complete``— a K finished inside the compiled sweep (job_id,
-  k, pac); fed by the ``progress_callback`` plumbing ``api.py`` already
-  exposes, forwarded through the executor's per-job dispatcher
+- ``h_block_complete``— a streamed H-block's curves landed (job_id,
+  block, h_done, pac_area): the per-block progress of the streaming
+  sweep engine, the signs-of-life signal for a long job
+- ``k_batch_complete``— per-K PAC at sweep completion (job_id, k, pac);
+  emitted host-side by the executor once per K (the streaming driver
+  owns the final curves, so no staged debug callback is involved)
 - ``job_done``        — result stored (job_id, fingerprint, seconds)
 - ``job_retry``       — transient failure, will re-run (job_id, attempt,
   backoff_seconds, error)

@@ -3,29 +3,31 @@
 A batch CLI run pays the full JAX trace + XLA-compile cost on every
 process start.  A long-lived service should pay it once per *shape
 bucket* — the tuple of everything that determines the compiled program:
-(N, d, K_range, H) plus the semantics-bearing sweep statics (bins,
-subsampling, dtype, clusterer, ...) but NOT the seed or the data values,
-which are runtime inputs.  This executor keeps two cache layers:
+(N, d, K_range) plus the semantics-bearing sweep statics (bins,
+subsampling, dtype, clusterer, block size, ...) but NOT the seed, the
+data values, or — since the executor runs the streaming H-block engine
+(:class:`~consensus_clustering_tpu.parallel.streaming.StreamingSweep`)
+— the resample count H, which is a traced runtime scalar of the block
+program.  **One warm executable serves ANY ``iterations``**: two jobs
+differing only in H share a bucket, proven live by the
+``executable_cache_hits``/``_misses`` counters ``/metrics`` exposes.
+The executor keeps two cache layers:
 
-- **in-process executable cache** — ``build_sweep(...).lower(...).
-  compile()`` keyed by shape bucket, so the second job at a given bucket
-  skips tracing *and* compilation entirely and goes straight to
-  execution;
+- **in-process engine cache** — a warm :class:`StreamingSweep` per
+  bucket (its jit cache holds the compiled block), so the second job at
+  a bucket skips tracing *and* compilation entirely;
 - **persistent XLA compilation cache** — ``utils.platform.
   enable_compilation_cache()`` — so even the first job after a process
   restart hits disk instead of recompiling (tracing is re-paid, compile
   — the dominant cost at these shapes — is not).
 
-Per-K progress events ride the existing ``progress_callback`` plumbing
-(``parallel.sweep.build_sweep`` stages a ``jax.debug.callback`` after
-each K's scan step).  Because the callback is baked into the cached
-executable, the executor bakes in one *dispatcher* and redirects it to
-the current job's callback at run time; per-execution dedup (shard_map
-replicates effects per device) happens here.  After a job timeout the
-slot is cleared, so a still-running abandoned execution's events are
-dropped; if the SAME bucket is re-run while an abandoned execution is
-still live, its stragglers may briefly attribute to the new job — an
-accepted, documented corner of the timeout design.
+Progress events are host-side now: the streaming driver owns every
+block's curves on the host, so per-block events (``h_block_complete``)
+and the once-per-K ``k_batch_complete`` events at completion are plain
+function calls — no ``jax.debug.callback`` baked into the executable,
+no per-device dedup.  A generation token still guards them: after a job
+timeout the abandoned thread's late emissions find a newer generation
+and are dropped.
 """
 
 from __future__ import annotations
@@ -49,7 +51,17 @@ _CONFIG_KEYS = frozenset(
         "k", "iterations", "subsampling", "seed", "clusterer",
         "clusterer_options", "bins", "pac_interval", "parity_zeros",
         "analysis", "delta_k_threshold", "dtype", "chunk_size",
+        "stream_h_block", "adaptive_tol", "adaptive_patience",
+        "adaptive_min_h",
     }
+)
+
+# Spec fields that never enter the executable bucket: runtime inputs to
+# the compiled block program (seed, H) or host-side driver/post-
+# processing knobs (analysis selection, adaptive early stop).
+_RUNTIME_FIELDS = (
+    "seed", "analysis", "delta_k_threshold", "n_iterations",
+    "adaptive_tol", "adaptive_patience", "adaptive_min_h",
 )
 
 
@@ -79,6 +91,12 @@ class JobSpec:
     delta_k_threshold: float = 0.05
     dtype: str = "float32"
     chunk_size: int = 8
+    # None -> the executor's default block size; the resolved value is
+    # part of the executable bucket (it shapes the block program).
+    stream_h_block: Optional[int] = None
+    adaptive_tol: Optional[float] = None
+    adaptive_patience: int = 2
+    adaptive_min_h: int = 0
 
     def fingerprint_payload(self) -> Dict[str, Any]:
         """The JSON payload hashed into the job fingerprint.
@@ -95,17 +113,22 @@ class JobSpec:
         payload["clusterer_options"] = dict(self.clusterer_options)
         return payload
 
-    def bucket(self, n: int, d: int) -> str:
-        """The executable-cache key: fingerprint payload minus the seed
-        (a runtime input to the compiled program) and minus the fields
-        that only steer host-side post-processing (``analysis`` /
-        ``delta_k_threshold`` feed ``select_best_k`` after the sweep
-        returns — two jobs differing only there share one executable),
-        plus the data shape."""
+    def bucket(self, n: int, d: int, h_block: Optional[int] = None) -> str:
+        """The executable-cache key: fingerprint payload minus every
+        runtime field — the seed and, because the executor streams the
+        sweep in H-blocks, ``iterations`` itself (H is a traced scalar
+        of the block program, so jobs differing only in H share one
+        warm executable) — minus the fields that only steer the
+        host-side driver or post-processing (adaptive early stop;
+        ``analysis``/``delta_k_threshold`` feed ``select_best_k`` after
+        the sweep returns), plus the data shape and the RESOLVED block
+        size (``h_block`` overrides an unset ``stream_h_block``; the
+        block size shapes the compiled program)."""
         payload = self.fingerprint_payload()
-        payload.pop("seed")
-        payload.pop("analysis")
-        payload.pop("delta_k_threshold")
+        for field in _RUNTIME_FIELDS:
+            payload.pop(field)
+        if payload["stream_h_block"] is None:
+            payload["stream_h_block"] = h_block
         payload["shape"] = [int(n), int(d)]
         return json.dumps(payload, sort_keys=True)
 
@@ -223,6 +246,26 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
             f"config.pac_interval must be [lo, hi] with 0 <= lo < hi <= 1, "
             f"got {pac_interval!r}"
         )
+    stream_h_block = cfg.get("stream_h_block")
+    if stream_h_block is not None and (
+        not isinstance(stream_h_block, int)
+        or isinstance(stream_h_block, bool)
+        or not 1 <= stream_h_block <= 100_000
+    ):
+        raise JobSpecError(
+            f"config.stream_h_block must be an int in [1, 100000], got "
+            f"{stream_h_block!r}"
+        )
+    adaptive_tol = cfg.get("adaptive_tol")
+    if adaptive_tol is not None and (
+        not isinstance(adaptive_tol, (int, float))
+        or isinstance(adaptive_tol, bool)
+        or adaptive_tol < 0
+    ):
+        raise JobSpecError(
+            f"config.adaptive_tol must be a number >= 0, got "
+            f"{adaptive_tol!r}"
+        )
     spec = JobSpec(
         k_values=tuple(int(k) for k in k_values),
         n_iterations=_int("iterations", 25, 2, 100_000),
@@ -237,34 +280,57 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         delta_k_threshold=float(threshold),
         dtype=dtype,
         chunk_size=_int("chunk_size", 8, 1, 4096),
+        stream_h_block=stream_h_block,
+        adaptive_tol=(
+            None if adaptive_tol is None else float(adaptive_tol)
+        ),
+        adaptive_patience=_int("adaptive_patience", 2, 1, 1000),
+        adaptive_min_h=_int("adaptive_min_h", 0, 0, 100_000),
     )
     return spec, x
 
 
 class SweepExecutor:
-    """Runs validated jobs as compiled sweeps, caching executables.
+    """Runs validated jobs as streamed compiled sweeps, caching engines.
 
     ``run_count`` counts actual sweep executions — the jobstore-dedup
     test asserts it does NOT advance when a duplicate submission is
-    served from the store.
+    served from the store.  ``executable_cache_hits``/``_misses`` count
+    bucket lookups (a miss pays the block-program compile; H is not in
+    the bucket, so jobs differing only in ``iterations`` hit), and
+    ``h_requested_total``/``h_effective_total`` accumulate, over
+    SUCCESSFUL executions, each job's resample budget vs what the
+    adaptive driver actually ran — the ``/metrics`` view of both
+    streaming wins (their difference is the adaptive saving, which is
+    why failed attempts advance neither).
     """
 
-    def __init__(self, use_compilation_cache: bool = True):
+    def __init__(
+        self,
+        use_compilation_cache: bool = True,
+        default_h_block: int = 32,
+    ):
+        if default_h_block < 1:
+            raise ValueError(
+                f"default_h_block must be >= 1, got {default_h_block}"
+            )
+        self.default_h_block = default_h_block
         self.run_count = 0
         self.executable_cache_hits = 0
-        self._compiled: Dict[str, Any] = {}
+        self.executable_cache_misses = 0
+        self.h_requested_total = 0
+        self.h_effective_total = 0
+        self._engines: Dict[str, Any] = {}
         self._lock = threading.Lock()
         # Serialises build+compile per process, separate from _lock: a
         # timed-out job's abandoned thread and the next job can reach
-        # _get_compiled concurrently, and holding _lock for a
-        # minutes-long compile would stall the progress _dispatch of
-        # whatever is still running.
+        # _get_engine concurrently, and holding _lock for a minutes-long
+        # compile would stall the event emission of whatever is still
+        # running.
         self._compile_lock = threading.Lock()
-        self._job_cb: Optional[Callable[[int, float], None]] = None
-        self._seen: set = set()
-        # Generation counter for the progress slot: an abandoned
-        # (timed-out) execution's cleanup must not clear the slot out
-        # from under the job that owns it now.
+        # Generation counter for host-side event emission: an abandoned
+        # (timed-out) execution's late block/K events must find a newer
+        # generation and drop themselves.
         self._cb_gen = 0
         self.compilation_cache_dir = None
         if use_compilation_cache:
@@ -289,6 +355,10 @@ class SweepExecutor:
     # -- executable cache ------------------------------------------------
 
     def _config_for(self, spec: JobSpec, n: int, d: int) -> SweepConfig:
+        # n_iterations is a placeholder here: the streaming engine takes
+        # H at run() time (traced scalar); nothing compiled depends on
+        # it.  The adaptive knobs live in the driver, also outside the
+        # executable — both are why the bucket can drop them.
         return SweepConfig(
             n_samples=n,
             n_features=d,
@@ -300,6 +370,10 @@ class SweepExecutor:
             parity_zeros=spec.parity_zeros,
             store_matrices=False,  # serving results are curves-only JSON
             chunk_size=spec.chunk_size,
+            stream_h_block=spec.stream_h_block or self.default_h_block,
+            # Adaptive knobs deliberately NOT baked: the cached engine
+            # is shared by every job in the bucket, and run() takes them
+            # as per-job overrides.
             dtype=spec.dtype,
         )
 
@@ -327,20 +401,8 @@ class SweepExecutor:
         except (TypeError, ValueError) as e:
             raise JobSpecError(str(e))
 
-    def _dispatch(self, k, pac):
-        """The one progress callback baked into every cached executable;
-        redirects to the current job's callback with per-execution k
-        dedup (shard_map replicates effects per device)."""
-        kk = int(k)
-        with self._lock:
-            cb = self._job_cb
-            if cb is None or kk in self._seen:
-                return
-            self._seen.add(kk)
-        cb(kk, float(pac))
-
-    def _get_compiled(self, spec: JobSpec, n: int, d: int):
-        """(compiled, build_compile_seconds, cached) for the bucket.
+    def _get_engine(self, spec: JobSpec, n: int, d: int):
+        """(engine, build_compile_seconds, cached) for the bucket.
 
         Reachable from two threads at once (a timed-out job's abandoned
         thread plus the next job's fresh one), so the whole
@@ -348,49 +410,48 @@ class SweepExecutor:
         the race blocks and then hits the cache instead of paying a
         duplicate minutes-long compile serialized behind one device.
         """
-        import jax.numpy as jnp
-
-        key = spec.bucket(n, d)
+        key = spec.bucket(n, d, self.default_h_block)
         with self._compile_lock:
-            hit = self._compiled.get(key)
+            hit = self._engines.get(key)
             if hit is not None:
                 with self._lock:
                     self.executable_cache_hits += 1
                 return hit, 0.0, True
-            from consensus_clustering_tpu.parallel.sweep import build_sweep
+            from consensus_clustering_tpu.parallel.streaming import (
+                StreamingSweep,
+            )
 
             t0 = time.perf_counter()
-            sweep = build_sweep(
+            engine = StreamingSweep(
                 self._clusterer_for(spec),
                 self._config_for(spec, n, d),
-                progress_callback=self._dispatch,
             )
-            xz = jnp.zeros((n, d), jnp.dtype(spec.dtype))
-            import jax
-
-            compiled = sweep.lower(xz, jax.random.PRNGKey(0)).compile()
-            # This delta times trace+compile, and .compile() blocks on
-            # the host until XLA returns; the only device ops in the
-            # region are the zeros placeholder and the PRNGKey constant,
-            # which lower() consumes synchronously — no async execution
-            # to barrier on.
-            seconds = time.perf_counter() - t0  # jaxlint: disable=JL007
-            self._compiled[key] = compiled
-            return compiled, seconds, False
+            # warmup() runs one all-masked block on zeros: trace + XLA
+            # compile + a trivial execution, the cheapest way to
+            # populate the engine's jit cache with the exact program
+            # every later block (at ANY H) reuses.  The curves copy
+            # inside warmup is the completion barrier.
+            engine.warmup()
+            seconds = time.perf_counter() - t0
+            self._engines[key] = engine
+            with self._lock:
+                self.executable_cache_misses += 1
+            return engine, seconds, False
 
     def warmup(self, spec: JobSpec, n: int, d: int) -> float:
-        """Pre-compile the executable for a shape bucket; returns the
-        build+compile wall-clock (0.0 when already warm)."""
-        _, seconds, _ = self._get_compiled(spec, n, d)
+        """Pre-compile the block executable for a shape bucket; returns
+        the build+compile wall-clock (0.0 when already warm).  One
+        warmup covers every H at the shape — the executable is
+        H-agnostic."""
+        _, seconds, _ = self._get_engine(spec, n, d)
         return seconds
 
     def cancel_events(self) -> None:
-        """Drop the current job's progress slot (called on job timeout so
-        an abandoned execution's stragglers are not emitted)."""
+        """Invalidate the current job's event generation (called on job
+        timeout so an abandoned execution's late block/K events are
+        dropped, not attributed to a newer job)."""
         with self._lock:
             self._cb_gen += 1
-            self._job_cb = None
-            self._seen = set()
 
     # -- execution -------------------------------------------------------
 
@@ -399,11 +460,17 @@ class SweepExecutor:
         spec: JobSpec,
         x: np.ndarray,
         progress_cb: Optional[Callable[[int, float], None]] = None,
+        block_cb: Optional[Callable[[int, int, list], None]] = None,
     ) -> Dict[str, Any]:
-        """Execute one sweep; returns the JSON-able serving result."""
-        import jax
-        import jax.numpy as jnp
+        """Execute one streamed sweep; returns the JSON-able result.
 
+        ``progress_cb(k, pac)`` fires once per K when the sweep
+        completes (the curves are host-side in the streaming driver — no
+        staged debug callback, no per-device dedup); ``block_cb(block,
+        h_done, pac_list)`` fires per streamed H-block.  Both are
+        generation-guarded: after a timeout's :meth:`cancel_events`, an
+        abandoned execution's stragglers are silently dropped.
+        """
         from consensus_clustering_tpu.ops.analysis import (
             area_under_cdf,
             delta_k,
@@ -411,37 +478,54 @@ class SweepExecutor:
         )
 
         n, d = x.shape
-        compiled, compile_seconds, cached = self._get_compiled(spec, n, d)
+        engine, compile_seconds, cached = self._get_engine(spec, n, d)
 
         with self._lock:
             self._cb_gen += 1
             gen = self._cb_gen
-            self._job_cb = progress_cb
-            self._seen = set()
+
+        def _live() -> bool:
+            with self._lock:
+                return self._cb_gen == gen
+
+        guarded_block_cb = None
+        if block_cb is not None:
+            def guarded_block_cb(block, h_done, pac_list):
+                if _live():
+                    block_cb(block, h_done, pac_list)
+
         try:
-            xj = jnp.asarray(x, jnp.dtype(spec.dtype))
-            key = jax.random.PRNGKey(spec.seed)
             t0 = time.perf_counter()
-            out = compiled(xj, key)
-            # Host copy is the completion barrier (run_sweep's rule: on
-            # some platforms block_until_ready returns early).
-            host = jax.tree.map(np.asarray, out)
+            host = engine.run(
+                x, spec.seed, spec.n_iterations,
+                block_callback=guarded_block_cb,
+                adaptive_tol=spec.adaptive_tol,
+                adaptive_patience=spec.adaptive_patience,
+                adaptive_min_h=spec.adaptive_min_h,
+            )
+            # engine.run's curves copies are the completion barrier
+            # (run_sweep's rule: block_until_ready can return early on
+            # some platforms).
             run_seconds = time.perf_counter() - t0
-            if progress_cb is not None:
-                # Debug-callback effects are asynchronous; drain them so
-                # every per-K event lands before job_done.
-                jax.effects_barrier()
         finally:
             with self._lock:
-                # Only the slot's current owner may clear it: an abandoned
-                # timed-out execution finishing late finds a newer gen and
-                # leaves the live job's callback alone.
-                if self._cb_gen == gen:
-                    self._job_cb = None
                 self.run_count += 1
+
+        streaming = host["streaming"]
+        with self._lock:
+            # Both totals advance together, on SUCCESSFUL executions
+            # only: if requested were counted per attempt (retries,
+            # timeouts) while effective counted per success, their
+            # difference would read as adaptive savings that never
+            # happened (/metrics documents exactly that difference).
+            self.h_requested_total += int(spec.n_iterations)
+            self.h_effective_total += int(streaming["h_effective"])
 
         ks = list(spec.k_values)
         pac = [float(v) for v in host["pac_area"]]
+        if progress_cb is not None and _live():
+            for k, p in zip(ks, pac):
+                progress_cb(int(k), float(p))
         areas = np.asarray(
             [float(area_under_cdf(host["cdf"][i])) for i in range(len(ks))]
         )
@@ -460,11 +544,25 @@ class SweepExecutor:
             "best_k": int(best_k),
             "analysis": spec.analysis,
             "backend": self.backend(),
+            # Top-level so a /metrics-style consumer need not know the
+            # streaming schema to see the adaptive win per job.
+            "h_effective": int(streaming["h_effective"]),
+            "streaming": {
+                "h_block": int(streaming["h_block"]),
+                "h_requested": int(streaming["h_requested"]),
+                "h_effective": int(streaming["h_effective"]),
+                "n_blocks_run": int(streaming["n_blocks_run"]),
+                "stopped_early": bool(streaming["stopped_early"]),
+                "pac_trajectory": streaming["pac_trajectory"],
+            },
             "timings": {
                 "compile_seconds": compile_seconds,
                 "run_seconds": run_seconds,
-                "resamples_per_second": spec.n_iterations * len(ks)
-                / max(run_seconds, 1e-9),
+                # Rate over resamples actually RUN: an adaptive job's
+                # r/s stays a true throughput, not budget-skipped
+                # inflation.
+                "resamples_per_second": streaming["h_effective"]
+                * len(ks) / max(run_seconds, 1e-9),
                 "executable_cached": cached,
             },
         }

@@ -231,6 +231,21 @@ class Scheduler:
                 "jobs_timed_out": self.jobs_timed_out,
                 "cache_hits": self.cache_hits,
                 "executable_cache_hits": self.executor.executable_cache_hits,
+                # The H-agnostic bucket win, observable: misses count
+                # block-program compiles, and hits/misses together show
+                # jobs differing only in H sharing one warm executable.
+                # getattr keeps duck-typed stub executors valid.
+                "executable_cache_misses": getattr(
+                    self.executor, "executable_cache_misses", 0
+                ),
+                # Adaptive early stop, aggregated: resamples requested
+                # vs actually run across every executed job.
+                "h_requested_total": getattr(
+                    self.executor, "h_requested_total", 0
+                ),
+                "h_effective_total": getattr(
+                    self.executor, "h_effective_total", 0
+                ),
                 "sweeps_executed": self.executor.run_count,
                 "backend": self.executor.backend(),
             }
@@ -253,21 +268,26 @@ class Scheduler:
                 self._jobs.pop(job_id, None)
         return snapshot
 
-    def _run_with_timeout(self, spec: JobSpec, x, progress_cb):
+    def _run_with_timeout(self, spec: JobSpec, x, progress_cb, block_cb):
         """Run the executor, bounding wall-clock with a per-job thread.
 
-        A compiled XLA program has no cancellation point, so on timeout
-        the job thread is abandoned (daemon; it dies with the process)
-        and its progress slot cleared — see the executor docstring for
-        the event-attribution corner this accepts.
+        A compiled XLA program has no cancellation point (the streaming
+        driver does check between blocks, but a single block can still
+        be long), so on timeout the job thread is abandoned (daemon; it
+        dies with the process) and its event generation invalidated —
+        see the executor docstring for the attribution corner this
+        accepts.
         """
+        kwargs = {} if block_cb is None else {"block_cb": block_cb}
         if self.job_timeout is None:
-            return self.executor.run(spec, x, progress_cb)
+            return self.executor.run(spec, x, progress_cb, **kwargs)
         box: Dict[str, Any] = {}
 
         def _target():
             try:
-                box["result"] = self.executor.run(spec, x, progress_cb)
+                box["result"] = self.executor.run(
+                    spec, x, progress_cb, **kwargs
+                )
             except BaseException as e:  # noqa: BLE001 — reraised below
                 box["error"] = e
 
@@ -324,6 +344,19 @@ class Scheduler:
                 "k_batch_complete", job_id=job_id, k=k, pac=pac
             )
 
+        def block_cb(block: int, h_done: int, pac_list) -> None:
+            # Per-streamed-block progress from the H-block driver: the
+            # signs-of-life signal for a long job, at block resolution.
+            self.events.emit(
+                "h_block_complete", job_id=job_id, block=block,
+                h_done=h_done, pac_area=pac_list,
+            )
+
+        # Duck-typed executors (test stubs) may not stream; only a real
+        # streaming executor gets the per-block callback.
+        if not hasattr(self.executor, "default_h_block"):
+            block_cb = None
+
         for attempt in range(self.max_retries + 1):
             self._update(
                 job_id, status="running", attempt=attempt,
@@ -332,7 +365,9 @@ class Scheduler:
             self.events.emit("job_started", job_id=job_id, attempt=attempt)
             t0 = time.perf_counter()
             try:
-                result = self._run_with_timeout(spec, x, progress_cb)
+                result = self._run_with_timeout(
+                    spec, x, progress_cb, block_cb
+                )
             except JobTimeout as e:
                 with self._lock:
                     self.jobs_timed_out += 1

@@ -284,6 +284,55 @@ def draw(key):
     assert "JL001" in rule_ids(active)
 
 
+def test_donated_streaming_driver_is_clean(tmp_path):
+    # The streaming engine's donated-argnum idiom
+    # (parallel/streaming.py): jit bound ONCE with donate_argnums at
+    # function scope, then a host driver loop that re-passes the SAME
+    # master key and the donated state every block.  The key is never
+    # consumed by jax.random on the host (the traced body splits it) and
+    # the jit is never rebuilt per iteration — JL001 and JL004 must both
+    # stay silent, or every streaming engine needs suppressions.
+    active, _ = lint_source(tmp_path, """
+class Engine:
+    def __init__(self):
+        def step(state, x, key, h_start):
+            key_resample, key_cluster = jax.random.split(key)
+            delta = jax.random.normal(key_resample, x.shape)
+            return state + delta + h_start, jnp.sum(state)
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def run(self, x, key, n_blocks):
+        state = jnp.zeros_like(x)
+        curves = []
+        for b in range(n_blocks):
+            state, c = self._step(state, x, key, jnp.int32(b))
+            curves.append(np.asarray(c))
+        return curves
+""")
+    assert "JL001" not in rule_ids(active), [
+        (f.rule, f.line, f.message) for f in active
+    ]
+    assert "JL004" not in rule_ids(active), [
+        (f.rule, f.line, f.message) for f in active
+    ]
+
+
+def test_donated_jit_in_loop_still_fires_jl004(tmp_path):
+    # The donation-aware allowance must not swallow the REAL hazard:
+    # rebuilding the donated jit inside the driver loop is still a
+    # retrace per block.
+    active, _ = lint_source(tmp_path, """
+def run(x, key, n_blocks):
+    state = jnp.zeros_like(x)
+    for b in range(n_blocks):
+        step = jax.jit(lambda s, v: s + v, donate_argnums=(0,))
+        state = step(state, x)
+    return state
+""")
+    assert "JL004" in rule_ids(active)
+
+
 def test_module_level_jit_lambda_is_fine(tmp_path):
     # Evaluated once at import; its cache persists — not retrace-per-call.
     active, _ = lint_source(tmp_path, """

@@ -207,6 +207,111 @@ def test_unknown_routes_and_jobs_404(base):
     assert _req(base, "/jobs/deadbeef")[0] == 404
 
 
+def test_h_agnostic_bucket_serves_two_h_from_one_compile(tmp_path):
+    """Acceptance criterion: two jobs differing ONLY in H share one
+    compiled entry — the streaming block program takes H as a traced
+    scalar, so the executable bucket drops ``iterations``.  Proven by
+    the hit/miss counters /metrics now exposes."""
+    ex = SweepExecutor(use_compilation_cache=False)
+    svc = ConsensusService(
+        store_dir=str(tmp_path / "store"), port=0, executor=ex,
+    ).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        rng = np.random.default_rng(9)
+        body_a = _job_body(rng, n=24, d=3, k=(2,), iters=6, seed=1)
+        body_b = dict(body_a)
+        body_b["config"] = dict(body_a["config"], iterations=11)
+
+        _, rec_a, _ = _req(base, "/jobs", body_a)
+        done_a = _poll(base, rec_a["job_id"])
+        assert done_a["status"] == "done"
+        _, rec_b, _ = _req(base, "/jobs", body_b)
+        done_b = _poll(base, rec_b["job_id"])
+        assert done_b["status"] == "done"
+
+        code, m, _ = _req(base, "/metrics")
+        assert code == 200
+        # ONE block-program compile, then a warm hit for the second H.
+        assert m["executable_cache_misses"] == 1
+        assert m["executable_cache_hits"] >= 1
+        assert m["sweeps_executed"] == 2
+        # Per-job h_effective is observable in each result, and the
+        # aggregate counters tie out with the two non-adaptive runs.
+        assert done_a["result"]["h_effective"] == 6
+        assert done_b["result"]["h_effective"] == 11
+        assert m["h_requested_total"] == 17
+        assert m["h_effective_total"] == 17
+    finally:
+        svc.stop()
+
+
+def test_adaptive_job_reports_h_effective_below_budget(tmp_path):
+    """An adaptive job on a stable input stops early; the result's
+    h_effective and the /metrics aggregate both show it, and the
+    per-block h_block_complete events land in the JSONL log."""
+    ex = SweepExecutor(use_compilation_cache=False)
+    events_path = str(tmp_path / "ev.jsonl")
+    svc = ConsensusService(
+        store_dir=str(tmp_path / "store"), port=0, executor=ex,
+        events_path=events_path,
+    ).start()
+    base = f"http://127.0.0.1:{svc.port}"
+    try:
+        rng = np.random.default_rng(10)
+        half = 15
+        x = np.concatenate([
+            rng.normal(0.0, 0.2, (half, 3)),
+            rng.normal(6.0, 0.2, (half, 3)),
+        ])
+        body = {
+            "data": x.tolist(),
+            "config": {
+                "k": [2], "iterations": 40, "seed": 4,
+                "stream_h_block": 5, "adaptive_tol": 0.02,
+                "adaptive_min_h": 10,
+            },
+        }
+        _, rec, _ = _req(base, "/jobs", body)
+        done = _poll(base, rec["job_id"])
+        assert done["status"] == "done"
+        result = done["result"]
+        assert result["streaming"]["stopped_early"] is True
+        assert result["h_effective"] < 40
+        code, m, _ = _req(base, "/metrics")
+        assert m["h_effective_total"] < m["h_requested_total"] == 40
+
+        with open(events_path) as f:
+            events = [json.loads(line) for line in f]
+        blocks = [
+            e for e in events
+            if e.get("job_id") == rec["job_id"]
+            and e["event"] == "h_block_complete"
+        ]
+        assert blocks, "per-block progress events missing"
+        assert blocks[0]["h_done"] == 5
+        assert all("pac_area" in e for e in blocks)
+    finally:
+        svc.stop()
+
+
+def test_bad_streaming_config_rejected(base):
+    for body, why in [
+        ({"data": [[1, 2], [3, 4], [5, 6]],
+          "config": {"stream_h_block": 0}},
+         "stream_h_block below 1"),
+        ({"data": [[1, 2], [3, 4], [5, 6]],
+          "config": {"adaptive_tol": -0.5}},
+         "negative adaptive_tol"),
+        ({"data": [[1, 2], [3, 4], [5, 6]],
+          "config": {"adaptive_patience": 0}},
+         "adaptive_patience below 1"),
+    ]:
+        code, rec, _ = _req(base, "/jobs", body)
+        assert code == 400, why
+        assert "error" in rec
+
+
 # ---------------------------------------------------------------------------
 # Scheduler semantics against a stub executor (no compiles)
 
@@ -464,6 +569,30 @@ def test_bucket_ignores_host_side_analysis_fields():
     n, d = x.shape
     assert pac.bucket(n, d) == dk.bucket(n, d)
     assert pac.fingerprint_payload() != dk.fingerprint_payload()
+
+
+def test_bucket_drops_h_and_adaptive_knobs():
+    """H is a traced runtime scalar of the streaming block program and
+    the adaptive knobs steer only the host driver: neither may split
+    the executable bucket — but both MUST split the result
+    fingerprint (different H / early-stop settings are different
+    results)."""
+    base_body = {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
+                 "config": {"k": [2], "iterations": 10}}
+    a, x = parse_job_spec(base_body)
+    b, _ = parse_job_spec(
+        {**base_body,
+         "config": {"k": [2], "iterations": 77, "adaptive_tol": 0.05,
+                    "adaptive_min_h": 20}}
+    )
+    n, d = x.shape
+    assert a.bucket(n, d, 32) == b.bucket(n, d, 32)
+    assert a.fingerprint_payload() != b.fingerprint_payload()
+    # An explicit block size DOES shape the compiled program.
+    c, _ = parse_job_spec(
+        {**base_body, "config": {"k": [2], "stream_h_block": 8}}
+    )
+    assert c.bucket(n, d, 32) != a.bucket(n, d, 32)
 
 
 def test_restart_reconciliation_fails_orphaned_jobs(tmp_path):

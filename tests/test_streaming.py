@@ -1,0 +1,314 @@
+"""Streaming H-block engine: full-H parity, adaptive early stop,
+H-agnostic executable, validation."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.streaming import (
+    StreamingSweep,
+    run_streaming_sweep,
+)
+from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+
+def _config(x, **kw):
+    defaults = dict(
+        n_samples=x.shape[0],
+        n_features=x.shape[1],
+        k_values=(2, 3, 4),
+        n_iterations=13,
+        subsampling=0.8,
+    )
+    defaults.update(kw)
+    return SweepConfig(**defaults)
+
+
+_PARITY_KEYS = ("mij", "iij", "cij", "hist", "cdf", "pac_area")
+
+
+class TestFullHParity:
+    def test_bit_identical_single_device(self, blobs):
+        # The acceptance bar: streamed full-H equals build_sweep bit for
+        # bit — matrices included.  h_block=5 does not divide H=13, so
+        # the final partial block's masking is exercised too.
+        x, _ = blobs
+        config = _config(x)
+        mono = run_sweep(KMeans(n_init=2), config, x, seed=7)
+        stream = run_streaming_sweep(
+            KMeans(n_init=2),
+            dataclasses.replace(config, stream_h_block=5), x, seed=7,
+        )
+        for name in _PARITY_KEYS:
+            np.testing.assert_array_equal(
+                mono[name], stream[name], err_msg=name
+            )
+        s = stream["streaming"]
+        assert s["h_effective"] == 13 and not s["stopped_early"]
+        assert s["n_blocks_run"] == 3
+        assert len(s["pac_trajectory"]) == 3
+
+    def test_bit_identical_on_khn_mesh(self, blobs):
+        # Full ('k', 'h', 'n') mesh: the donated state carries the same
+        # row-sharded layout the monolithic program uses, and block
+        # boundaries still cannot change any draw.
+        x, _ = blobs
+        config = _config(x, n_iterations=16)
+        mono = run_sweep(
+            KMeans(n_init=2), config, x, seed=5,
+            mesh=resample_mesh(jax.devices()[:1]),
+        )
+        mesh = resample_mesh(k_shards=2, row_shards=2)
+        stream = run_streaming_sweep(
+            KMeans(n_init=2),
+            dataclasses.replace(config, stream_h_block=6), x, seed=5,
+            mesh=mesh,
+        )
+        for name in _PARITY_KEYS:
+            np.testing.assert_array_equal(
+                mono[name], stream[name], err_msg=name
+            )
+
+    @pytest.mark.slow
+    def test_block_size_invariance(self, blobs):
+        # Any block size gives the same full-H answer: the accumulators
+        # are exact integers and every draw folds the global index.
+        x, _ = blobs
+        config = _config(x, store_matrices=False)
+        ref = run_streaming_sweep(
+            KMeans(n_init=2),
+            dataclasses.replace(config, stream_h_block=13), x, seed=3,
+        )
+        for block in (1, 4):
+            out = run_streaming_sweep(
+                KMeans(n_init=2),
+                dataclasses.replace(config, stream_h_block=block),
+                x, seed=3,
+            )
+            np.testing.assert_array_equal(
+                ref["pac_area"], out["pac_area"]
+            )
+            np.testing.assert_array_equal(ref["cdf"], out["cdf"])
+
+    @pytest.mark.slow
+    def test_cluster_batch_composes(self, blobs):
+        # The shared fit_resample_lanes path: sub-batched streaming
+        # equals the unbatched monolithic sweep bit for bit.
+        x, _ = blobs
+        config = _config(x)
+        mono = run_sweep(KMeans(n_init=2), config, x, seed=3)
+        stream = run_streaming_sweep(
+            KMeans(n_init=2),
+            dataclasses.replace(
+                config, stream_h_block=7, cluster_batch=3
+            ),
+            x, seed=3,
+        )
+        for name in _PARITY_KEYS:
+            np.testing.assert_array_equal(
+                mono[name], stream[name], err_msg=name
+            )
+
+
+class TestHAgnosticExecutable:
+    def test_one_compile_serves_any_h(self, blobs):
+        # H enters the block program as a traced scalar: running the
+        # same engine at a different n_iterations must not add a jit
+        # cache entry — the compile-cache win the serve bucket banks on.
+        x, _ = blobs
+        config = _config(x, store_matrices=False, stream_h_block=6)
+        engine = StreamingSweep(KMeans(n_init=2), config)
+        engine.warmup(x)
+        traces = engine._step._cache_size()
+        out_a = engine.run(x, seed=0, n_iterations=9)
+        out_b = engine.run(x, seed=0, n_iterations=17)
+        assert engine._step._cache_size() == traces == 1
+        assert out_a["streaming"]["h_effective"] == 9
+        assert out_b["streaming"]["h_effective"] == 17
+        # And the H-agnostic program still matches the monolithic
+        # engine compiled specifically for each H.
+        mono = run_sweep(
+            KMeans(n_init=2),
+            _config(x, store_matrices=False, n_iterations=17),
+            x, seed=0,
+        )
+        np.testing.assert_array_equal(
+            mono["pac_area"], out_b["pac_area"]
+        )
+
+    def test_adaptive_knobs_are_runtime_overrides(self, blobs):
+        # The serve executor shares one engine across jobs with
+        # different early-stop settings: run() must honour per-run
+        # overrides without re-tracing.
+        x, _ = blobs
+        config = _config(x, store_matrices=False, stream_h_block=4)
+        engine = StreamingSweep(KMeans(n_init=2), config)
+        full = engine.run(x, seed=1, n_iterations=12)
+        assert not full["streaming"]["stopped_early"]
+        adaptive = engine.run(
+            x, seed=1, n_iterations=12,
+            adaptive_tol=10.0, adaptive_patience=1,
+        )
+        assert adaptive["streaming"]["stopped_early"]
+        assert engine._step._cache_size() == 1
+
+
+class TestAdaptiveEarlyStop:
+    @pytest.fixture(scope="class")
+    def stable(self):
+        """Well-separated blobs: PAC is ~0 and flat from the first
+        blocks — the stable synthetic config of the acceptance bar."""
+        rng = np.random.default_rng(0)
+        x = np.concatenate([
+            rng.normal(0.0, 0.2, (30, 4)), rng.normal(5.0, 0.2, (30, 4)),
+        ]).astype(np.float32)
+        return x
+
+    def test_stops_early_within_tol_of_full_h(self, stable):
+        x = stable
+        h = 60
+        full_config = _config(
+            x, k_values=(2, 3), n_iterations=h, store_matrices=False,
+        )
+        full = run_sweep(KMeans(n_init=2), full_config, x, seed=11)
+        tol = 0.02
+        out = run_streaming_sweep(
+            KMeans(n_init=2),
+            dataclasses.replace(
+                full_config, stream_h_block=5, adaptive_tol=tol,
+                adaptive_patience=2, adaptive_min_h=10,
+            ),
+            x, seed=11,
+        )
+        s = out["streaming"]
+        assert s["stopped_early"]
+        assert s["h_effective"] < h
+        assert s["h_effective"] >= 10
+        # The early answer is within tolerance of the full-H answer.
+        delta = np.max(
+            np.abs(np.asarray(out["pac_area"]) - full["pac_area"])
+        )
+        assert delta <= tol
+        # Trajectory covers exactly the evaluated blocks.
+        assert len(s["pac_trajectory"]) == s["h_effective"] // 5
+
+    def test_min_h_floor_blocks_stop(self, stable):
+        x = stable
+        config = _config(
+            x, k_values=(2,), n_iterations=20, store_matrices=False,
+            stream_h_block=4, adaptive_tol=10.0, adaptive_patience=1,
+            adaptive_min_h=20,
+        )
+        out = run_streaming_sweep(KMeans(n_init=2), config, x, seed=2)
+        assert not out["streaming"]["stopped_early"]
+        assert out["streaming"]["h_effective"] == 20
+
+    def test_block_callback_sees_every_evaluated_block(self, stable):
+        x = stable
+        events = []
+        config = _config(
+            x, k_values=(2, 3), n_iterations=12, store_matrices=False,
+            stream_h_block=4,
+        )
+        out = run_streaming_sweep(
+            KMeans(n_init=2), config, x, seed=0,
+            block_callback=lambda b, h, pac: events.append((b, h)),
+        )
+        assert events == [(0, 4), (1, 8), (2, 12)]
+        assert len(out["streaming"]["pac_trajectory"]) == 3
+
+
+class TestDonationGate:
+    def test_defaults_off_on_cpu_and_env_forces(self, blobs, monkeypatch):
+        # jaxlib 0.4.36's CPU backend corrupts the heap executing a
+        # donated-argnums executable DESERIALIZED from the persistent
+        # XLA compilation cache (streaming.py documents the repro), so
+        # donation must default off on CPU; the env knob is the
+        # mitigation surface for an accelerator plugin with a similar
+        # bug.  Build-only: no compile, so this is cheap.
+        x, _ = blobs
+        config = _config(x, store_matrices=False, stream_h_block=4)
+        assert not StreamingSweep(KMeans(), config).donates_state
+        monkeypatch.setenv("CCTPU_STREAM_DONATE", "1")
+        assert StreamingSweep(KMeans(), config).donates_state
+        monkeypatch.setenv("CCTPU_STREAM_DONATE", "0")
+        assert not StreamingSweep(KMeans(), config).donates_state
+
+
+class TestValidation:
+    def test_config_rejects_adaptive_without_streaming(self):
+        with pytest.raises(ValueError, match="stream_h_block"):
+            SweepConfig(
+                n_samples=10, n_features=2, adaptive_tol=0.01,
+                store_matrices=False,
+            )
+
+    def test_config_rejects_adaptive_with_matrices(self):
+        with pytest.raises(ValueError, match="store_matrices"):
+            SweepConfig(
+                n_samples=10, n_features=2, stream_h_block=4,
+                adaptive_tol=0.01,
+            )
+
+    def test_config_rejects_bad_block(self):
+        with pytest.raises(ValueError, match="stream_h_block"):
+            SweepConfig(n_samples=10, n_features=2, stream_h_block=0)
+
+    def test_engine_requires_block(self, blobs):
+        x, _ = blobs
+        with pytest.raises(ValueError, match="stream_h_block"):
+            StreamingSweep(KMeans(), _config(x))
+
+    def test_run_rejects_adaptive_with_matrices(self, blobs):
+        # The runtime-override path must enforce the same invariant the
+        # config does (an engine built with matrices on, overridden to
+        # adaptive per run, would report inconsistent h_effective).
+        x, _ = blobs
+        engine = StreamingSweep(
+            KMeans(n_init=2), _config(x, stream_h_block=4)
+        )
+        with pytest.raises(ValueError, match="store_matrices"):
+            engine.run(x, seed=0, n_iterations=8, adaptive_tol=0.1)
+
+
+class TestApiIntegration:
+    def test_fit_streaming_matches_monolithic(self, blobs):
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        x, _ = blobs
+        kw = dict(
+            K_range=(2, 3), n_iterations=10, random_state=5,
+            plot_cdf=False, store_matrices=False, progress=False,
+        )
+        mono = ConsensusClustering(**kw).fit(x)
+        stream = ConsensusClustering(stream_h_block=4, **kw).fit(x)
+        for k in (2, 3):
+            assert (mono.cdf_at_K_data[k]["pac_area"]
+                    == stream.cdf_at_K_data[k]["pac_area"])
+            np.testing.assert_array_equal(
+                mono.cdf_at_K_data[k]["cdf"],
+                stream.cdf_at_K_data[k]["cdf"],
+            )
+        assert stream.metrics_["streaming"]["h_effective"] == 10
+
+    def test_fit_adaptive_reports_h_effective(self):
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        rng = np.random.default_rng(3)
+        x = np.concatenate([
+            rng.normal(0.0, 0.2, (25, 3)), rng.normal(5.0, 0.2, (25, 3)),
+        ]).astype(np.float32)
+        cc = ConsensusClustering(
+            K_range=(2, 3), n_iterations=40, random_state=5,
+            plot_cdf=False, progress=False,
+            stream_h_block=5, adaptive_tol=0.02, adaptive_min_h=10,
+        ).fit(x)
+        s = cc.metrics_["streaming"]
+        assert s["stopped_early"] and s["h_effective"] < 40
+        # store_matrices='auto' resolved to curves-only under adaptive.
+        assert cc.cdf_at_K_data[2]["mij"] is None
