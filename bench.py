@@ -494,7 +494,21 @@ def main(argv=None):
         "--adaptive-min-h", type=int, default=0,
         help="resample floor before an adaptive stop may trigger",
     )
+    parser.add_argument(
+        "--stream-ckpt-dir", default=None,
+        help="with --stream: checkpoint the block state into this "
+        "directory while benchmarking (resilience.StreamCheckpointer), "
+        "so the per-block durability overhead is measured at the real "
+        "shape; forces --repeats 1 (a repeat would resume the first "
+        "run's terminal generation) and records checkpoint_writes / "
+        "checkpoint_write_seconds",
+    )
     args = parser.parse_args(argv)
+    if args.stream_ckpt_dir and not args.stream:
+        # Without --stream there is no block loop to checkpoint: erroring
+        # beats emitting a normal-looking record that silently measured
+        # no durability overhead at all.
+        parser.error("--stream-ckpt-dir requires --stream")
 
     from consensus_clustering_tpu.utils.platform import (
         enable_compilation_cache,
@@ -553,9 +567,19 @@ def main(argv=None):
         mode = ("adaptive" if args.adaptive_tol is not None
                 else "full-H")
         metric += f" [streamed h_block={args.stream} {mode}]"
+        checkpointer = None
+        if args.stream_ckpt_dir:
+            from consensus_clustering_tpu.resilience.blocks import (
+                StreamCheckpointer,
+            )
+
+            checkpointer = StreamCheckpointer(args.stream_ckpt_dir)
+            checkpointer.clear()  # measure fresh runs, never a resume
+            repeats = 1
+            metric += " [ckpt]"
         out = run_streaming_sweep(
             clusterer, config, x, seed=SEED, repeats=repeats,
-            profile_dir=args.profile_dir,
+            profile_dir=args.profile_dir, checkpointer=checkpointer,
         )
         # The rate divides by resamples actually RUN (h_effective), so
         # an adaptive record's r/s stays a true throughput, not a
@@ -629,6 +653,15 @@ def main(argv=None):
             [round(float(p), 5) for p in row]
             for row in s["pac_trajectory"]
         ]
+        if args.stream_ckpt_dir:
+            # Durability overhead, disclosed next to the rate it taxed:
+            # write count and the writer thread's wall (device→host
+            # copy + frame + disk, off the driver's critical path when
+            # donation is off).
+            record["checkpoint_writes"] = int(s["checkpoint_writes"])
+            record["checkpoint_write_seconds"] = round(
+                checkpointer.write_seconds_total, 4
+            )
     peak = out["timing"].get("device_memory", {}).get("peak_bytes_in_use")
     if peak:
         record["peak_device_bytes"] = peak

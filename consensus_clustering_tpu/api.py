@@ -191,6 +191,11 @@ class ConsensusClustering:
         prerequisite for adaptive early stopping.  None (default) keeps
         the single-program sweep.  See ``SweepConfig.stream_h_block``;
         ignored (with a log message) for host-backend clusterers.
+        With ``checkpoint_dir`` set, streamed fits additionally
+        checkpoint the block state into ``<checkpoint_dir>/stream`` as
+        they run: a crash mid-batch resumes from the last completed
+        BLOCK (bit-identically) instead of the last completed K batch
+        (docs/ARCHITECTURE.md "Resilience").
     adaptive_tol : float, keyword-only, optional
         With ``stream_h_block``: stop the stream early once every K's
         PAC moved less than this for ``adaptive_patience`` consecutive
@@ -492,6 +497,7 @@ class ConsensusClustering:
             n_batches = -(-len(missing) // batch)
             for i0 in range(0, len(missing), batch):
                 chunk = missing[i0:i0 + batch]
+                stream_ckpt = None
                 run_config = dataclasses.replace(
                     config, k_values=tuple(chunk)
                 )
@@ -515,11 +521,36 @@ class ConsensusClustering:
                             block=block, h_done=h_done, pac_area=pac,
                         )
 
-                    out = run_streaming_sweep(
-                        clusterer, run_config, X, self.random_state,
-                        mesh=self.mesh, block_callback=block_cb,
-                        profile_dir=self.profile_dir,
-                    )
+                    if self.checkpoint_dir is not None:
+                        # Within-sweep durability: the per-K files bound
+                        # a crash's cost to one K batch; the stream ring
+                        # tightens that to ONE BLOCK — a re-fit resumes
+                        # the interrupted batch mid-stream (the ring is
+                        # cleared below once the batch's per-K files
+                        # supersede it).
+                        import os as _os
+
+                        from consensus_clustering_tpu.resilience.blocks import (
+                            StreamCheckpointer,
+                        )
+
+                        stream_ckpt = StreamCheckpointer(
+                            _os.path.join(self.checkpoint_dir, "stream")
+                        )
+                    try:
+                        out = run_streaming_sweep(
+                            clusterer, run_config, X, self.random_state,
+                            mesh=self.mesh, block_callback=block_cb,
+                            profile_dir=self.profile_dir,
+                            checkpointer=stream_ckpt,
+                        )
+                    finally:
+                        # Close unconditionally (a failed attempt must
+                        # not leak the writer thread) — but clear() only
+                        # after the per-K save below: the ring surviving
+                        # a crash IS the feature.
+                        if stream_ckpt is not None:
+                            stream_ckpt.close()
                     if self.progress_callback is not None:
                         # The streaming driver has the final curves on
                         # the host — the per-K signal needs no staged
@@ -549,6 +580,13 @@ class ConsensusClustering:
                 if ckpt is not None:
                     for k in chunk:
                         ckpt.save_k(k, chunk_entries[k])
+                if stream_ckpt is not None:
+                    # The batch's per-K files now supersede the block
+                    # ring; clearing it keeps the next batch (different
+                    # k_values, different stream fingerprint) from
+                    # scanning-and-skipping stale generations.  (Already
+                    # closed in the finally above.)
+                    stream_ckpt.clear()
                 entries.update(chunk_entries)
                 timings.append(out["timing"])
                 if "streaming" in out:

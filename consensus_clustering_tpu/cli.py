@@ -255,7 +255,13 @@ def cmd_serve(args):
     )
 
     logging.basicConfig(level=logging.INFO)
-    executor = SweepExecutor(default_h_block=args.stream_block)
+    executor = SweepExecutor(
+        # 0 = autotune per job (block ≈ H/8 clamped to [16, 128], the
+        # ROADMAP serving heuristic); a positive value pins one default
+        # block size for jobs that don't set stream_h_block themselves.
+        default_h_block=args.stream_block or None,
+        checkpoint_every=args.checkpoint_every,
+    )
     service = ConsensusService(
         store_dir=args.store_dir,
         host=args.host,
@@ -265,7 +271,16 @@ def cmd_serve(args):
         max_retries=args.max_retries,
         events_path=args.events_path,
         executor=executor,
+        job_checkpoints=not args.no_job_checkpoints,
     )
+    if args.port_file:
+        # The orchestration handshake for --port 0 (ephemeral): whoever
+        # launched this process reads the bound port from the file —
+        # written atomically so a reader never sees a partial line.
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(service.port))
+        os.replace(tmp, args.port_file)
     for spec_str in args.warmup or ():
         # n,d,kspec,h — pre-compile the executable for this shape bucket
         # so the first real request at it skips straight to execution.
@@ -283,11 +298,15 @@ def cmd_serve(args):
             )
         secs = executor.warmup(spec, n, d)
         # The streamed block program is H-agnostic, so one warmup covers
-        # every iterations value at this shape (the H in the spec string
-        # is accepted for compatibility but does not split the bucket).
+        # every iterations value at this shape that resolves to the same
+        # block size — every H with a pinned --stream-block; under
+        # autotune (--stream-block 0) the spec's H picks the block the
+        # heuristic would (H values autotuning to another block compile
+        # their own bucket).
+        block = executor._resolve_h_block(spec)
         print(
             f"warmed bucket n={n} d={d} k={spec.k_values} "
-            f"(any H) in {secs:.1f}s",
+            f"h_block={block} in {secs:.1f}s",
             file=sys.stderr,
         )
     print(
@@ -425,10 +444,23 @@ def main(argv=None):
                          "(exponential backoff)")
     serve_p.add_argument("--events-path", default=None,
                          help="append JSONL lifecycle events here")
-    serve_p.add_argument("--stream-block", type=int, default=32,
+    serve_p.add_argument("--stream-block", type=int, default=0,
                          help="default resamples per streamed H-block "
                          "for jobs that don't set stream_h_block "
-                         "(part of the executable bucket)")
+                         "(part of the executable bucket); 0 (default) "
+                         "autotunes per job: block = H/8 clamped to "
+                         "[16, 128]")
+    serve_p.add_argument("--checkpoint-every", type=int, default=1,
+                         help="checkpoint the streamed block state every "
+                         "N evaluated blocks (1 = every block; a "
+                         "preemption loses at most N blocks of work)")
+    serve_p.add_argument("--no-job-checkpoints", action="store_true",
+                         help="disable per-job block checkpointing "
+                         "(payload persistence and restart re-queue "
+                         "stay on; re-queued jobs restart from zero)")
+    serve_p.add_argument("--port-file", default=None,
+                         help="write the bound port here after binding "
+                         "(the handshake for --port 0)")
     serve_p.add_argument("--warmup", action="append", default=None,
                          metavar="N,D,KSPEC,H",
                          help="pre-compile a shape bucket at startup, "

@@ -18,6 +18,27 @@ from consensus_clustering_tpu.ops.analysis import pac_indices
 from consensus_clustering_tpu.ops.resample import subsample_size
 
 
+def autotune_stream_block(n_iterations: int) -> int:
+    """Serving-side default H-block size: ``H // 8`` clamped to [16, 128].
+
+    The ROADMAP heuristic (follow-up from the streaming engine): the
+    per-block overhead is one extra per-K consensus-histogram pass, so
+    tiny blocks tax small jobs, while blocks beyond ~128 stop buying
+    pipeline overlap and start delaying both the adaptive-stop decision
+    points and the checkpoint cadence (a preemption loses up to one
+    block of work).  ``H/8`` keeps ~8 evaluation/checkpoint points per
+    sweep; the clamp keeps degenerate H values sane.  Per-job
+    ``stream_h_block`` overrides it; the resolved value is part of the
+    serving executable bucket, so two jobs whose H autotunes to
+    different blocks compile separately (documented in docs/SERVING.md).
+    """
+    if n_iterations < 1:
+        raise ValueError(
+            f"n_iterations must be >= 1, got {n_iterations}"
+        )
+    return max(16, min(128, int(n_iterations) // 8))
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepConfig:
     """Everything shape- or semantics-static about one consensus sweep.

@@ -63,12 +63,15 @@ from __future__ import annotations
 import logging
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+if TYPE_CHECKING:  # import cycle guard: resilience never imports parallel
+    from consensus_clustering_tpu.resilience.blocks import StreamCheckpointer
 
 from consensus_clustering_tpu.config import SweepConfig
 from consensus_clustering_tpu.models.protocol import JaxClusterer
@@ -96,6 +99,11 @@ from consensus_clustering_tpu.parallel.sweep import (
     resample_lane_keys,
     shard_map,
     sweep_geometry,
+)
+from consensus_clustering_tpu.resilience.faults import faults
+from consensus_clustering_tpu.utils.checkpoint import (
+    data_fingerprint,
+    stream_fingerprint,
 )
 
 logger = logging.getLogger(__name__)
@@ -426,6 +434,7 @@ class StreamingSweep:
         adaptive_tol: Optional[float] = None,
         adaptive_patience: Optional[int] = None,
         adaptive_min_h: Optional[int] = None,
+        checkpointer: Optional["StreamCheckpointer"] = None,
     ) -> Dict[str, Any]:
         """Stream the sweep; returns host-side results + streaming stats.
 
@@ -444,6 +453,34 @@ class StreamingSweep:
         analysis (and any callback) overlaps device compute.  With
         adaptive stopping on, a stop decided on block b discards the
         already-dispatched block b+1.
+
+        ``checkpointer`` (a :class:`~consensus_clustering_tpu.
+        resilience.blocks.StreamCheckpointer`) makes the run
+        preemption-safe at BLOCK granularity: each evaluated block's
+        exact accumulator state (+ curves + adaptive trajectory) is
+        handed to the checkpointer's background writer, and a fresh
+        call with the same (config, seed, data, H, adaptive knobs) —
+        the :func:`~consensus_clustering_tpu.utils.checkpoint.
+        stream_fingerprint` identity — resumes from the newest valid
+        generation, bit-identically: the resample plan and lane keys
+        fold the GLOBAL resample index, so only ``h_done`` is needed to
+        reconstruct every draw (tests/test_resilience.py asserts
+        kill-and-resume parity against uninterrupted runs).
+
+        Overlap caveat: with state donation OFF (the CPU default —
+        see the ``CCTPU_STREAM_DONATE`` note in the class docstring)
+        the writer snapshots the still-device-resident state, so the
+        device→host copy and the disk write both happen off the driver
+        thread and the double-buffered pipeline never stalls.  The
+        price of that overlap is device memory: the snapshots pin up to
+        ~3 accumulator generations on device (the in-flight one, one
+        queued, one being serialized — the writer queue is bounded at 1
+        for exactly this) on top of the live state.  With donation ON
+        the state buffer is aliased into the next block's dispatch, so
+        each checkpointed block must synchronously copy the state down
+        first — one pipeline bubble per checkpointed block, but no
+        extra device residency.  Either way, ``checkpointer.every`` is
+        the lever if the cost shows up in profiles (or HBM).
         """
         if n_iterations < 1:
             raise ValueError(
@@ -467,22 +504,91 @@ class StreamingSweep:
         n_blocks = -(-n_iterations // self._hb_pad)
 
         t0 = time.perf_counter()
-        state = self.init_state()
         trajectory: List[List[float]] = []
         prev_pac: Optional[np.ndarray] = None
         quiet = 0
         stopped_early = False
         result_curves: Optional[Dict[str, np.ndarray]] = None
         h_effective = 0
-        pending = None  # (block_index, device curves) not yet on host
+        start_block = 0
+        resumed_from_block = 0
+        resume_terminal = False
+        ckpt_fp = None
+        ckpt_writes_before = 0
+        state = None
+        if checkpointer is not None:
+            # The fingerprint covers everything that determines the
+            # resumed stream bit for bit — config, seed, DATA CONTENT,
+            # and the resolved runtime knobs (H, adaptive settings) —
+            # so latest() refuses state from any other sweep.
+            ckpt_fp = stream_fingerprint(
+                config, seed, data_fingerprint(np.asarray(x)),
+                n_iterations=n_iterations,
+                adaptive_tol=adaptive_tol,
+                adaptive_patience=adaptive_patience,
+                adaptive_min_h=adaptive_min_h,
+            )
+            ckpt_writes_before = checkpointer.writes_total
+            resume = checkpointer.latest(ckpt_fp)
+            if resume is not None:
+                header, arrays = resume
+                state = {
+                    name: jax.device_put(
+                        arrays[f"state_{name}"],
+                        self._state_shardings[name],
+                    )
+                    for name in ("mij", "iij")
+                }
+                # float32 restore keeps the adaptive arithmetic
+                # bit-identical to the uninterrupted run: the PAC
+                # values were f32 on the way out, JSON round-trips
+                # them exactly, and the delta-vs-tol comparison must
+                # not silently widen to f64 on the resumed path only.
+                trajectory = [
+                    [float(v) for v in row]
+                    for row in header["trajectory"]
+                ]
+                if trajectory:
+                    prev_pac = np.asarray(
+                        trajectory[-1], dtype=np.float32
+                    )
+                quiet = int(header["quiet"])
+                h_effective = int(header["h_done"])
+                result_curves = {
+                    name[len("curve_"):]: arrays[name]
+                    for name in arrays
+                    if name.startswith("curve_")
+                }
+                start_block = int(header["block_index"]) + 1
+                resumed_from_block = start_block
+                checkpointer.resumes_total += 1
+                stopped_early = bool(header.get("stopped", False))
+                # A terminal generation (adaptive stop already decided,
+                # or the final block) replays to the stored answer with
+                # zero device work.
+                resume_terminal = (
+                    stopped_early or h_effective >= n_iterations
+                )
+                logger.info(
+                    "resuming streamed sweep from checkpoint: block %d "
+                    "(h_done=%d of %d%s)",
+                    start_block - 1, h_effective, n_iterations,
+                    ", terminal" if resume_terminal else "",
+                )
+        if state is None:
+            state = self.init_state()
+        pending = None  # (block, device curves, state snapshot) pending
 
         def h_done(b: int) -> int:
             return min((b + 1) * self._hb_pad, n_iterations)
 
-        def evaluate(b: int, curves) -> bool:
+        def evaluate(b: int, curves, snap) -> bool:
             """Pull block b's curves to host; True when the run should
             stop early.  The np.asarray copy is the completion barrier —
-            while it blocks, the next block already computes."""
+            while it blocks, the next block already computes.  ``snap``
+            (the exact accumulator state after block b, device- or
+            host-resident) is handed to the checkpoint writer together
+            with the just-updated adaptive bookkeeping."""
             nonlocal prev_pac, quiet, result_curves, h_effective
             host = {
                 name: np.asarray(v) for name, v in curves.items()
@@ -506,21 +612,79 @@ class StreamingSweep:
                     and h_effective < n_iterations
                 )
             prev_pac = pac
+            if checkpointer is not None and snap is not None:
+                arrays = {
+                    f"state_{name}": v for name, v in snap.items()
+                }
+                arrays.update(
+                    {f"curve_{name}": v for name, v in host.items()}
+                )
+                checkpointer.write_async(
+                    {
+                        "fingerprint": ckpt_fp,
+                        "block_index": int(b),
+                        "h_done": int(h_effective),
+                        "n_iterations": int(n_iterations),
+                        # Copied: the live list keeps growing while the
+                        # writer thread serialises.
+                        "trajectory": [list(row) for row in trajectory],
+                        "quiet": int(quiet),
+                        "stopped": bool(stop),
+                        "written_at": round(time.time(), 3),
+                    },
+                    arrays,
+                )
             return stop
 
-        for b in range(n_blocks):
-            state, curves = self._step(
-                state, xj, key, jnp.int32(b * self._hb_pad), h_total
-            )
-            if pending is not None and evaluate(*pending):
-                # Block b is the speculative in-flight dispatch; its
-                # state and curves never enter the answer.
-                stopped_early = True
-                pending = None
-                break
-            pending = (b, curves)
-        if pending is not None:
-            evaluate(*pending)
+        try:
+            for b in range(start_block, 0 if resume_terminal else n_blocks):
+                faults.fire("block_start", index=b)
+                state, curves = self._step(
+                    state, xj, key, jnp.int32(b * self._hb_pad), h_total
+                )
+                if pending is not None and evaluate(*pending):
+                    # Block b is the speculative in-flight dispatch; its
+                    # state and curves never enter the answer — which is
+                    # why its checkpoint snapshot (below) is taken only
+                    # AFTER this check: on the stop iteration the
+                    # donated path would otherwise pay a full
+                    # synchronous state copy for a discarded block.
+                    stopped_early = True
+                    pending = None
+                    break
+                snap = None
+                if checkpointer is not None and checkpointer.due(
+                    b, n_blocks
+                ):
+                    if self.donates_state:
+                        # The next dispatch will alias (donate) these
+                        # buffers, so the host copy must land first —
+                        # one pipeline bubble per checkpointed block
+                        # (see the docstring's overlap caveat).
+                        for leaf in state.values():
+                            copy_async = getattr(
+                                leaf, "copy_to_host_async", None
+                            )
+                            if copy_async is not None:
+                                copy_async()
+                        snap = {
+                            name: np.asarray(v)
+                            for name, v in state.items()
+                        }
+                    else:
+                        # Undonated buffers stay valid after the next
+                        # dispatch: hand the device refs straight to
+                        # the writer thread, whose np.asarray waits
+                        # off the driver's critical path.
+                        snap = state
+                pending = (b, curves, snap)
+            if pending is not None:
+                evaluate(*pending)
+        finally:
+            if checkpointer is not None:
+                # An injected fault / preemption-style abort must still
+                # leave a consistent ring behind — that is the product.
+                checkpointer.flush()
 
         out: Dict[str, Any] = dict(result_curves)
         if config.store_matrices and not stopped_early:
@@ -544,6 +708,14 @@ class StreamingSweep:
             "n_blocks_run": len(trajectory),
             "stopped_early": stopped_early,
             "pac_trajectory": trajectory,
+            # Resilience accounting: 0 = fresh run; > 0 = the first
+            # block this process actually executed (everything before
+            # it was restored from the checkpoint ring).
+            "resumed_from_block": int(resumed_from_block),
+            "checkpoint_writes": (
+                checkpointer.writes_total - ckpt_writes_before
+                if checkpointer is not None else 0
+            ),
         }
         out["timing"] = {
             "run_seconds": run_seconds,
@@ -564,6 +736,7 @@ def run_streaming_sweep(
     repeats: int = 1,
     block_callback=None,
     profile_dir: Optional[str] = None,
+    checkpointer: Optional["StreamCheckpointer"] = None,
 ) -> Dict[str, Any]:
     """Build, warm and drive a streaming sweep; mirror of
     :func:`~consensus_clustering_tpu.parallel.sweep.run_sweep`.
@@ -574,7 +747,16 @@ def run_streaming_sweep(
     (``h_effective``, per-block PAC trajectory, early-stop flag).
     ``profile_dir`` captures a ``jax.profiler`` trace of the FIRST
     streamed run (the warmup block is outside the trace).
+    ``checkpointer`` makes the run preemption-safe (see
+    :meth:`StreamingSweep.run`); it is single-run by construction —
+    with ``repeats`` the second repeat would resume the first's
+    terminal generation and time nothing.
     """
+    if checkpointer is not None and repeats > 1:
+        raise ValueError(
+            "checkpointer is incompatible with repeats > 1: repeat 2 "
+            "would short-circuit on repeat 1's terminal checkpoint"
+        )
     engine = StreamingSweep(clusterer, config, mesh)
     compile_seconds = engine.warmup(x)
     best = None
@@ -585,11 +767,13 @@ def run_streaming_sweep(
                 out = engine.run(
                     x, seed, config.n_iterations,
                     block_callback=block_callback,
+                    checkpointer=checkpointer,
                 )
         else:
             out = engine.run(
                 x, seed, config.n_iterations,
                 block_callback=block_callback,
+                checkpointer=checkpointer,
             )
         run_times.append(out["timing"]["run_seconds"])
         if best is None or out["timing"]["run_seconds"] < best[

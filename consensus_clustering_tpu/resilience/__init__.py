@@ -1,0 +1,43 @@
+"""Resilience subsystem: preemption-safe durability for streamed sweeps.
+
+On a real TPU pod preemption is the common case, not the exception
+(ROADMAP "Streaming + checkpointing"): a sweep that loses hours of
+accumulated resamples to a slice restart is not production-scale.  The
+streaming H-block engine made the per-block ``{mij, iij}`` state an
+exact resume point — the resample plan folds every draw with its GLOBAL
+index, so only ``h_done`` is needed to reconstruct the keys — and this
+package turns that property into crash recovery at BLOCK granularity:
+
+- :mod:`.blocks`  — :class:`StreamCheckpointer`: CRC-framed,
+  atomic-rename block checkpoints with a last-2-generation ring and an
+  async writer thread that overlaps disk I/O with the next in-flight
+  block.
+- :mod:`.faults`  — deterministic fault injection (env / programmatic)
+  plus :func:`classify_error`, the retryable-vs-fatal triage the
+  serving scheduler retries from checkpoint on.
+
+Every recovery path here is exercised by tests/test_resilience.py via
+the fault hooks rather than trusted: raise at block *b*, die mid-write,
+corrupt/truncate a generation — each must resume bit-identically.
+Importing this package initialises neither JAX nor the filesystem.
+"""
+
+from consensus_clustering_tpu.resilience.blocks import (
+    CheckpointFrameError,
+    StreamCheckpointer,
+)
+from consensus_clustering_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedFault,
+    classify_error,
+    faults,
+)
+
+__all__ = [
+    "CheckpointFrameError",
+    "FaultInjector",
+    "InjectedFault",
+    "StreamCheckpointer",
+    "classify_error",
+    "faults",
+]

@@ -1,0 +1,203 @@
+"""Deterministic fault injection + failure triage for the resilience paths.
+
+Recovery code that is never executed is recovery code that does not
+work.  This module gives every recovery path a way to be *driven* by a
+test instead of trusted:
+
+- **Fault points.**  Durability-critical code calls
+  ``faults.fire("<point>", index=i)`` at named points (the streaming
+  driver before each block, the checkpoint writer mid-frame and
+  post-rename).  With no plan armed this is a dict lookup on an empty
+  dict — effectively free on the hot path.
+- **Fault plans.**  A plan arms actions at (point, index) pairs, from
+  the ``CCTPU_FAULTS`` env var (read once at import, so a service
+  subprocess can be launched pre-armed) or programmatically
+  (``faults.configure("block_start=3")``).  Spec grammar::
+
+      CCTPU_FAULTS="point=index[:action][,point=index[:action]...]"
+
+      block_start=3            raise InjectedFault before block 3
+      block_start=3:kill       os._exit(137) there instead (SIGKILL-like)
+      checkpoint_mid_write=1   raise with a torn temp file half-written
+      checkpoint_post_write=0:kill   die after the atomic rename
+
+  Every rule fires ONCE and disarms: a retried / resumed run must not
+  trip over the same mine again — that is precisely what lets one plan
+  drive an interrupt-then-recover test end to end.
+- **Triage.**  :func:`classify_error` is the scheduler's
+  retryable-vs-fatal decision: deterministic programming/validation
+  errors fail a job immediately, while device/runtime/IO faults (the
+  preemption class) are retried with backoff *from checkpoint*.
+
+:class:`InjectedFault` is deliberately retryable — the serving tests
+use it as a stand-in for a device preemption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ENV = "CCTPU_FAULTS"
+_ACTIONS = ("raise", "kill")
+_KILL_EXIT_CODE = 137  # what a SIGKILL'd process reports (128 + 9)
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected, *retryable* failure (fault-plan 'raise')."""
+
+
+@dataclasses.dataclass
+class _Rule:
+    point: str
+    index: int
+    action: str
+
+
+def _parse_plan(spec: Optional[str]) -> List[_Rule]:
+    rules: List[_Rule] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            point, rest = entry.split("=", 1)
+            index_s, _, action = rest.partition(":")
+            rule = _Rule(point.strip(), int(index_s), action or "raise")
+        except ValueError:
+            raise ValueError(
+                f"bad fault spec entry {entry!r}: expected "
+                "point=index[:action]"
+            )
+        if rule.action not in _ACTIONS:
+            raise ValueError(
+                f"bad fault action {rule.action!r} in {entry!r} "
+                f"(choose from {_ACTIONS})"
+            )
+        rules.append(rule)
+    return rules
+
+
+class FaultInjector:
+    """Registry of armed fault rules, consulted at named fault points.
+
+    One process-global instance (:data:`faults`) is what production code
+    calls into; tests either configure that instance (and clear it in a
+    finally) or launch a subprocess with ``CCTPU_FAULTS`` set.
+    """
+
+    def __init__(self, spec: Optional[str] = None):
+        self._armed: Dict[Tuple[str, int], _Rule] = {}
+        self.fired: List[Tuple[str, int, str]] = []
+        self.configure(spec)
+
+    def configure(self, spec: Optional[str]) -> "FaultInjector":
+        """Arm a plan from a spec string; ``None``/empty clears it."""
+        self._armed = {
+            (r.point, r.index): r for r in _parse_plan(spec)
+        }
+        return self
+
+    def clear(self) -> None:
+        self._armed = {}
+
+    def active(self) -> bool:
+        return bool(self._armed)
+
+    def fire(self, point: str, index: int) -> None:
+        """Trigger the (point, index) rule if armed; no-op otherwise.
+
+        Rules are single-shot: once fired they disarm, so a retry or a
+        resume-from-checkpoint of the same work does not re-trip — the
+        property that lets one plan drive a full interrupt-then-recover
+        cycle.
+        """
+        rule = self._armed.pop((point, index), None)
+        if rule is None:
+            return
+        self.fired.append((point, index, rule.action))
+        if rule.action == "kill":
+            logger.warning(
+                "fault injection: killing process at %s[%d]", point, index
+            )
+            # Mimic SIGKILL: no atexit, no finally blocks, no flushes —
+            # exactly the torn state a preempted process leaves behind.
+            os._exit(_KILL_EXIT_CODE)
+        logger.warning(
+            "fault injection: raising at %s[%d]", point, index
+        )
+        raise InjectedFault(f"injected fault at {point}[{index}]")
+
+
+#: The process-global injector production code fires into.  Armed from
+#: ``CCTPU_FAULTS`` at import so a subprocess can be launched pre-mined.
+faults = FaultInjector(os.environ.get(_ENV))
+
+
+# ---------------------------------------------------------------------------
+# Failure triage: what the scheduler may retry from checkpoint
+
+
+#: Substrings that mark a RuntimeError as the transient device class —
+#: XLA runtime status codes and the TPU preemption vocabulary.  Matched
+#: case-insensitively against str(exc).
+_RETRYABLE_MARKERS = (
+    "resource_exhausted",
+    "out of memory",
+    "unavailable",
+    "aborted",
+    "deadline_exceeded",
+    "preempt",
+    "slice restart",
+    "device or resource busy",
+    "failed to connect",
+    "socket closed",
+)
+
+#: Deterministic error types: re-running the identical job re-raises the
+#: identical error, so retrying burns the backoff budget for nothing.
+_FATAL_TYPES = (
+    ValueError,
+    TypeError,
+    KeyError,
+    IndexError,
+    AttributeError,
+    AssertionError,
+    ZeroDivisionError,
+    NotImplementedError,
+)
+
+
+def classify_error(exc: BaseException) -> Tuple[str, str]:
+    """Triage a job failure into ``(kind, reason)``.
+
+    ``kind`` is ``"retryable"`` (the scheduler re-runs with backoff,
+    resuming from the newest checkpoint) or ``"fatal"`` (fail the job
+    now).  ``reason`` is a short label for the ``retry_total{reason}``
+    metrics counter: ``injected`` | ``oom`` | ``device`` | ``io`` |
+    ``runtime`` — or the exception type name for fatal errors.
+
+    The default for an *unrecognised* exception is retryable: on a pod,
+    the unknown-unknowns are overwhelmingly transient (plugin hiccups,
+    collective timeouts), and a bounded retry of a deterministic bug
+    costs two backoffs, while *not* retrying a preemption costs the
+    whole job.
+    """
+    if isinstance(exc, InjectedFault):
+        return "retryable", "injected"
+    if isinstance(exc, _FATAL_TYPES):
+        return "fatal", type(exc).__name__
+    text = str(exc).lower()
+    if "memory" in text and (
+        "out of" in text or "exhausted" in text or "oom" in text
+    ):
+        return "retryable", "oom"
+    if any(marker in text for marker in _RETRYABLE_MARKERS):
+        return "retryable", "device"
+    if isinstance(exc, OSError):
+        return "retryable", "io"
+    return "retryable", "runtime"

@@ -9,6 +9,11 @@ The serving subsystem over the batch API — see docs/SERVING.md:
   /healthz, /metrics)
 - :mod:`.events`    — structured JSONL lifecycle events
 
+Durability rides on :mod:`consensus_clustering_tpu.resilience`: job
+payloads and per-fingerprint block-checkpoint rings persist in the
+jobstore, retries and restarts resume from the last completed block
+(docs/SERVING.md "Crash recovery").
+
 Everything here is stdlib + the existing package; importing
 ``consensus_clustering_tpu.serve`` does not initialise JAX (that happens
 on the first executed job / warmup).

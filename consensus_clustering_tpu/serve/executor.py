@@ -33,6 +33,7 @@ and are dropped.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import threading
 import time
@@ -40,7 +41,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.config import SweepConfig, autotune_stream_block
 
 _CLUSTERERS = ("kmeans", "gmm", "agglomerative", "spectral")
 
@@ -112,6 +113,41 @@ class JobSpec:
         payload["pac_interval"] = list(self.pac_interval)
         payload["clusterer_options"] = dict(self.clusterer_options)
         return payload
+
+    @staticmethod
+    def from_payload(payload: Dict[str, Any]) -> "JobSpec":
+        """Rebuild a spec from its :meth:`fingerprint_payload` — the
+        crash-resume path: the jobstore persists exactly that payload,
+        and a restarted scheduler re-queues the orphan from it.
+
+        ``chunk_size`` is absent from the payload (excluded from the
+        fingerprint because counts are exact integers at any chunking),
+        so the rebuilt spec carries the default — bit-identical results
+        either way, by the same argument.
+        """
+        return JobSpec(
+            k_values=tuple(int(k) for k in payload["k_values"]),
+            n_iterations=int(payload["n_iterations"]),
+            subsampling=float(payload["subsampling"]),
+            seed=int(payload["seed"]),
+            clusterer=payload["clusterer"],
+            clusterer_options=tuple(
+                sorted(payload["clusterer_options"].items())
+            ),
+            bins=int(payload["bins"]),
+            pac_interval=(
+                float(payload["pac_interval"][0]),
+                float(payload["pac_interval"][1]),
+            ),
+            parity_zeros=bool(payload["parity_zeros"]),
+            analysis=payload["analysis"],
+            delta_k_threshold=float(payload["delta_k_threshold"]),
+            dtype=payload["dtype"],
+            stream_h_block=payload.get("stream_h_block"),
+            adaptive_tol=payload.get("adaptive_tol"),
+            adaptive_patience=int(payload["adaptive_patience"]),
+            adaptive_min_h=int(payload["adaptive_min_h"]),
+        )
 
     def bucket(self, n: int, d: int, h_block: Optional[int] = None) -> str:
         """The executable-cache key: fingerprint payload minus every
@@ -308,18 +344,31 @@ class SweepExecutor:
     def __init__(
         self,
         use_compilation_cache: bool = True,
-        default_h_block: int = 32,
+        default_h_block: Optional[int] = None,
+        checkpoint_every: int = 1,
     ):
-        if default_h_block < 1:
+        if default_h_block is not None and default_h_block < 1:
             raise ValueError(
-                f"default_h_block must be >= 1, got {default_h_block}"
+                f"default_h_block must be >= 1 or None (autotune), "
+                f"got {default_h_block}"
             )
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
+        # None: ROADMAP's serving heuristic — block ≈ H/8 clamped to
+        # [16, 128], resolved per job from its requested H
+        # (config.autotune_stream_block).  An integer pins one block
+        # size for every job that doesn't set stream_h_block itself.
         self.default_h_block = default_h_block
+        self.checkpoint_every = checkpoint_every
         self.run_count = 0
         self.executable_cache_hits = 0
         self.executable_cache_misses = 0
         self.h_requested_total = 0
         self.h_effective_total = 0
+        self.checkpoint_writes_total = 0
+        self.checkpoint_resume_total = 0
         self._engines: Dict[str, Any] = {}
         self._lock = threading.Lock()
         # Serialises build+compile per process, separate from _lock: a
@@ -354,6 +403,16 @@ class SweepExecutor:
 
     # -- executable cache ------------------------------------------------
 
+    def _resolve_h_block(self, spec: JobSpec) -> int:
+        """The block size this job actually streams with: the job's own
+        ``stream_h_block``, else the executor's pinned default, else
+        the ROADMAP autotune heuristic (H/8 clamped to [16, 128])."""
+        if spec.stream_h_block is not None:
+            return spec.stream_h_block
+        if self.default_h_block is not None:
+            return self.default_h_block
+        return autotune_stream_block(spec.n_iterations)
+
     def _config_for(self, spec: JobSpec, n: int, d: int) -> SweepConfig:
         # n_iterations is a placeholder here: the streaming engine takes
         # H at run() time (traced scalar); nothing compiled depends on
@@ -370,7 +429,7 @@ class SweepExecutor:
             parity_zeros=spec.parity_zeros,
             store_matrices=False,  # serving results are curves-only JSON
             chunk_size=spec.chunk_size,
-            stream_h_block=spec.stream_h_block or self.default_h_block,
+            stream_h_block=self._resolve_h_block(spec),
             # Adaptive knobs deliberately NOT baked: the cached engine
             # is shared by every job in the bucket, and run() takes them
             # as per-job overrides.
@@ -410,7 +469,7 @@ class SweepExecutor:
         the race blocks and then hits the cache instead of paying a
         duplicate minutes-long compile serialized behind one device.
         """
-        key = spec.bucket(n, d, self.default_h_block)
+        key = spec.bucket(n, d, self._resolve_h_block(spec))
         with self._compile_lock:
             hit = self._engines.get(key)
             if hit is not None:
@@ -440,9 +499,15 @@ class SweepExecutor:
 
     def warmup(self, spec: JobSpec, n: int, d: int) -> float:
         """Pre-compile the block executable for a shape bucket; returns
-        the build+compile wall-clock (0.0 when already warm).  One
-        warmup covers every H at the shape — the executable is
-        H-agnostic."""
+        the build+compile wall-clock (0.0 when already warm).
+
+        The executable is H-agnostic, so one warmup covers every H at
+        the shape **that resolves to the same block size**: every H
+        under a pinned ``default_h_block`` or an explicit
+        ``spec.stream_h_block``, but under the autotune default the
+        spec's ``n_iterations`` picks the block (H/8 clamped to
+        [16, 128]) — an H that autotunes to a different block is a
+        different bucket and pays its own compile."""
         _, seconds, _ = self._get_engine(spec, n, d)
         return seconds
 
@@ -461,6 +526,7 @@ class SweepExecutor:
         x: np.ndarray,
         progress_cb: Optional[Callable[[int, float], None]] = None,
         block_cb: Optional[Callable[[int, int, list], None]] = None,
+        checkpoint_dir: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Execute one streamed sweep; returns the JSON-able result.
 
@@ -470,6 +536,13 @@ class SweepExecutor:
         h_done, pac_list)`` fires per streamed H-block.  Both are
         generation-guarded: after a timeout's :meth:`cancel_events`, an
         abandoned execution's stragglers are silently dropped.
+
+        ``checkpoint_dir`` (the scheduler passes the jobstore's per-
+        fingerprint ring directory) makes the execution preemption-safe:
+        block state is checkpointed as it streams, and a re-run — same
+        process after a transient failure, or a restarted process after
+        a crash — continues from the newest valid generation instead of
+        from zero.  The result's ``resumed_from_block`` records which.
         """
         from consensus_clustering_tpu.ops.analysis import (
             area_under_cdf,
@@ -479,6 +552,16 @@ class SweepExecutor:
 
         n, d = x.shape
         engine, compile_seconds, cached = self._get_engine(spec, n, d)
+
+        checkpointer = None
+        if checkpoint_dir is not None:
+            from consensus_clustering_tpu.resilience.blocks import (
+                StreamCheckpointer,
+            )
+
+            checkpointer = StreamCheckpointer(
+                checkpoint_dir, every=self.checkpoint_every
+            )
 
         with self._lock:
             self._cb_gen += 1
@@ -502,6 +585,7 @@ class SweepExecutor:
                 adaptive_tol=spec.adaptive_tol,
                 adaptive_patience=spec.adaptive_patience,
                 adaptive_min_h=spec.adaptive_min_h,
+                checkpointer=checkpointer,
             )
             # engine.run's curves copies are the completion barrier
             # (run_sweep's rule: block_until_ready can return early on
@@ -510,6 +594,19 @@ class SweepExecutor:
         finally:
             with self._lock:
                 self.run_count += 1
+                if checkpointer is not None:
+                    # Counted in the finally: a run interrupted by a
+                    # fault/preemption still wrote its checkpoints, and
+                    # /metrics must show them (that is the whole story
+                    # of a retry-from-checkpoint).
+                    self.checkpoint_writes_total += (
+                        checkpointer.writes_total
+                    )
+                    self.checkpoint_resume_total += (
+                        checkpointer.resumes_total
+                    )
+            if checkpointer is not None:
+                checkpointer.close()
 
         streaming = host["streaming"]
         with self._lock:
@@ -535,7 +632,12 @@ class SweepExecutor:
             delta_k_gains=gains,
             delta_k_threshold=spec.delta_k_threshold,
         )
-        return {
+        # The SEMANTIC result identity: every field a resumed run must
+        # reproduce bit for bit, none of the fields that legitimately
+        # differ between an interrupted-then-resumed run and an
+        # uninterrupted one (timings, resumed_from_block, cache flags).
+        # The kill-and-resume acceptance test compares exactly this.
+        semantic = {
             "shape": [int(n), int(d)],
             "K": [int(k) for k in ks],
             "pac_area": {str(k): p for k, p in zip(ks, pac)},
@@ -543,10 +645,20 @@ class SweepExecutor:
             "delta_k": [float(g) for g in gains],
             "best_k": int(best_k),
             "analysis": spec.analysis,
-            "backend": self.backend(),
-            # Top-level so a /metrics-style consumer need not know the
-            # streaming schema to see the adaptive win per job.
             "h_effective": int(streaming["h_effective"]),
+        }
+        result_fingerprint = hashlib.sha256(
+            json.dumps(semantic, sort_keys=True).encode()
+        ).hexdigest()[:16]
+        return {
+            **semantic,
+            "backend": self.backend(),
+            "result_fingerprint": result_fingerprint,
+            # Satellite metric: 0 = ran from scratch; > 0 = this many
+            # leading blocks were restored from the checkpoint ring.
+            "resumed_from_block": int(
+                streaming.get("resumed_from_block", 0)
+            ),
             "streaming": {
                 "h_block": int(streaming["h_block"]),
                 "h_requested": int(streaming["h_requested"]),
@@ -554,6 +666,12 @@ class SweepExecutor:
                 "n_blocks_run": int(streaming["n_blocks_run"]),
                 "stopped_early": bool(streaming["stopped_early"]),
                 "pac_trajectory": streaming["pac_trajectory"],
+                "resumed_from_block": int(
+                    streaming.get("resumed_from_block", 0)
+                ),
+                "checkpoint_writes": int(
+                    streaming.get("checkpoint_writes", 0)
+                ),
             },
             "timings": {
                 "compile_seconds": compile_seconds,

@@ -12,20 +12,31 @@ result that a later hit would serve)::
 
     <dir>/results/<fingerprint>.json   canonical result bytes (sort_keys)
     <dir>/jobs/<job_id>.json           job record (status, timings, error)
+    <dir>/payloads/<job_id>.json|.npy  submitted config + data matrix —
+                                       what lets a RESTARTED process
+                                       re-queue an orphaned job instead
+                                       of failing it (crash-resume)
+    <dir>/checkpoints/<fingerprint>/   per-job streamed block-checkpoint
+                                       ring (resilience.StreamCheckpointer)
 
 Results are stored as CANONICAL JSON bytes (``sort_keys=True``) and served
 back verbatim: two submissions that dedup to the same fingerprint receive
 byte-identical result payloads by construction, not by re-serialisation
 luck.  Job records are small and mutable (status transitions); results are
-immutable once written.
+immutable once written.  Payloads live exactly as long as their job is
+non-terminal; checkpoint rings live until the job completes (a failed
+job's ring deliberately survives, so resubmitting the identical job
+resumes instead of restarting).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -49,8 +60,92 @@ class JobStore:
         self.directory = directory
         self.results_dir = os.path.join(directory, "results")
         self.jobs_dir = os.path.join(directory, "jobs")
+        self.payloads_dir = os.path.join(directory, "payloads")
+        self.checkpoints_dir = os.path.join(directory, "checkpoints")
         os.makedirs(self.results_dir, exist_ok=True)
         os.makedirs(self.jobs_dir, exist_ok=True)
+        os.makedirs(self.payloads_dir, exist_ok=True)
+        os.makedirs(self.checkpoints_dir, exist_ok=True)
+        self._sweep_stale_tmps()
+        self._sweep_stale_checkpoints()
+        self._sweep_orphan_payloads()
+
+    # Temp files younger than this are treated as another process's
+    # live writes (two services can share a store dir); older ones are
+    # crash garbage — a process died between write and os.replace — and
+    # without this sweep the matrix-sized payload temps in particular
+    # would accumulate forever (same grace rule as the checkpoint ring).
+    _TMP_GRACE_SECONDS = 600.0
+
+    # A failed/timed-out job's checkpoint ring deliberately survives so
+    # an identical resubmission resumes its progress — but "deliberate"
+    # needs a bound: rings of jobs that are never resubmitted would
+    # otherwise accumulate state-sized directories (GBs each at large N)
+    # forever.  A week comfortably covers any resubmission horizon.
+    _CKPT_RING_TTL_SECONDS = 7 * 24 * 3600.0
+
+    def _sweep_stale_checkpoints(self) -> None:
+        now = time.time()
+        for name in os.listdir(self.checkpoints_dir):
+            ring = os.path.join(self.checkpoints_dir, name)
+            try:
+                newest = max(
+                    (
+                        os.path.getmtime(os.path.join(ring, f))
+                        for f in os.listdir(ring)
+                    ),
+                    default=os.path.getmtime(ring),
+                )
+                if now - newest > self._CKPT_RING_TTL_SECONDS:
+                    shutil.rmtree(ring)
+            except OSError:
+                pass
+
+    def _sweep_orphan_payloads(self) -> None:
+        """GC finalized payloads whose job can never use them again.
+
+        A crash can land between ``save_payload`` and ``save_job``
+        (payload, no record) or between a terminal ``save_job`` and
+        ``delete_payload`` (terminal record, payload left behind);
+        neither is reachable by the reconciliation sweep (it only walks
+        queued/running records), so without this the matrix-sized
+        ``.npy`` payloads accumulate forever on a preemption-heavy pod.
+        The grace window spares another live process's in-flight
+        admission (payload written moments before its record).
+        """
+        now = time.time()
+        for name in os.listdir(self.payloads_dir):
+            if not name.endswith(".json"):
+                continue  # the .npy goes (or stays) with its .json
+            job_id = name[: -len(".json")]
+            path = os.path.join(self.payloads_dir, name)
+            try:
+                if now - os.path.getmtime(path) <= self._TMP_GRACE_SECONDS:
+                    continue
+            except OSError:
+                continue
+            record = self.load_job(job_id)
+            if record is None or record.get("status") not in (
+                "queued", "running",
+            ):
+                self.delete_payload(job_id)
+
+    def _sweep_stale_tmps(self) -> None:
+        now = time.time()
+        for directory in (
+            self.results_dir, self.jobs_dir, self.payloads_dir,
+        ):
+            for name in os.listdir(directory):
+                # Canonical names are <hex>.json / <hex>.npy; every
+                # temp spelling here embeds ".tmp".
+                if ".tmp" not in name:
+                    continue
+                path = os.path.join(directory, name)
+                try:
+                    if now - os.path.getmtime(path) > self._TMP_GRACE_SECONDS:
+                        os.remove(path)
+                except OSError:
+                    pass
 
     # -- fingerprints ----------------------------------------------------
 
@@ -127,6 +222,76 @@ class JobStore:
                 return json.load(f)
         except (FileNotFoundError, ValueError):
             return None
+
+    # -- job payloads (config + data, for crash re-queue) ----------------
+
+    def _payload_paths(self, job_id: str) -> Tuple[str, str]:
+        if not job_id.replace("-", "").isalnum():
+            raise ValueError(f"invalid job id {job_id!r}")
+        base = os.path.join(self.payloads_dir, job_id)
+        return base + ".json", base + ".npy"
+
+    def save_payload(
+        self, job_id: str, payload: Dict[str, Any], x: np.ndarray
+    ) -> None:
+        """Persist what re-running the job needs: the fingerprint-bearing
+        config payload plus the data matrix.  Written at admission and
+        deleted on the terminal transition — the window in between is
+        exactly when a process death would otherwise strand the job."""
+        json_path, npy_path = self._payload_paths(job_id)
+        tmp = f"{npy_path}.{uuid.uuid4().hex}.tmp.npy"
+        np.save(tmp, np.ascontiguousarray(x))
+        os.replace(tmp, npy_path)
+        # Data first, record second: a crash between the two leaves an
+        # orphan .npy (garbage, never loaded) instead of a payload whose
+        # load would fail mid-reconciliation.
+        tmp = f"{json_path}.{uuid.uuid4().hex}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, sort_keys=True, default=float)
+        os.replace(tmp, json_path)
+
+    def load_payload(
+        self, job_id: str
+    ) -> Optional[Tuple[Dict[str, Any], np.ndarray]]:
+        try:
+            json_path, npy_path = self._payload_paths(job_id)
+        except ValueError:
+            return None
+        try:
+            with open(json_path) as f:
+                payload = json.load(f)
+            x = np.load(npy_path)
+        except (FileNotFoundError, ValueError):
+            return None
+        return payload, x
+
+    def delete_payload(self, job_id: str) -> None:
+        try:
+            for path in self._payload_paths(job_id):
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+        except ValueError:
+            pass
+
+    # -- per-job block-checkpoint rings ----------------------------------
+
+    def checkpoint_dir(self, fingerprint: str) -> str:
+        """Directory for a job's streamed block-checkpoint ring, keyed
+        by the job FINGERPRINT (not the job id): a resubmission of an
+        identical failed job resumes the previous attempt's ring."""
+        if not fingerprint.isalnum():
+            raise ValueError(f"invalid fingerprint {fingerprint!r}")
+        return os.path.join(self.checkpoints_dir, fingerprint)
+
+    def clear_checkpoints(self, fingerprint: str) -> None:
+        """Drop a completed job's ring (its result is stored; the
+        block state is dead weight)."""
+        try:
+            shutil.rmtree(self.checkpoint_dir(fingerprint))
+        except (OSError, ValueError):
+            pass
 
     def iter_jobs(self):
         """Yield every stored (job_id, record) pair — the scheduler's

@@ -15,10 +15,21 @@ Each job runs with:
   abandoned (status ``timeout``) when it exceeds ``job_timeout`` —
   a compiled XLA program cannot be interrupted, so the thread is left
   to finish in the background with its progress events dropped;
-- **retry with exponential backoff**: transient failures (anything but
-  :class:`~consensus_clustering_tpu.serve.executor.JobSpecError`,
-  which is the caller's fault and permanent) re-run after
-  ``backoff_base * 2**attempt`` seconds, up to ``max_retries`` times.
+- **retry with exponential backoff, from checkpoint**: failures are
+  triaged by :func:`~consensus_clustering_tpu.resilience.faults.
+  classify_error` — deterministic programming/validation errors (and
+  :class:`~consensus_clustering_tpu.serve.executor.JobSpecError`, the
+  caller's fault) fail the job immediately, while the transient
+  device/runtime class (the preemption class) re-runs after
+  ``backoff_base * 2**attempt`` seconds, up to ``max_retries`` times —
+  and each re-run hands the executor the job's checkpoint ring, so a
+  retry continues from the last completed block instead of from zero.
+  ``retry_total`` counts retries by triage reason;
+- **crash-resume**: the submitted (config, data) payload is persisted
+  in the jobstore for the job's whole non-terminal life, so the startup
+  reconciliation of a RESTARTED process re-queues orphaned jobs (they
+  then resume from their checkpoint ring) instead of failing them; only
+  orphans whose payload is missing (pre-durability stores) are failed.
 
 Job records live in memory for speed and are mirrored to the jobstore on
 every transition, so ``GET /jobs/<id>`` survives a restart.
@@ -26,6 +37,7 @@ every transition, so ``GET /jobs/<id>`` survives a restart.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -34,6 +46,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from consensus_clustering_tpu.resilience.faults import classify_error
 from consensus_clustering_tpu.serve.events import EventLog
 from consensus_clustering_tpu.serve.executor import (
     JobSpec,
@@ -41,6 +54,8 @@ from consensus_clustering_tpu.serve.executor import (
     SweepExecutor,
 )
 from consensus_clustering_tpu.serve.jobstore import JobStore
+
+logger = logging.getLogger(__name__)
 
 
 class QueueFull(Exception):
@@ -69,6 +84,7 @@ class Scheduler:
         backoff_base: float = 0.5,
         events: Optional[EventLog] = None,
         sleep=time.sleep,
+        checkpoints: bool = True,
     ):
         self.executor = executor
         self.store = store
@@ -76,6 +92,10 @@ class Scheduler:
         self.job_timeout = job_timeout
         self.max_retries = max_retries
         self.backoff_base = backoff_base
+        # False disables per-job block checkpointing (the executor runs
+        # without a ring); payload persistence and restart re-queue stay
+        # on — they cost one small write per job, not one per block.
+        self.checkpoints = checkpoints
         self._sleep = sleep  # injectable so retry tests need not wait
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._jobs: Dict[str, Dict[str, Any]] = {}
@@ -91,7 +111,11 @@ class Scheduler:
         self.jobs_failed = 0
         self.jobs_retried = 0
         self.jobs_timed_out = 0
+        self.jobs_requeued = 0
         self.cache_hits = 0
+        # Retries by classify_error reason ({"injected": 1, "oom": 2,
+        # ...}) — the /metrics retry_total{reason} satellite.
+        self.retry_total: Dict[str, int] = {}
 
     # -- lifecycle -------------------------------------------------------
 
@@ -105,30 +129,98 @@ class Scheduler:
         self._worker.start()
 
     def _reconcile_orphans(self) -> None:
-        """Fail over jobs a previous process left non-terminal.
+        """Re-queue (or, failing that, fail over) jobs a previous
+        process left non-terminal.
 
-        A record mirrored as ``queued``/``running`` whose process died
-        can never finish — its spec and data lived only in that
-        process's memory — so without this sweep a client polling from
-        before the restart would wait forever.  Jobs this scheduler
-        tracks in memory are skipped (a stop()/start() cycle within one
-        process must not fail live work).
+        The jobstore persists every job's (config, data) payload for its
+        non-terminal life, so a ``queued``/``running`` orphan from a
+        dead process is RE-QUEUED here: the worker re-runs it, and the
+        executor resumes from the job's checkpoint ring — the crash
+        costs at most one block of work plus the re-queue.  Orphans
+        whose payload is missing (stores written before durability, or a
+        crash inside the admission window) are failed as before — a
+        client polling from before the restart must terminate either
+        way.  Jobs this scheduler tracks in memory are skipped (a
+        stop()/start() cycle within one process must not touch live
+        work).
         """
         for job_id, record in self.store.iter_jobs():
             with self._lock:
                 if job_id in self._jobs:
                     continue
-            if record.get("status") in ("queued", "running"):
-                record.update(
-                    status="failed",
-                    error="interrupted by service restart",
-                    finished_at=round(time.time(), 3),
-                )
-                self.store.save_job(record)
-                self.events.emit(
-                    "job_failed", job_id=job_id,
-                    error="interrupted by service restart", kind="restart",
-                )
+            if record.get("status") not in ("queued", "running"):
+                continue
+            requeued = False
+            reason = "interrupted by service restart"
+            payload = self.store.load_payload(job_id)
+            if payload is not None:
+                spec_payload, x = payload
+                try:
+                    spec = JobSpec.from_payload(spec_payload)
+                except (KeyError, TypeError, ValueError) as e:
+                    # Schema drift (a payload written before a JobSpec
+                    # field existed): name the real cause — the operator
+                    # must not be sent chasing queue capacity.
+                    reason = (
+                        "interrupted by service restart (persisted "
+                        f"payload unusable: {e!r})"
+                    )
+                    logger.warning(
+                        "orphan %s payload unusable (%s); failing it",
+                        job_id, e,
+                    )
+                else:
+                    record.update(
+                        status="queued",
+                        requeued_after_restart=True,
+                        requeued_at=round(time.time(), 3),
+                    )
+                    record.pop("error", None)
+                    with self._lock:
+                        self._jobs[job_id] = record
+                        self._specs[job_id] = spec
+                        self._data[job_id] = x
+                    # Mirror BEFORE enqueueing (submit()'s rule): once
+                    # the worker can see the id it starts writing
+                    # "running"/"done" transitions, and this "queued"
+                    # snapshot must never land after them.
+                    self.store.save_job(dict(record))
+                    try:
+                        self._queue.put_nowait(job_id)
+                        requeued = True
+                    except queue.Full:
+                        # More orphans than queue slots: the overflow
+                        # fails over — bounded admission outranks
+                        # recovery completeness.  Undo the requeue
+                        # claim the record briefly carried.
+                        reason = (
+                            "interrupted by service restart (queue "
+                            "full on requeue)"
+                        )
+                        with self._lock:
+                            del self._jobs[job_id]
+                            del self._specs[job_id]
+                            del self._data[job_id]
+                        record.pop("requeued_after_restart", None)
+                        record.pop("requeued_at", None)
+                    if requeued:
+                        with self._lock:
+                            self.jobs_requeued += 1
+                        self.events.emit(
+                            "job_requeued", job_id=job_id,
+                            fingerprint=record.get("fingerprint"),
+                        )
+                        continue
+            record.update(
+                status="failed",
+                error=reason,
+                finished_at=round(time.time(), 3),
+            )
+            self.store.save_job(record)
+            self.store.delete_payload(job_id)
+            self.events.emit(
+                "job_failed", job_id=job_id, error=reason, kind="restart",
+            )
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
@@ -185,6 +277,23 @@ class Scheduler:
             self._jobs[job_id] = record
             self._specs[job_id] = spec
             self._data[job_id] = x
+        # Persist the payload FIRST: from the moment the record is
+        # visible as "queued", a crash must leave everything a restarted
+        # process needs to re-queue the job (config + data), or the
+        # reconciliation sweep falls back to failing it.
+        try:
+            self.store.save_payload(job_id, spec.fingerprint_payload(), x)
+        except Exception:
+            # Disk full / unwritable store: without this rollback the
+            # job would sit in _jobs as "queued" forever — never
+            # enqueued, never reconciled (reconciliation skips
+            # in-memory ids), data matrix pinned in _data.
+            with self._lock:
+                del self._jobs[job_id]
+                del self._specs[job_id]
+                del self._data[job_id]
+            self.store.delete_payload(job_id)  # any half-written part
+            raise
         # Mirror to the jobstore BEFORE enqueueing: once the worker can see
         # the job it starts writing "running"/"done" transitions, and the
         # admission-time "queued" snapshot must never land after (and
@@ -201,6 +310,7 @@ class Scheduler:
                 del self._specs[job_id]
                 del self._data[job_id]
             self.store.delete_job(job_id)
+            self.store.delete_payload(job_id)
             raise QueueFull(
                 f"queue full ({self._queue.maxsize} jobs); retry later"
             )
@@ -246,6 +356,17 @@ class Scheduler:
                 "h_effective_total": getattr(
                     self.executor, "h_effective_total", 0
                 ),
+                # Resilience counters: blocks checkpointed, runs that
+                # actually restored state, retries by triage reason,
+                # and orphans re-queued at startup.
+                "checkpoint_writes_total": getattr(
+                    self.executor, "checkpoint_writes_total", 0
+                ),
+                "checkpoint_resume_total": getattr(
+                    self.executor, "checkpoint_resume_total", 0
+                ),
+                "retry_total": dict(self.retry_total),
+                "jobs_requeued": self.jobs_requeued,
                 "sweeps_executed": self.executor.run_count,
                 "backend": self.executor.backend(),
             }
@@ -266,9 +387,18 @@ class Scheduler:
             # falls back to store.load_job, so eviction is invisible.
             with self._lock:
                 self._jobs.pop(job_id, None)
+            # The payload exists to survive a crash of a NON-terminal
+            # job; past this point it is dead weight.  The checkpoint
+            # ring goes only on success: a failed/timed-out job's ring
+            # lets an identical resubmission resume the lost progress.
+            self.store.delete_payload(job_id)
+            if snapshot.get("status") == "done" and snapshot.get(
+                "fingerprint"
+            ):
+                self.store.clear_checkpoints(snapshot["fingerprint"])
         return snapshot
 
-    def _run_with_timeout(self, spec: JobSpec, x, progress_cb, block_cb):
+    def _run_with_timeout(self, spec: JobSpec, x, progress_cb, **kwargs):
         """Run the executor, bounding wall-clock with a per-job thread.
 
         A compiled XLA program has no cancellation point (the streaming
@@ -278,7 +408,6 @@ class Scheduler:
         see the executor docstring for the attribution corner this
         accepts.
         """
-        kwargs = {} if block_cb is None else {"block_cb": block_cb}
         if self.job_timeout is None:
             return self.executor.run(spec, x, progress_cb, **kwargs)
         box: Dict[str, Any] = {}
@@ -336,6 +465,25 @@ class Scheduler:
             x = self._data.pop(job_id)
             fp = record["fingerprint"]
 
+        # Late dedup: submission-time dedup misses a twin that was
+        # still RUNNING (its result not yet stored), and a restart can
+        # re-queue an orphan whose twin completed before the crash —
+        # either way, if the byte-exact result landed in the store by
+        # now, serve it instead of re-running a whole sweep.
+        cached = self.store.get_result(fp)
+        if cached is not None:
+            with self._lock:
+                self.cache_hits += 1
+                self.jobs_completed += 1
+            self._update(
+                job_id, status="done", result=cached, from_cache=True,
+                finished_at=round(time.time(), 3),
+            )
+            self.events.emit(
+                "job_done", job_id=job_id, fingerprint=fp, cached=True,
+            )
+            return
+
         def progress_cb(k: int, pac: float) -> None:
             # The per-K signal api.py's progress plumbing already emits,
             # surfaced as a service event (name kept aligned with the
@@ -353,9 +501,15 @@ class Scheduler:
             )
 
         # Duck-typed executors (test stubs) may not stream; only a real
-        # streaming executor gets the per-block callback.
-        if not hasattr(self.executor, "default_h_block"):
-            block_cb = None
+        # streaming executor gets the per-block callback and the
+        # checkpoint ring (the resume surface).
+        run_kwargs: Dict[str, Any] = {}
+        if hasattr(self.executor, "default_h_block"):
+            run_kwargs["block_cb"] = block_cb
+            if self.checkpoints:
+                run_kwargs["checkpoint_dir"] = self.store.checkpoint_dir(
+                    fp
+                )
 
         for attempt in range(self.max_retries + 1):
             self._update(
@@ -366,7 +520,7 @@ class Scheduler:
             t0 = time.perf_counter()
             try:
                 result = self._run_with_timeout(
-                    spec, x, progress_cb, block_cb
+                    spec, x, progress_cb, **run_kwargs
                 )
             except JobTimeout as e:
                 with self._lock:
@@ -393,14 +547,25 @@ class Scheduler:
                     kind="bad_request",
                 )
                 return
-            except Exception as e:  # transient until retries exhausted
-                if attempt < self.max_retries:
+            except Exception as e:
+                # Triage before burning the retry budget: deterministic
+                # errors re-raise identically on every attempt, while
+                # the transient class (preemptions, device/runtime/IO
+                # faults) re-runs after backoff and — because the
+                # executor keeps the checkpoint ring — resumes from the
+                # last completed block, not from zero.
+                kind, reason = classify_error(e)
+                if kind == "retryable" and attempt < self.max_retries:
                     backoff = self.backoff_base * (2 ** attempt)
                     with self._lock:
                         self.jobs_retried += 1
+                        self.retry_total[reason] = (
+                            self.retry_total.get(reason, 0) + 1
+                        )
                     self.events.emit(
                         "job_retry", job_id=job_id, attempt=attempt,
                         backoff_seconds=backoff, error=str(e),
+                        reason=reason,
                     )
                     self._sleep(backoff)
                     continue
@@ -412,7 +577,10 @@ class Scheduler:
                 )
                 self.events.emit(
                     "job_failed", job_id=job_id, error=str(e),
-                    kind="retries_exhausted",
+                    kind=(
+                        "retries_exhausted" if kind == "retryable"
+                        else f"fatal:{reason}"
+                    ),
                 )
                 return
             seconds = time.perf_counter() - t0

@@ -12,10 +12,17 @@ framework, nothing the container doesn't already have.  Endpoints:
 - ``GET /jobs/<id>``   — poll a job; embeds ``result`` once done.
 - ``GET /healthz``     — liveness: status, backend label, uptime.
 - ``GET /metrics``     — queue depth/capacity, jobs completed/failed/
-  retried/timed-out, jobstore ``cache_hits``, in-process
-  ``executable_cache_hits``, ``sweeps_executed``, and ``backend``
-  (``tpu`` | ``cpu-fallback``, bench.py's ``measurement_backend``
-  convention).
+  retried/timed-out/requeued, jobstore ``cache_hits``, in-process
+  ``executable_cache_hits``, ``sweeps_executed``, the resilience
+  counters (``checkpoint_writes_total``, ``checkpoint_resume_total``,
+  ``retry_total`` by triage reason), and ``backend`` (``tpu`` |
+  ``cpu-fallback``, bench.py's ``measurement_backend`` convention).
+
+Durability (docs/SERVING.md "Crash recovery"): submitted jobs persist
+their (config, data) payload, streamed executions checkpoint block
+state into the jobstore's per-fingerprint ring, and a restarted process
+re-queues orphaned jobs which then resume from their last completed
+block — SIGKILL mid-job costs at most one block of work.
 
 Run it with ``python -m consensus_clustering_tpu serve`` or embed
 :class:`ConsensusService` (``start()``/``stop()``) — the test suite does
@@ -143,6 +150,7 @@ class ConsensusService:
         events_path: Optional[str] = None,
         executor: Optional[SweepExecutor] = None,
         max_body_bytes: int = _DEFAULT_MAX_BODY,
+        job_checkpoints: bool = True,
     ):
         self.store = JobStore(store_dir)
         self.events = EventLog(events_path)
@@ -155,6 +163,7 @@ class ConsensusService:
             max_retries=max_retries,
             backoff_base=backoff_base,
             events=self.events,
+            checkpoints=job_checkpoints,
         )
         self.max_body_bytes = max_body_bytes
         self.started_at = time.time()

@@ -36,6 +36,15 @@ def _fingerprint(config: SweepConfig, seed: int) -> str:
     # so neither may invalidate checkpoints.
     payload.pop("chunk_size")
     payload.pop("use_pallas", None)
+    # stream_h_block is an execution strategy, not a semantic: the
+    # streamed sweep is bit-exact to the monolithic one at full H (the
+    # PR-3 parity proof), so block size must not invalidate per-K
+    # checkpoints.  NORMALIZED rather than popped: existing checkpoint
+    # dirs were fingerprinted with the key present ("stream_h_block":
+    # null for every non-streamed sweep), and dropping the key would
+    # invalidate all of them on upgrade.  The adaptive_* knobs stay IN
+    # — they change h_effective, which changes the accumulated counts.
+    payload["stream_h_block"] = None
     blob = json.dumps(payload, sort_keys=True).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
 
@@ -53,6 +62,69 @@ def data_fingerprint(x: np.ndarray) -> str:
     h.update(repr(x.shape).encode())
     h.update(x.tobytes())
     return h.hexdigest()[:16]
+
+
+def stream_fingerprint(
+    config: SweepConfig,
+    seed: int,
+    data_sha: str,
+    *,
+    n_iterations: Optional[int] = None,
+    adaptive_tol: Optional[float] = None,
+    adaptive_patience: Optional[int] = None,
+    adaptive_min_h: Optional[int] = None,
+) -> str:
+    """Identity of a streamed sweep's BLOCK-granular resume state.
+
+    The per-K scheme (:func:`_fingerprint`) extended for mid-sweep
+    state, which is strictly more identity-sensitive than a completed
+    K's result:
+
+    - ``data_sha`` rides along — a block checkpoint carries raw count
+      accumulators, and resuming them onto different data silently
+      blends two datasets (the per-K scheme never needed this because
+      api-level resumes pass the same X by contract; the serving path
+      cannot assume that).
+    - ``k_values`` stay IN (popped by the per-K scheme): the state
+      stacks ALL swept Ks, so the K list and its order are part of the
+      layout.
+    - ``stream_h_block`` stays IN (popped by the per-K scheme): the
+      block size sets the boundaries ``h_done`` snaps to and the points
+      the adaptive trajectory was evaluated at — resuming a block-16
+      trajectory with a block-32 driver would re-decide early stops at
+      different H.
+    - The resolved RUNTIME knobs (H and the adaptive settings, which
+      the serving executor overrides per run) replace the build-config
+      values: they determine masking of the final block and every stop
+      decision.
+
+    ``store_matrices``/``chunk_size``/``use_pallas`` are excluded for
+    the per-K scheme's reasons — exact integer counts either way.
+    """
+    payload = dataclasses.asdict(config)
+    payload["seed"] = seed
+    payload.pop("store_matrices")
+    payload.pop("chunk_size")
+    payload.pop("use_pallas", None)
+    payload["n_iterations"] = (
+        config.n_iterations if n_iterations is None else int(n_iterations)
+    )
+    payload["adaptive_tol"] = (
+        config.adaptive_tol if adaptive_tol is None else float(adaptive_tol)
+    )
+    payload["adaptive_patience"] = (
+        config.adaptive_patience if adaptive_patience is None
+        else int(adaptive_patience)
+    )
+    payload["adaptive_min_h"] = (
+        config.adaptive_min_h if adaptive_min_h is None
+        else int(adaptive_min_h)
+    )
+    blob = json.dumps(
+        {"scheme": "stream-v1", "config": payload, "data_sha": data_sha},
+        sort_keys=True,
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
 
 
 def job_fingerprint(payload: Dict, x: np.ndarray) -> str:

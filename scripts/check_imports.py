@@ -37,6 +37,7 @@ EXPECTED_SUBPACKAGES = (
     "consensus_clustering_tpu.models",
     "consensus_clustering_tpu.ops",
     "consensus_clustering_tpu.parallel",
+    "consensus_clustering_tpu.resilience",
     "consensus_clustering_tpu.serve",
     "consensus_clustering_tpu.utils",
 )

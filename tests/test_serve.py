@@ -157,10 +157,12 @@ def test_metrics_schema(base):
     assert code == 200
     for field in (
         "queue_depth", "queue_capacity", "jobs_completed", "jobs_failed",
-        "jobs_retried", "jobs_timed_out", "cache_hits",
+        "jobs_retried", "jobs_timed_out", "jobs_requeued", "cache_hits",
         "executable_cache_hits", "sweeps_executed", "backend",
+        "checkpoint_writes_total", "checkpoint_resume_total", "retry_total",
     ):
         assert field in m, field
+    assert isinstance(m["retry_total"], dict)
 
 
 def test_events_jsonl_lifecycle(base, service):

@@ -157,7 +157,15 @@ class TestSweepSingleDevice:
 
 
 class TestSweepSharded:
-    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    # Mid-size params of the invariance families ride the slow lane
+    # (PR-3's tier-1 budget rule: each family keeps its boundary cases
+    # fast — the smallest mesh and the full 8-device one here — and the
+    # interior duplicates, each a 7-11s compile, run outside the 870s
+    # fast-lane budget).
+    @pytest.mark.parametrize(
+        "n_dev",
+        [2, pytest.param(4, marks=pytest.mark.slow), 8],
+    )
     def test_device_count_invariance(self, blobs, n_dev):
         # The psum-sharded sweep must equal the 1-device run bit-for-bit:
         # something the reference's racy joblib backends could never offer
@@ -186,7 +194,16 @@ class TestSweepSharded:
         # Each point appears in exactly H * n_sub total slots.
         assert ref["iij"].astype(np.int64).trace() == 13 * config.n_sub
 
-    @pytest.mark.parametrize("h_shards,row_shards", [(4, 2), (2, 4), (1, 8)])
+    @pytest.mark.parametrize(
+        "h_shards,row_shards",
+        [
+            (4, 2),
+            # Interior dup on the slow lane (budget rule above): (4,2)
+            # and the all-rows (1,8) extreme stay fast.
+            pytest.param(2, 4, marks=pytest.mark.slow),
+            (1, 8),
+        ],
+    )
     def test_row_sharding_invariance(self, blobs, h_shards, row_shards):
         # Sharding consensus-matrix ROWS over the 'n' axis (the long-context
         # analog, SURVEY.md §5.7) must be bit-identical to the 1-device run,
@@ -296,7 +313,15 @@ class TestSweepSharded:
 
 class TestKShardedSweep:
     @pytest.mark.parametrize(
-        "k_shards,h_shards,row_shards", [(2, 4, 1), (2, 2, 2), (4, 2, 1)]
+        "k_shards,h_shards,row_shards",
+        [
+            # k+h-only dup on the slow lane (the tier-1 budget rule in
+            # TestSweepSharded): the full three-axis (2,2,2) mesh and
+            # the max-k (4,2,1) split keep the coverage fast.
+            pytest.param(2, 4, 1, marks=pytest.mark.slow),
+            (2, 2, 2),
+            (4, 2, 1),
+        ],
     )
     def test_k_sharding_invariance(self, blobs, k_shards, h_shards, row_shards):
         # The K sweep sharded over the 'k' mesh axis (each k-group runs
@@ -341,7 +366,15 @@ class TestKShardedSweep:
         with pytest.raises(ValueError, match="not divisible"):
             resample_mesh(jax.devices(), k_shards=3)
 
-    @pytest.mark.parametrize("k_shards,row_shards", [(2, 2), (4, 1)])
+    @pytest.mark.parametrize(
+        "k_shards,row_shards",
+        [
+            (2, 2),
+            # k-only dup on the slow lane (tier-1 budget rule): the
+            # mixed k+row (2,2) mesh keeps the un-permute coverage fast.
+            pytest.param(4, 1, marks=pytest.mark.slow),
+        ],
+    )
     def test_k_interleave_is_bit_identical(self, blobs, k_shards,
                                            row_shards):
         # Round-robin K assignment (k_interleave) changes only WHICH
