@@ -495,6 +495,15 @@ def main(argv=None):
         help="resample floor before an adaptive stop may trigger",
     )
     parser.add_argument(
+        "--autotune", nargs="?", const="", default=None, metavar="DIR",
+        help="resolve unset perf knobs (KMeans max_iter, cluster_batch) "
+        "from the autotune calibration store (bare flag: the committed "
+        "benchmarks/calibration seeds).  Only parity-gated records for "
+        "THIS environment and shape bucket apply, a knob the config "
+        "pins is never overridden, and every resolution is disclosed "
+        "in the record next to vs_baseline (docs/AUTOTUNE.md)",
+    )
+    parser.add_argument(
         "--stream-ckpt-dir", default=None,
         help="with --stream: checkpoint the block state into this "
         "directory while benchmarking (resilience.StreamCheckpointer), "
@@ -551,6 +560,51 @@ def main(argv=None):
 
     clusterer, config, x, metric, baseline_key = _build(args.config, small)
     repeats = 1 if backend == "cpu" else max(1, args.repeats)
+
+    autotune_disclosure = None
+    if args.autotune is not None:
+        # Calibrated-knob resolution, disclosed next to vs_baseline:
+        # the serial baseline ran sklearn's own defaults (e.g.
+        # max_iter=300), so any capped/tuned knob must be stated in the
+        # same record as the speedup it helped produce — never silent
+        # (ROADMAP; the max_iter pin rule in decide_maxiter.py).
+        import dataclasses
+
+        from consensus_clustering_tpu.autotune.policy import (
+            AutotunePolicy,
+            default_calibration_dir,
+        )
+        from consensus_clustering_tpu.autotune.store import (
+            CalibrationStore,
+            shape_bucket,
+        )
+        from consensus_clustering_tpu.models.kmeans import KMeans
+
+        store_dir = args.autotune or default_calibration_dir()
+        policy = AutotunePolicy(CalibrationStore(store_dir))
+        bucket = shape_bucket(
+            config.n_samples, config.n_features, config.n_iterations,
+            config.k_values,
+        )
+        autotune_disclosure = {"store": store_dir, "bucket": bucket}
+        if isinstance(clusterer, KMeans):
+            r = policy.resolve(
+                "max_iter", bucket, default=clusterer.max_iter
+            )
+            if r.provenance == "calibrated":
+                clusterer = dataclasses.replace(
+                    clusterer, max_iter=int(r.value)
+                )
+                metric += f" [max_iter={int(r.value)} calibrated]"
+            autotune_disclosure["max_iter"] = r.disclosure()
+        r = policy.resolve(
+            "cluster_batch", bucket, pinned=config.cluster_batch
+        )
+        if r.provenance == "calibrated" and r.value is not None:
+            config = dataclasses.replace(
+                config, cluster_batch=int(r.value)
+            )
+        autotune_disclosure["cluster_batch"] = r.disclosure()
     if args.stream:
         import dataclasses
 
@@ -628,6 +682,11 @@ def main(argv=None):
         "value": round(rate, 2),
         "unit": "resamples/sec",
         "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+        # Calibrated-knob disclosure sits NEXT TO vs_baseline by design:
+        # a reader of the speedup must see in the same breath which
+        # knobs calibration set (absent without --autotune).
+        **({"autotune": autotune_disclosure}
+           if autotune_disclosure is not None else {}),
         "backend": backend,
         "sweep_wall_seconds": round(wall, 4),
         "compile_seconds": round(out["timing"]["compile_seconds"], 2),

@@ -128,11 +128,14 @@ class ConsensusClustering:
         groups let each sub-batch's Lloyd loop stop at its own slowest
         member instead of the sweep-wide slowest — bit-identical labels,
         less lockstep waste, serialised groups (see SweepConfig).
-    split_init : bool, keyword-only
+    split_init : bool, keyword-only, optional
         With ``cluster_batch`` set and the native KMeans clusterer,
         compute every lane's k-means++ init outside the sub-batch groups
         in one full-width vmapped pass and group only the Lloyd loop —
         bit-identical labels, full-size init GEMMs (see SweepConfig).
+        None (the default) means *unset*: it behaves as False unless
+        ``autotune=True`` resolves a calibrated A/B verdict for this
+        shape; pass an explicit bool to pin it either way.
     k_interleave : bool, keyword-only
         With a 'k'-sharded mesh, assign K values to the k-groups
         round-robin instead of in contiguous blocks, spreading the
@@ -206,6 +209,23 @@ class ConsensusClustering:
     adaptive_patience, adaptive_min_h : keyword-only
         Early-stop patience (consecutive quiet blocks, default 2) and
         resample floor (default 0) — see ``SweepConfig``.
+    autotune : bool, keyword-only
+        Fill UNSET performance knobs (``cluster_batch``, ``split_init``,
+        ``stream_h_block``, and the default KMeans clusterer's
+        ``max_iter``) from the calibration store's parity-gated records
+        for this environment × shape bucket (docs/AUTOTUNE.md).  Only
+        bit-identical-gated knobs are filled — the statistic cannot
+        move — and never a knob you set yourself (user pins outrank
+        calibration).  ``metrics_["autotune"]`` discloses every
+        resolution with its provenance tier (``user-pinned`` >
+        ``calibrated`` > ``default``).  No-op (with a log message) for
+        host-backend clusterers: none of these knobs steer the host
+        labelling loop, and a disclosure must never claim a value
+        steered a run it could not touch.
+    calibration_dir : str, keyword-only, optional
+        Calibration store for ``autotune=True`` (default: the repo's
+        committed ``benchmarks/calibration`` seeds, or
+        ``CCTPU_CALIBRATION_DIR``).
 
     Attributes
     ----------
@@ -243,7 +263,7 @@ class ConsensusClustering:
         bins: int = 20,
         chunk_size: int = 8,
         cluster_batch: Optional[int] = None,
-        split_init: bool = False,
+        split_init: Optional[bool] = None,
         k_interleave: bool = False,
         compute_consensus_labels: bool = False,
         reseed_clusterer_per_resample: bool = False,
@@ -260,6 +280,8 @@ class ConsensusClustering:
         adaptive_tol: Optional[float] = None,
         adaptive_patience: int = 2,
         adaptive_min_h: int = 0,
+        autotune: bool = False,
+        calibration_dir: Optional[str] = None,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -334,6 +356,12 @@ class ConsensusClustering:
         self.adaptive_tol = adaptive_tol
         self.adaptive_patience = adaptive_patience
         self.adaptive_min_h = adaptive_min_h
+        self.autotune = autotune
+        self.calibration_dir = calibration_dir
+        # Calibrated clusterer options (currently the default KMeans'
+        # max_iter): set by the fit-time resolution, merged by
+        # _effective_options without outranking anything explicit.
+        self._autotune_options: Dict[str, Any] = {}
 
     # -- clusterer resolution -------------------------------------------
 
@@ -383,6 +411,11 @@ class ConsensusClustering:
                 accepts = False
             if not accepts:
                 options.pop("n_init")
+        for name, value in self._autotune_options.items():
+            # Calibrated options never outrank an explicit one (the
+            # fit-time resolution only sets them when the user left the
+            # knob unset, but setdefault keeps the invariant local).
+            options.setdefault(name, value)
         return options
 
     # -- fit -------------------------------------------------------------
@@ -430,6 +463,100 @@ class ConsensusClustering:
                 "N); pass store_matrices=True explicitly"
             )
 
+        # Autotune resolution (docs/AUTOTUNE.md): fill UNSET perf knobs
+        # from parity-gated calibration, user pins always winning.  Only
+        # bit-identical-gated knobs are filled here — cluster_batch,
+        # split_init, stream_h_block (full-H streaming is bit-exact) and
+        # the default KMeans' max_iter — never adaptive_tol, which
+        # trades resamples for bounded PAC drift and stays an explicit
+        # opt-in at this surface.
+        cluster_batch = self.cluster_batch
+        split_init = self.split_init
+        stream_h_block = self.stream_h_block
+        self._autotune_options = {}
+        self.autotune_ = None
+        # A host-backend clusterer (sklearn estimator / HostClusterer)
+        # labels resamples in a Python loop: none of the resolvable
+        # knobs steer that path, so resolving there would disclose
+        # "calibrated" for values with zero effect — worse than silent.
+        _c = self.clusterer
+        _is_hostish = isinstance(_c, HostClusterer) or (
+            _c is not None
+            and hasattr(_c, "fit_predict")
+            and hasattr(_c, "get_params")
+        )
+        if self.autotune and _is_hostish:
+            logger.info(
+                "autotune: host-backend clusterer — the resolvable "
+                "knobs (cluster_batch/split_init/stream_h_block/"
+                "max_iter) are device-path features; nothing to resolve"
+            )
+        if self.autotune and not _is_hostish:
+            from consensus_clustering_tpu.autotune.policy import (
+                AutotunePolicy,
+                Resolution,
+                default_calibration_dir,
+            )
+            from consensus_clustering_tpu.autotune.store import (
+                CalibrationStore,
+                shape_bucket,
+            )
+
+            policy = AutotunePolicy(CalibrationStore(
+                self.calibration_dir or default_calibration_dir()
+            ))
+            bucket = shape_bucket(
+                n, d, self.n_iterations, tuple(self.K_range)
+            )
+            r_stream = policy.resolve(
+                "stream_h_block", bucket, pinned=self.stream_h_block
+            )
+            if (
+                r_stream.provenance == "calibrated"
+                and not (r_stream.record.get("speedup") or 0) > 1.0
+            ):
+                # The stream_h_block record answers "which block size
+                # GIVEN streaming" — serving needs it at any speedup
+                # because serving always streams — but this surface's
+                # unset default is the MONOLITHIC program, and the
+                # record's own evidence (speedup vs the monolithic
+                # baseline) says streaming lost at this bucket.
+                # Adopting it would make autotune=True a pessimization.
+                logger.info(
+                    "autotune: calibrated stream_h_block=%s not adopted "
+                    "(streamed at %.2fx the monolithic rate at this "
+                    "bucket); keeping the monolithic default",
+                    r_stream.record.get("value"),
+                    r_stream.record.get("speedup") or 0.0,
+                )
+                r_stream = Resolution("stream_h_block", None, "default")
+            resolutions = [
+                policy.resolve(
+                    "cluster_batch", bucket, pinned=self.cluster_batch
+                ),
+                policy.resolve(
+                    "split_init", bucket, pinned=self.split_init,
+                    default=False,
+                ),
+                r_stream,
+            ]
+            cluster_batch, split_init, stream_h_block = (
+                r.value for r in resolutions
+            )
+            if self.clusterer is None and (
+                "max_iter" not in self.clusterer_options
+            ):
+                # The default-clusterer path is the only one where
+                # max_iter is provably unset; an explicit clusterer
+                # instance (whatever its fields) is a pin.
+                r = policy.resolve("max_iter", bucket)
+                if r.value is not None:
+                    self._autotune_options = {"max_iter": int(r.value)}
+                resolutions.append(r)
+            self.autotune_ = {
+                r.knob: r.disclosure() for r in resolutions
+            }
+
         config = SweepConfig(
             n_samples=n,
             n_features=d,
@@ -441,11 +568,11 @@ class ConsensusClustering:
             parity_zeros=self.parity_zeros,
             store_matrices=self._resolve_store_matrices(n),
             chunk_size=self.chunk_size,
-            cluster_batch=self.cluster_batch,
-            split_init=self.split_init,
+            cluster_batch=cluster_batch,
+            split_init=bool(split_init),
             k_interleave=self.k_interleave,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
-            stream_h_block=self.stream_h_block,
+            stream_h_block=stream_h_block,
             adaptive_tol=self.adaptive_tol,
             adaptive_patience=self.adaptive_patience,
             adaptive_min_h=self.adaptive_min_h,
@@ -615,6 +742,10 @@ class ConsensusClustering:
             self.metrics_["streaming"] = streaming_infos[-1]
             if len(streaming_infos) > 1:
                 self.metrics_["streaming_batches"] = streaming_infos
+        if self.autotune_ is not None:
+            # Disclose every resolution with its provenance tier next
+            # to the timings it shaped (the never-silent rule).
+            self.metrics_["autotune"] = self.autotune_
 
         metrics_logger.emit(
             "sweep_complete",

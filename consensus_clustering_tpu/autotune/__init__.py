@@ -1,0 +1,56 @@
+"""Autotune: parity-gated probes, calibration store, knob resolution.
+
+Three pieces (docs/AUTOTUNE.md):
+
+- :mod:`.probes` — the measurement registry.  Every probe times a knob's
+  candidates at a shape bucket and asserts PAC parity (bit-identical, or
+  within a stated tolerance it records) before a result may become a
+  recommendation.
+- :mod:`.store` — the schema-versioned calibration database: atomic JSON
+  records keyed by environment fingerprint × shape bucket × knob, with
+  the refuse-foreign-fingerprint rule of
+  ``utils/checkpoint.stream_fingerprint``.
+- :mod:`.policy` — resolution for ``api.py``, ``serve/executor.py`` and
+  ``bench.py``: ``user-pinned`` > ``calibrated`` > ``default``, never
+  overriding a pin, always disclosing which tier answered.
+"""
+
+import importlib
+
+# Lazy exports (PEP 562, the root package's pattern): the CLI builds the
+# ``autotune`` argparse subtree from :mod:`.cli` on EVERY invocation —
+# including ``lint``, which must stay importable with no numpy/jax
+# installed (the zero-dependency CI job) — so this __init__ must not
+# pull :mod:`.policy`/:mod:`.store` (→ config → numpy) eagerly.
+_EXPORTS = {
+    "AutotunePolicy": "consensus_clustering_tpu.autotune.policy",
+    "PROVENANCE_CALIBRATED": "consensus_clustering_tpu.autotune.policy",
+    "PROVENANCE_DEFAULT": "consensus_clustering_tpu.autotune.policy",
+    "PROVENANCE_USER": "consensus_clustering_tpu.autotune.policy",
+    "Resolution": "consensus_clustering_tpu.autotune.policy",
+    "default_calibration_dir": "consensus_clustering_tpu.autotune.policy",
+    "CalibrationError": "consensus_clustering_tpu.autotune.store",
+    "CalibrationStore": "consensus_clustering_tpu.autotune.store",
+    "ForeignFingerprintError": "consensus_clustering_tpu.autotune.store",
+    "SCHEMA_VERSION": "consensus_clustering_tpu.autotune.store",
+    "SchemaVersionError": "consensus_clustering_tpu.autotune.store",
+    "env_fingerprint": "consensus_clustering_tpu.autotune.store",
+    "environment": "consensus_clustering_tpu.autotune.store",
+    "make_record": "consensus_clustering_tpu.autotune.store",
+    "shape_bucket": "consensus_clustering_tpu.autotune.store",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

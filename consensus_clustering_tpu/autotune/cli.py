@@ -1,0 +1,196 @@
+"""``python -m consensus_clustering_tpu autotune run|show|diff``.
+
+The measurement front door (docs/AUTOTUNE.md): ``run`` executes the
+parity-gated probe suite under a ``--budget`` seconds cap and prints one
+JSON summary line (the bench.py contract), ``show`` lists a store's
+records, ``diff`` compares two stores' recommendations.  The next
+on-chip session is one command —
+
+    python -m consensus_clustering_tpu autotune run --shapes full \
+        --store benchmarks/calibration --budget 3600
+
+— instead of the old shell-script checklist (``maxiter_probe.py`` +
+``decide_maxiter.py`` + ``onchip_followup.sh`` steps).
+
+Exit codes (``run``): 0 = every executed gate passed (budget-skips are
+fine), 1 = a parity gate failed (a recommendation's correctness premise
+broke — the CI smoke job's trigger), 2 = usage.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict
+
+
+def add_arguments(parser) -> None:
+    sub = parser.add_subparsers(dest="autotune_cmd", required=True)
+
+    run = sub.add_parser(
+        "run", help="run the parity-gated probe suite"
+    )
+    run.add_argument(
+        "--store", default=None,
+        help="calibration store directory (default: the committed "
+        "benchmarks/calibration seeds; CCTPU_CALIBRATION_DIR overrides)",
+    )
+    run.add_argument(
+        "--probe", action="append", default=None, metavar="NAME",
+        help="run only this probe (repeatable; default: all). "
+        "Available: max_iter, cluster_batch, split_init, "
+        "stream_h_block, adaptive_tol",
+    )
+    run.add_argument(
+        "--budget", type=float, default=None, metavar="SECONDS",
+        help="wall-clock cap: measurements that don't fit are reported "
+        "budget-skipped, never half-run (default: unbounded)",
+    )
+    run.add_argument(
+        "--shapes", choices=["smoke", "small", "full"], default="small",
+        help="probe shape scale: smoke (CI seconds), small (CPU "
+        "minutes — the committed seed records), full (the bench "
+        "shapes, for the on-chip session)",
+    )
+    run.add_argument("--seed", type=int, default=23)
+    run.add_argument(
+        "--repeats", type=int, default=1,
+        help="re-execute each compiled sweep this many times and time "
+        "the fastest (>1 on chip filters shared-tunnel noise)",
+    )
+
+    show = sub.add_parser("show", help="list a store's records")
+    show.add_argument("--store", default=None)
+    show.add_argument(
+        "--this-env-only", action="store_true",
+        help="only records the current environment would resolve",
+    )
+
+    diff = sub.add_parser(
+        "diff", help="compare two stores' recommendations"
+    )
+    diff.add_argument("--store", default=None)
+    diff.add_argument(
+        "--against", required=True,
+        help="the other store directory to compare with",
+    )
+
+
+def _store_dir(args) -> str:
+    if args.store:
+        return args.store
+    from consensus_clustering_tpu.autotune.policy import (
+        default_calibration_dir,
+    )
+
+    return default_calibration_dir()
+
+
+def cmd_autotune(args) -> int:
+    return {"run": _cmd_run, "show": _cmd_show, "diff": _cmd_diff}[
+        args.autotune_cmd
+    ](args)
+
+
+def _cmd_run(args) -> int:
+    from consensus_clustering_tpu.autotune.probes import (
+        Budget,
+        ProbeContext,
+        get_probe,
+        list_probes,
+        run_probes,
+    )
+    from consensus_clustering_tpu.autotune.store import CalibrationStore
+
+    names = args.probe or [p.name for p in list_probes()]
+    try:
+        for name in names:
+            get_probe(name)
+    except KeyError as e:
+        print(f"autotune: {e.args[0]}", file=sys.stderr)
+        return 2
+    if args.repeats < 1:
+        print("autotune: --repeats must be >= 1", file=sys.stderr)
+        return 2
+    store = CalibrationStore(_store_dir(args))
+    ctx = ProbeContext(
+        store=store,
+        budget=Budget(args.budget),
+        shapes=args.shapes,
+        seed=args.seed,
+        repeats=args.repeats,
+    )
+    summaries, gate_failed = run_probes(names, ctx)
+    payload: Dict[str, Any] = {
+        "store": store.directory,
+        "env": store.env,
+        "env_fingerprint": store.env_fp,
+        "shapes": args.shapes,
+        "budget_seconds": args.budget,
+        "elapsed_seconds": round(ctx.budget.elapsed(), 1),
+        "records_written": sum(len(s["records"]) for s in summaries),
+        "gate_failed": gate_failed,
+        "probes": summaries,
+    }
+    print(json.dumps(payload))
+    return 1 if gate_failed else 0
+
+
+def _cmd_show(args) -> int:
+    from consensus_clustering_tpu.autotune.store import CalibrationStore
+
+    store = CalibrationStore(_store_dir(args))
+    records = store.records(all_envs=not args.this_env_only)
+    print(json.dumps({
+        "store": store.directory,
+        "current_env_fingerprint": store.env_fp,
+        "records": [
+            dict(record, path=path) for path, record in records
+        ],
+    }, indent=1))
+    return 0
+
+
+def _cmd_diff(args) -> int:
+    from consensus_clustering_tpu.autotune.store import CalibrationStore
+
+    a = CalibrationStore(_store_dir(args))
+    b = CalibrationStore(args.against)
+
+    def _index(store):
+        out = {}
+        for path, record in store.records(all_envs=True):
+            if "error" in record:
+                continue
+            key = (
+                record["env_fingerprint"], record["knob"],
+                record["bucket"],
+            )
+            out[key] = record
+        return out
+
+    ia, ib = _index(a), _index(b)
+    diffs = []
+    for key in sorted(set(ia) | set(ib)):
+        ra, rb = ia.get(key), ib.get(key)
+        if ra is not None and rb is not None:
+            if ra.get("value") != rb.get("value"):
+                diffs.append({
+                    "env_fingerprint": key[0], "knob": key[1],
+                    "bucket": key[2], "status": "value-differs",
+                    "value_a": ra.get("value"), "value_b": rb.get("value"),
+                })
+        else:
+            diffs.append({
+                "env_fingerprint": key[0], "knob": key[1],
+                "bucket": key[2],
+                "status": "only-in-a" if rb is None else "only-in-b",
+            })
+    print(json.dumps({
+        "store_a": a.directory,
+        "store_b": b.directory,
+        "records_a": len(ia),
+        "records_b": len(ib),
+        "differences": diffs,
+    }, indent=1))
+    return 0

@@ -8,6 +8,7 @@ one for benchmarking and batch use:
     python -m consensus_clustering_tpu bench
     python -m consensus_clustering_tpu serve --port 8000   # docs/SERVING.md
     python -m consensus_clustering_tpu lint                # docs/LINT.md
+    python -m consensus_clustering_tpu autotune run        # docs/AUTOTUNE.md
 
 Results are written as JSON (PAC / CDF curves and stability statistics);
 matrices stay out of the JSON by design.
@@ -255,12 +256,21 @@ def cmd_serve(args):
     )
 
     logging.basicConfig(level=logging.INFO)
+    calibration = None
+    if args.calibration_dir:
+        from consensus_clustering_tpu.autotune.store import CalibrationStore
+
+        calibration = CalibrationStore(args.calibration_dir)
     executor = SweepExecutor(
-        # 0 = autotune per job (block ≈ H/8 clamped to [16, 128], the
-        # ROADMAP serving heuristic); a positive value pins one default
-        # block size for jobs that don't set stream_h_block themselves.
+        # 0 = resolve per job through the autotune policy: a calibrated
+        # block size for this (environment, shape bucket) when the
+        # store has one, else the H/8-clamped-[16,128] heuristic as the
+        # default tier.  A positive value pins one block size for every
+        # job that doesn't set stream_h_block itself (user-pinned
+        # tier) — docs/AUTOTUNE.md "Provenance".
         default_h_block=args.stream_block or None,
         checkpoint_every=args.checkpoint_every,
+        calibration_store=calibration,
     )
     service = ConsensusService(
         store_dir=args.store_dir,
@@ -299,11 +309,11 @@ def cmd_serve(args):
         secs = executor.warmup(spec, n, d)
         # The streamed block program is H-agnostic, so one warmup covers
         # every iterations value at this shape that resolves to the same
-        # block size — every H with a pinned --stream-block; under
-        # autotune (--stream-block 0) the spec's H picks the block the
-        # heuristic would (H values autotuning to another block compile
-        # their own bucket).
-        block = executor._resolve_h_block(spec)
+        # block size — every H with a pinned --stream-block; under the
+        # policy default (--stream-block 0) the spec's H and shape pick
+        # the block (calibrated record, else the H/8 heuristic; H values
+        # resolving to another block compile their own bucket).
+        block = executor._resolve_h_block(spec, n, d).value
         print(
             f"warmed bucket n={n} d={d} k={spec.k_values} "
             f"h_block={block} in {secs:.1f}s",
@@ -334,6 +344,12 @@ def cmd_lint(args):
     from consensus_clustering_tpu.lint.runner import run as lint_run
 
     raise SystemExit(lint_run(args))
+
+
+def cmd_autotune(args):
+    from consensus_clustering_tpu.autotune.cli import cmd_autotune as run
+
+    raise SystemExit(run(args))
 
 
 def main(argv=None):
@@ -448,8 +464,14 @@ def main(argv=None):
                          help="default resamples per streamed H-block "
                          "for jobs that don't set stream_h_block "
                          "(part of the executable bucket); 0 (default) "
-                         "autotunes per job: block = H/8 clamped to "
-                         "[16, 128]")
+                         "resolves per job: calibrated block size when "
+                         "--calibration-dir has a matching record, "
+                         "else H/8 clamped to [16, 128]")
+    serve_p.add_argument("--calibration-dir", default=None,
+                         help="autotune calibration store consulted "
+                         "for jobs that don't pin stream_h_block "
+                         "(docs/AUTOTUNE.md); resolution provenance is "
+                         "disclosed per result and in /metrics")
     serve_p.add_argument("--checkpoint-every", type=int, default=1,
                          help="checkpoint the streamed block state every "
                          "N evaluated blocks (1 = every block; a "
@@ -475,6 +497,18 @@ def main(argv=None):
 
     add_arguments(lint_p)
     lint_p.set_defaults(fn=cmd_lint)
+
+    autotune_p = sub.add_parser(
+        "autotune",
+        help="parity-gated perf probes + calibration store "
+        "(docs/AUTOTUNE.md)",
+    )
+    from consensus_clustering_tpu.autotune.cli import (
+        add_arguments as autotune_add_arguments,
+    )
+
+    autotune_add_arguments(autotune_p)
+    autotune_p.set_defaults(fn=cmd_autotune)
 
     args = parser.parse_args(argv)
     if args.cmd != "lint":

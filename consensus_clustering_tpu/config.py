@@ -21,6 +21,11 @@ from consensus_clustering_tpu.ops.resample import subsample_size
 def autotune_stream_block(n_iterations: int) -> int:
     """Serving-side default H-block size: ``H // 8`` clamped to [16, 128].
 
+    Since the autotune subsystem (docs/AUTOTUNE.md) this heuristic is
+    the DEFAULT tier of ``autotune.policy.resolve_stream_block`` — a
+    parity-gated calibration record for the (environment, shape bucket)
+    outranks it, a user/operator pin outranks both.
+
     The ROADMAP heuristic (follow-up from the streaming engine): the
     per-block overhead is one extra per-K consensus-histogram pass, so
     tiny blocks tax small jobs, while blocks beyond ~128 stop buying

@@ -41,7 +41,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from consensus_clustering_tpu.config import SweepConfig, autotune_stream_block
+from consensus_clustering_tpu.config import SweepConfig
 
 _CLUSTERERS = ("kmeans", "gmm", "agglomerative", "spectral")
 
@@ -346,6 +346,7 @@ class SweepExecutor:
         use_compilation_cache: bool = True,
         default_h_block: Optional[int] = None,
         checkpoint_every: int = 1,
+        calibration_store=None,
     ):
         if default_h_block is not None and default_h_block < 1:
             raise ValueError(
@@ -356,12 +357,38 @@ class SweepExecutor:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}"
             )
-        # None: ROADMAP's serving heuristic — block ≈ H/8 clamped to
-        # [16, 128], resolved per job from its requested H
-        # (config.autotune_stream_block).  An integer pins one block
-        # size for every job that doesn't set stream_h_block itself.
+        # None: resolve per job through the autotune policy (a
+        # calibrated record for this environment × shape bucket when
+        # ``calibration_store`` has one, else the H/8-clamped-[16,128]
+        # heuristic as the default tier — autotune/policy.py).  An
+        # integer pins one block size for every job that doesn't set
+        # stream_h_block itself (user-pinned tier, never overridden).
         self.default_h_block = default_h_block
+        self.calibration_store = calibration_store
         self.checkpoint_every = checkpoint_every
+        # Resolutions by provenance tier over EXECUTED jobs — the
+        # /metrics autotune_provenance_total satellite: an operator can
+        # see live whether calibration actually steers traffic or
+        # everything still lands on the heuristic default.  PRE-SEEDED
+        # with every tier so the key set never changes after
+        # construction: the scheduler's metrics() dict-copies this
+        # without holding our lock, and a key insertion racing that
+        # iteration would 500 the /metrics endpoint.
+        from consensus_clustering_tpu.autotune.policy import (
+            PROVENANCE_CALIBRATED,
+            PROVENANCE_DEFAULT,
+            PROVENANCE_USER,
+        )
+
+        self.autotune_provenance: Dict[str, int] = {
+            PROVENANCE_USER: 0,
+            PROVENANCE_CALIBRATED: 0,
+            PROVENANCE_DEFAULT: 0,
+        }
+        # Memoized block-size resolutions (same lifetime rule as the
+        # engine cache: calibration records are read once per process;
+        # a record added mid-flight applies after a restart).
+        self._resolutions: Dict[Any, Any] = {}
         self.run_count = 0
         self.executable_cache_hits = 0
         self.executable_cache_misses = 0
@@ -403,17 +430,43 @@ class SweepExecutor:
 
     # -- executable cache ------------------------------------------------
 
-    def _resolve_h_block(self, spec: JobSpec) -> int:
-        """The block size this job actually streams with: the job's own
-        ``stream_h_block``, else the executor's pinned default, else
-        the ROADMAP autotune heuristic (H/8 clamped to [16, 128])."""
-        if spec.stream_h_block is not None:
-            return spec.stream_h_block
-        if self.default_h_block is not None:
-            return self.default_h_block
-        return autotune_stream_block(spec.n_iterations)
+    def _resolve_h_block(self, spec: JobSpec, n: int, d: int):
+        """The block size this job actually streams with, as a
+        :class:`~consensus_clustering_tpu.autotune.policy.Resolution`:
+        the job's own ``stream_h_block`` or the executor's pinned
+        default (both ``user-pinned``), else a ``calibrated`` record
+        for this environment × shape bucket, else the original
+        heuristic (H/8 clamped to [16, 128]) as the ``default`` tier.
+        The tier is disclosed in the job result and counted in
+        ``/metrics`` (``autotune_provenance_total``).  Memoized per
+        (pin, shape, H, K) key so warm-cache jobs stay free of the
+        calibration store's disk read (resolution inputs are immutable
+        for the process lifetime, like the compiled engine itself)."""
+        key = (
+            spec.stream_h_block, self.default_h_block, n, d,
+            spec.n_iterations, spec.k_values,
+        )
+        hit = self._resolutions.get(key)
+        if hit is not None:
+            return hit
+        from consensus_clustering_tpu.autotune.policy import AutotunePolicy
+        from consensus_clustering_tpu.autotune.store import shape_bucket
 
-    def _config_for(self, spec: JobSpec, n: int, d: int) -> SweepConfig:
+        policy = AutotunePolicy(self.calibration_store)
+        resolution = policy.resolve_stream_block(
+            shape_bucket(n, d, spec.n_iterations, spec.k_values),
+            job_pin=spec.stream_h_block,
+            operator_pin=self.default_h_block,
+            n_iterations=spec.n_iterations,
+        )
+        # Benign race: two threads resolving the same key compute the
+        # same immutable value; last write wins.
+        self._resolutions[key] = resolution
+        return resolution
+
+    def _config_for(
+        self, spec: JobSpec, n: int, d: int, h_block: int
+    ) -> SweepConfig:
         # n_iterations is a placeholder here: the streaming engine takes
         # H at run() time (traced scalar); nothing compiled depends on
         # it.  The adaptive knobs live in the driver, also outside the
@@ -429,7 +482,7 @@ class SweepExecutor:
             parity_zeros=spec.parity_zeros,
             store_matrices=False,  # serving results are curves-only JSON
             chunk_size=spec.chunk_size,
-            stream_h_block=self._resolve_h_block(spec),
+            stream_h_block=h_block,
             # Adaptive knobs deliberately NOT baked: the cached engine
             # is shared by every job in the bucket, and run() takes them
             # as per-job overrides.
@@ -461,7 +514,8 @@ class SweepExecutor:
             raise JobSpecError(str(e))
 
     def _get_engine(self, spec: JobSpec, n: int, d: int):
-        """(engine, build_compile_seconds, cached) for the bucket.
+        """(engine, build_compile_seconds, cached, resolution) for the
+        bucket.
 
         Reachable from two threads at once (a timed-out job's abandoned
         thread plus the next job's fresh one), so the whole
@@ -469,13 +523,14 @@ class SweepExecutor:
         the race blocks and then hits the cache instead of paying a
         duplicate minutes-long compile serialized behind one device.
         """
-        key = spec.bucket(n, d, self._resolve_h_block(spec))
+        resolution = self._resolve_h_block(spec, n, d)
+        key = spec.bucket(n, d, resolution.value)
         with self._compile_lock:
             hit = self._engines.get(key)
             if hit is not None:
                 with self._lock:
                     self.executable_cache_hits += 1
-                return hit, 0.0, True
+                return hit, 0.0, True, resolution
             from consensus_clustering_tpu.parallel.streaming import (
                 StreamingSweep,
             )
@@ -483,7 +538,7 @@ class SweepExecutor:
             t0 = time.perf_counter()
             engine = StreamingSweep(
                 self._clusterer_for(spec),
-                self._config_for(spec, n, d),
+                self._config_for(spec, n, d, resolution.value),
             )
             # warmup() runs one all-masked block on zeros: trace + XLA
             # compile + a trivial execution, the cheapest way to
@@ -495,7 +550,7 @@ class SweepExecutor:
             self._engines[key] = engine
             with self._lock:
                 self.executable_cache_misses += 1
-            return engine, seconds, False
+            return engine, seconds, False, resolution
 
     def warmup(self, spec: JobSpec, n: int, d: int) -> float:
         """Pre-compile the block executable for a shape bucket; returns
@@ -505,10 +560,11 @@ class SweepExecutor:
         the shape **that resolves to the same block size**: every H
         under a pinned ``default_h_block`` or an explicit
         ``spec.stream_h_block``, but under the autotune default the
-        spec's ``n_iterations`` picks the block (H/8 clamped to
-        [16, 128]) — an H that autotunes to a different block is a
-        different bucket and pays its own compile."""
-        _, seconds, _ = self._get_engine(spec, n, d)
+        spec's ``n_iterations`` and shape pick the block (a calibrated
+        record for the bucket, else H/8 clamped to [16, 128]) — an H
+        that resolves to a different block is a different bucket and
+        pays its own compile."""
+        _, seconds, _, _ = self._get_engine(spec, n, d)
         return seconds
 
     def cancel_events(self) -> None:
@@ -551,7 +607,9 @@ class SweepExecutor:
         )
 
         n, d = x.shape
-        engine, compile_seconds, cached = self._get_engine(spec, n, d)
+        engine, compile_seconds, cached, resolution = self._get_engine(
+            spec, n, d
+        )
 
         checkpointer = None
         if checkpoint_dir is not None:
@@ -617,6 +675,11 @@ class SweepExecutor:
             # happened (/metrics documents exactly that difference).
             self.h_requested_total += int(spec.n_iterations)
             self.h_effective_total += int(streaming["h_effective"])
+            # Same successful-executions-only rule for the provenance
+            # counters: a retried job must not double-count its tier.
+            self.autotune_provenance[resolution.provenance] = (
+                self.autotune_provenance.get(resolution.provenance, 0) + 1
+            )
 
         ks = list(spec.k_values)
         pac = [float(v) for v in host["pac_area"]]
@@ -654,6 +717,10 @@ class SweepExecutor:
             **semantic,
             "backend": self.backend(),
             "result_fingerprint": result_fingerprint,
+            # How the block size was chosen (ROADMAP's never-silent
+            # rule): user-pinned (job/operator), calibrated (with the
+            # record's parity evidence), or default (the H/8 heuristic).
+            "autotune": {"stream_h_block": resolution.disclosure()},
             # Satellite metric: 0 = ran from scratch; > 0 = this many
             # leading blocks were restored from the checkpoint ring.
             "resumed_from_block": int(

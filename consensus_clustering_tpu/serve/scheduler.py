@@ -367,6 +367,13 @@ class Scheduler:
                 ),
                 "retry_total": dict(self.retry_total),
                 "jobs_requeued": self.jobs_requeued,
+                # Block-size resolution tiers over executed jobs
+                # (docs/AUTOTUNE.md "Provenance"): whether calibration
+                # actually steers traffic, or jobs pin their own block,
+                # or everything falls to the heuristic default.
+                "autotune_provenance_total": dict(getattr(
+                    self.executor, "autotune_provenance", {}
+                ) or {}),
                 "sweeps_executed": self.executor.run_count,
                 "backend": self.executor.backend(),
             }

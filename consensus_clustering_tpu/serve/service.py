@@ -15,8 +15,10 @@ framework, nothing the container doesn't already have.  Endpoints:
   retried/timed-out/requeued, jobstore ``cache_hits``, in-process
   ``executable_cache_hits``, ``sweeps_executed``, the resilience
   counters (``checkpoint_writes_total``, ``checkpoint_resume_total``,
-  ``retry_total`` by triage reason), and ``backend`` (``tpu`` |
-  ``cpu-fallback``, bench.py's ``measurement_backend`` convention).
+  ``retry_total`` by triage reason), the block-size resolution tiers
+  (``autotune_provenance_total`` — docs/AUTOTUNE.md), and ``backend``
+  (``tpu`` | ``cpu-fallback``, bench.py's ``measurement_backend``
+  convention).
 
 Durability (docs/SERVING.md "Crash recovery"): submitted jobs persist
 their (config, data) payload, streamed executions checkpoint block

@@ -1,0 +1,576 @@
+"""Autotune subsystem tests (docs/AUTOTUNE.md).
+
+Fast lane: store round-trip + atomicity, the refuse-foreign-fingerprint
+rule, schema-version rejection, the parity-gate choke point, policy
+precedence (user pin > calibrated > default) across every surface's
+resolver, and the api's provenance disclosure via the compile-free host
+backend.  Slow lane (compile-heavy, per the tier-1 budget rule): a real
+probe run writing real records, a serving job resolving a calibrated
+block size, and a bench record disclosing a calibrated knob next to
+``vs_baseline`` — all three also run in CI's ``autotune-smoke`` job,
+which executes this file without the ``not slow`` filter.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.autotune.policy import (
+    PROVENANCE_CALIBRATED,
+    PROVENANCE_DEFAULT,
+    PROVENANCE_USER,
+    AutotunePolicy,
+)
+from consensus_clustering_tpu.autotune.probes import (
+    Budget,
+    ProbeContext,
+    list_probes,
+    pac_parity,
+    run_probes,
+)
+from consensus_clustering_tpu.autotune.store import (
+    SCHEMA_VERSION,
+    CalibrationError,
+    CalibrationStore,
+    ForeignFingerprintError,
+    SchemaVersionError,
+    env_fingerprint,
+    environment,
+    load_record,
+    make_record,
+    shape_bucket,
+)
+from consensus_clustering_tpu.config import autotune_stream_block
+
+BUCKET = shape_bucket(500, 16, 100, (2, 3, 4))
+
+
+def _passing_parity(tolerance=0.0, delta=0.0):
+    return {
+        "gate": "bit-identical" if tolerance == 0.0 else "tolerance",
+        "tolerance": tolerance,
+        "max_pac_delta": delta,
+        "k_values_compared": 3,
+        "passed": True,
+    }
+
+
+def _record(knob="cluster_batch", value=16, **kw):
+    return make_record(
+        knob, BUCKET, value, parity=_passing_parity(), **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# Store
+
+
+class TestStore:
+    def test_environment_fingerprint_is_content_keyed(self):
+        env = environment()
+        assert set(env) == {
+            "device_kind", "backend", "jaxlib_version", "device_count",
+        }
+        assert env_fingerprint(env) == env_fingerprint(dict(env))
+        other = dict(env, device_kind="TPU v4")
+        assert env_fingerprint(other) != env_fingerprint(env)
+
+    def test_shape_bucket_format(self):
+        assert shape_bucket(500, 16, 100, (4, 2, 3)) == "n500_d16_h100_k2-4"
+
+    def test_record_round_trip(self, tmp_path):
+        store = CalibrationStore(str(tmp_path))
+        record = _record(rate=120.0, baseline_rate=100.0, probe="test")
+        path = store.save(record)
+        assert not os.path.exists(path + ".tmp")  # atomic: tmp renamed
+        loaded = store.get("cluster_batch", BUCKET)
+        assert loaded == record
+        assert loaded["speedup"] == 1.2
+        # Unknown (knob, bucket) resolves to nothing, loudly not wrongly.
+        assert store.get("cluster_batch", "n1_d1_h1_k2-2") is None
+        assert store.get("max_iter", BUCKET) is None
+
+    def test_parity_gate_is_structural(self, tmp_path):
+        # make_record refuses an unpassed/missing gate...
+        with pytest.raises(CalibrationError, match="parity"):
+            make_record(
+                "max_iter", BUCKET, 25,
+                parity={"passed": False, "max_pac_delta": 0.5,
+                        "tolerance": 0.0},
+            )
+        with pytest.raises(CalibrationError, match="parity"):
+            make_record("max_iter", BUCKET, 25, parity={})
+        # ...and save() re-checks, so a hand-built dict can't sneak by.
+        store = CalibrationStore(str(tmp_path))
+        record = _record()
+        record["parity"]["passed"] = False
+        with pytest.raises(CalibrationError, match="parity"):
+            store.save(record)
+
+    def test_unknown_knob_rejected(self, tmp_path):
+        with pytest.raises(CalibrationError, match="unknown knob"):
+            make_record("warp_speed", BUCKET, 9, parity=_passing_parity())
+        store = CalibrationStore(str(tmp_path))
+        record = _record()
+        record["knob"] = "warp_speed"
+        with pytest.raises(CalibrationError, match="unknown knob"):
+            store.save(record)
+
+    def test_foreign_fingerprint_refused(self, tmp_path):
+        """The stream_fingerprint rule: a record measured on another
+        stack must not steer this one — even if the file was copied
+        into this environment's slot."""
+        foreign_env = dict(environment(), device_kind="TPU v5e")
+        foreign = CalibrationStore(str(tmp_path), env=foreign_env)
+        foreign.save(make_record(
+            "stream_h_block", BUCKET, 64, parity=_passing_parity(),
+            env=foreign_env,
+        ))
+        local = CalibrationStore(str(tmp_path))
+        # Keyed apart by filename: simply not found for this env.
+        assert local.get("stream_h_block", BUCKET) is None
+        # Tampered: foreign content renamed into the local slot raises.
+        src = foreign._path("stream_h_block", BUCKET, foreign.env_fp)
+        dst = local._path("stream_h_block", BUCKET, local.env_fp)
+        os.rename(src, dst)
+        with pytest.raises(ForeignFingerprintError, match="different"):
+            local.get("stream_h_block", BUCKET)
+
+    def test_mislabelled_slot_refused(self, tmp_path):
+        """A record copied into ANOTHER KNOB's slot (same environment)
+        is refused: content and slot must agree."""
+        store = CalibrationStore(str(tmp_path))
+        path = store.save(make_record(
+            "stream_h_block", BUCKET, 48, parity=_passing_parity(),
+            env=store.env,
+        ))
+        os.rename(path, store._path("cluster_batch", BUCKET, store.env_fp))
+        with pytest.raises(ForeignFingerprintError, match="mislabelled"):
+            store.get("cluster_batch", BUCKET)
+        # Same refusal for a bucket mismatch.
+        store.save(make_record(
+            "max_iter", BUCKET, 25, parity=_passing_parity(),
+            env=store.env,
+        ))
+        os.rename(
+            store._path("max_iter", BUCKET, store.env_fp),
+            store._path("max_iter", "n9_d9_h9_k2-2", store.env_fp),
+        )
+        with pytest.raises(ForeignFingerprintError, match="mislabelled"):
+            store.get("max_iter", "n9_d9_h9_k2-2")
+
+    def test_schema_version_rejected(self, tmp_path):
+        store = CalibrationStore(str(tmp_path))
+        record = _record()
+        path = store.save(record)
+        doctored = dict(record, schema_version=SCHEMA_VERSION + 1)
+        with open(path, "w") as f:
+            json.dump(doctored, f)
+        with pytest.raises(SchemaVersionError, match="schema_version"):
+            store.get("cluster_batch", BUCKET)
+        with pytest.raises(SchemaVersionError):
+            load_record(path)
+        # Writing a future version is refused too.
+        with pytest.raises(SchemaVersionError):
+            store.save(doctored)
+
+    def test_records_listing_surfaces_broken_files(self, tmp_path):
+        store = CalibrationStore(str(tmp_path))
+        store.save(_record())
+        bad = os.path.join(str(tmp_path), "zz__bad__bucket.json")
+        with open(bad, "w") as f:
+            f.write("{not json")
+        listed = store.records()
+        assert len(listed) == 2
+        assert any("error" in rec for _, rec in listed)
+
+
+# ---------------------------------------------------------------------------
+# Policy
+
+
+class TestPolicy:
+    def _store_with(self, tmp_path, knob, value, bucket=BUCKET):
+        store = CalibrationStore(str(tmp_path))
+        store.save(make_record(
+            knob, bucket, value, parity=_passing_parity(),
+            env=store.env,
+        ))
+        return store
+
+    def test_precedence_user_beats_calibrated_beats_default(self, tmp_path):
+        policy = AutotunePolicy(
+            self._store_with(tmp_path, "cluster_batch", 16)
+        )
+        pinned = policy.resolve(
+            "cluster_batch", BUCKET, pinned=4, default=None
+        )
+        assert (pinned.value, pinned.provenance) == (4, PROVENANCE_USER)
+        calibrated = policy.resolve("cluster_batch", BUCKET, default=None)
+        assert (calibrated.value, calibrated.provenance) == (
+            16, PROVENANCE_CALIBRATED,
+        )
+        assert calibrated.record["parity"]["passed"] is True
+        missing = policy.resolve("max_iter", BUCKET, default=100)
+        assert (missing.value, missing.provenance) == (
+            100, PROVENANCE_DEFAULT,
+        )
+        # No store at all: the default tier answers everything.
+        bare = AutotunePolicy(None).resolve(
+            "cluster_batch", BUCKET, default=None
+        )
+        assert (bare.value, bare.provenance) == (None, PROVENANCE_DEFAULT)
+
+    def test_stream_block_tiers_end_at_the_old_heuristic(self, tmp_path):
+        policy = AutotunePolicy(
+            self._store_with(tmp_path, "stream_h_block", 48)
+        )
+        job = policy.resolve_stream_block(
+            BUCKET, job_pin=8, operator_pin=24, n_iterations=100
+        )
+        assert (job.value, job.provenance) == (8, PROVENANCE_USER)
+        operator = policy.resolve_stream_block(
+            BUCKET, operator_pin=24, n_iterations=100
+        )
+        assert (operator.value, operator.provenance) == (
+            24, PROVENANCE_USER,
+        )
+        calibrated = policy.resolve_stream_block(BUCKET, n_iterations=100)
+        assert (calibrated.value, calibrated.provenance) == (
+            48, PROVENANCE_CALIBRATED,
+        )
+        # The pre-existing heuristic IS the default tier, verbatim.
+        default = policy.resolve_stream_block(
+            "n9_d9_h9_k2-2", n_iterations=400
+        )
+        assert (default.value, default.provenance) == (
+            autotune_stream_block(400), PROVENANCE_DEFAULT,
+        )
+
+    def test_broken_record_falls_back_to_default(self, tmp_path, caplog):
+        store = self._store_with(tmp_path, "cluster_batch", 16)
+        path = store._path("cluster_batch", BUCKET, store.env_fp)
+        with open(path) as f:
+            record = json.load(f)
+        record["schema_version"] = SCHEMA_VERSION + 7
+        with open(path, "w") as f:
+            json.dump(record, f)
+        policy = AutotunePolicy(store)
+        import logging
+
+        with caplog.at_level(
+            logging.WARNING, logger="consensus_clustering_tpu.autotune.policy"
+        ):
+            res = policy.resolve("cluster_batch", BUCKET, default=None)
+        assert res.provenance == PROVENANCE_DEFAULT
+        assert "ignoring calibration record" in caplog.text
+
+    def test_disclosure_carries_parity_evidence(self, tmp_path):
+        policy = AutotunePolicy(self._store_with(tmp_path, "max_iter", 25))
+        disclosure = policy.resolve("max_iter", BUCKET).disclosure()
+        assert disclosure["provenance"] == PROVENANCE_CALIBRATED
+        assert disclosure["value"] == 25
+        assert disclosure["parity"]["passed"] is True
+
+
+# ---------------------------------------------------------------------------
+# Probe harness (no sweeps in the fast lane)
+
+
+class TestProbeHarness:
+    def test_registry_is_complete(self):
+        assert {p.name for p in list_probes()} == {
+            "max_iter", "cluster_batch", "split_init", "stream_h_block",
+            "adaptive_tol",
+        }
+
+    def test_pac_parity_modes(self):
+        identical = pac_parity([0.1234567, 0.2], [0.1234567, 0.2])
+        assert identical["passed"] and identical["gate"] == "bit-identical"
+        # 5-decimal rounding is the comparison basis (decide_maxiter's).
+        rounded = pac_parity([0.123456], [0.123459])
+        assert rounded["passed"]
+        diverged = pac_parity([0.1235], [0.1234])
+        assert not diverged["passed"]
+        within = pac_parity([0.105], [0.1], tolerance=0.01)
+        assert within["passed"] and within["gate"] == "tolerance"
+        beyond = pac_parity([0.12], [0.1], tolerance=0.01)
+        assert not beyond["passed"]
+        mismatch = pac_parity([0.1], [0.1, 0.2])
+        assert not mismatch["passed"]
+
+    def test_exhausted_budget_skips_every_probe(self, tmp_path):
+        budget = Budget(0.0)  # exhausted before the first measurement
+        ctx = ProbeContext(
+            store=CalibrationStore(str(tmp_path)), budget=budget,
+            shapes="smoke",
+        )
+        names = [p.name for p in list_probes()]
+        summaries, gate_failed = run_probes(names, ctx)
+        assert not gate_failed  # budget exhaustion is NOT a gate failure
+        assert [s["status"] for s in summaries] == (
+            ["budget-skipped"] * len(names)
+        )
+        # Nothing measured, so nothing recorded.
+        assert not [
+            p for p in os.listdir(str(tmp_path)) if p.endswith(".json")
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: executor (unit), api via the compile-free host backend
+
+
+class TestExecutorResolution:
+    def _spec(self, **cfg):
+        from consensus_clustering_tpu.serve.executor import parse_job_spec
+
+        body = {
+            "data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0], [3.0, 1.0]],
+            "config": dict({"k": [2], "iterations": 400}, **cfg),
+        }
+        return parse_job_spec(body)
+
+    def test_calibrated_tier_reaches_the_executor(self, tmp_path):
+        from consensus_clustering_tpu.serve.executor import SweepExecutor
+
+        spec, x = self._spec()
+        n, d = x.shape
+        store = CalibrationStore(str(tmp_path))
+        store.save(make_record(
+            "stream_h_block", shape_bucket(n, d, 400, (2,)), 32,
+            parity=_passing_parity(), env=store.env,
+        ))
+        ex = SweepExecutor(
+            use_compilation_cache=False, calibration_store=store
+        )
+        res = ex._resolve_h_block(spec, n, d)
+        assert (res.value, res.provenance) == (32, PROVENANCE_CALIBRATED)
+        # A job pin still wins over the calibrated record.
+        pinned_spec = dataclasses.replace(spec, stream_h_block=8)
+        res = ex._resolve_h_block(pinned_spec, n, d)
+        assert (res.value, res.provenance) == (8, PROVENANCE_USER)
+        # And without a matching record, the heuristic default answers.
+        other_spec = dataclasses.replace(spec, n_iterations=800)
+        res = ex._resolve_h_block(other_spec, n, d)
+        assert (res.value, res.provenance) == (100, PROVENANCE_DEFAULT)
+
+
+class TestApiResolution:
+    def _host_fit(self, tmp_path, **kw):
+        import sklearn.cluster
+
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        rng = np.random.default_rng(0)
+        x = np.concatenate(
+            [rng.normal(0, 0.3, (20, 4)), rng.normal(3, 0.3, (20, 4))]
+        ).astype(np.float32)
+        cc = ConsensusClustering(
+            clusterer=sklearn.cluster.KMeans(n_init=2),
+            K_range=(2, 3), n_iterations=5, random_state=7,
+            plot_cdf=False, progress=False, store_matrices=False,
+            **kw,
+        )
+        cc.fit(x)
+        return cc
+
+    def test_host_backend_is_an_autotune_noop(self, tmp_path):
+        """The resolvable knobs are device-path features; a host fit
+        must not disclose 'calibrated' values that steered nothing."""
+        store = CalibrationStore(str(tmp_path))
+        store.save(make_record(
+            "cluster_batch", shape_bucket(40, 4, 5, (2, 3)), 4,
+            parity=_passing_parity(), env=store.env,
+        ))
+        cc = self._host_fit(
+            tmp_path, autotune=True, calibration_dir=str(tmp_path)
+        )
+        assert cc.autotune_ is None
+        assert "autotune" not in cc.metrics_
+
+    def test_autotune_off_discloses_nothing(self, tmp_path):
+        cc = self._host_fit(tmp_path)
+        assert "autotune" not in cc.metrics_
+        assert cc.autotune_ is None
+
+    @pytest.mark.slow
+    def test_device_fit_discloses_all_three_tiers(self, tmp_path):
+        """One compiled fit, three provenance tiers: calibrated
+        cluster_batch, user-pinned split_init, default stream_h_block
+        — and the calibrated value actually reaches the sweep."""
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        rng = np.random.default_rng(0)
+        x = np.concatenate(
+            [rng.normal(0, 0.3, (20, 4)), rng.normal(3, 0.3, (20, 4))]
+        ).astype(np.float32)
+        store = CalibrationStore(str(tmp_path))
+        store.save(make_record(
+            "cluster_batch", shape_bucket(40, 4, 6, (2, 3)), 3,
+            parity=_passing_parity(), env=store.env,
+        ))
+        # A stream_h_block record whose own evidence shows streaming
+        # LOSING to the monolithic baseline (speedup < 1): the api must
+        # not adopt it — serving would (it always streams), but this
+        # surface's unset default is the monolithic program.
+        store.save(make_record(
+            "stream_h_block", shape_bucket(40, 4, 6, (2, 3)), 3,
+            parity=_passing_parity(), rate=50.0, baseline_rate=100.0,
+            env=store.env,
+        ))
+        cc = ConsensusClustering(
+            K_range=(2, 3), n_iterations=6, random_state=7,
+            plot_cdf=False, progress=False, store_matrices=False,
+            clusterer_options={"n_init": 1},
+            split_init=False,  # an explicit pin, even at the default
+            autotune=True, calibration_dir=str(tmp_path),
+        )
+        cc.fit(x)
+        disclosed = cc.metrics_["autotune"]
+        assert disclosed["cluster_batch"]["provenance"] == (
+            PROVENANCE_CALIBRATED
+        )
+        assert disclosed["cluster_batch"]["value"] == 3
+        assert disclosed["cluster_batch"]["parity"]["passed"] is True
+        assert disclosed["split_init"] == {
+            "value": False, "provenance": PROVENANCE_USER,
+        }
+        assert disclosed["stream_h_block"]["provenance"] == (
+            PROVENANCE_DEFAULT
+        )
+        # max_iter: default-clusterer path, no record -> default tier.
+        assert disclosed["max_iter"]["provenance"] == PROVENANCE_DEFAULT
+        assert cc.autotune_ == disclosed
+        assert cc.best_k_ == 2
+
+
+# ---------------------------------------------------------------------------
+# Slow lane: real sweeps (compile-heavy — tier-1 budget rule).  CI's
+# autotune-smoke job runs these explicitly.
+
+
+@pytest.mark.slow
+def test_probe_run_writes_parity_gated_records(tmp_path):
+    """One real probe at smoke scale: records appear, every record's
+    parity gate passed, and the CLI payload contract holds."""
+    from consensus_clustering_tpu.autotune import cli as autotune_cli
+
+    class Args:
+        store = str(tmp_path)
+        probe = ["stream_h_block"]
+        budget = None
+        shapes = "smoke"
+        seed = 23
+        repeats = 1
+        autotune_cmd = "run"
+
+    import contextlib
+    import io
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = autotune_cli.cmd_autotune(Args())
+    assert rc == 0
+    payload = json.loads(out.getvalue())
+    assert payload["gate_failed"] is False
+    assert payload["records_written"] >= 1
+    store = CalibrationStore(str(tmp_path))
+    for _, record in store.records():
+        assert record["parity"]["passed"] is True
+        assert record["schema_version"] == SCHEMA_VERSION
+    # The freshly written record resolves for THIS environment.
+    bucket = payload["probes"][0]["records"][0].rsplit("__", 1)[-1][:-5]
+    resolved = AutotunePolicy(store).resolve("stream_h_block", bucket)
+    assert resolved.provenance == PROVENANCE_CALIBRATED
+
+
+@pytest.mark.slow
+def test_serve_result_discloses_calibrated_block(tmp_path):
+    """A real streamed serving job resolves its block size from a
+    calibration record and says so in the result AND /metrics."""
+    from consensus_clustering_tpu.serve.executor import (
+        SweepExecutor,
+        parse_job_spec,
+    )
+
+    rng = np.random.default_rng(2)
+    x = np.concatenate(
+        [rng.normal(0, 0.3, (30, 4)), rng.normal(3, 0.3, (30, 4))]
+    )
+    body = {
+        "data": x.tolist(),
+        "config": {"k": [2, 3], "iterations": 12, "seed": 23},
+    }
+    spec, data = parse_job_spec(body)
+    store = CalibrationStore(str(tmp_path))
+    store.save(make_record(
+        "stream_h_block", shape_bucket(60, 4, 12, (2, 3)), 6,
+        parity=_passing_parity(), env=store.env,
+    ))
+    ex = SweepExecutor(
+        use_compilation_cache=False, calibration_store=store
+    )
+    result = ex.run(spec, data)
+    disclosure = result["autotune"]["stream_h_block"]
+    assert disclosure["provenance"] == PROVENANCE_CALIBRATED
+    assert disclosure["value"] == 6
+    assert disclosure["parity"]["passed"] is True
+    assert result["streaming"]["h_block"] == 6
+    assert ex.autotune_provenance == {
+        PROVENANCE_USER: 0, PROVENANCE_CALIBRATED: 1,
+        PROVENANCE_DEFAULT: 0,
+    }
+
+
+@pytest.mark.slow
+def test_bench_record_discloses_calibration_next_to_vs_baseline(
+    tmp_path, capsys, monkeypatch
+):
+    """bench --autotune applies a calibrated max_iter and the record
+    discloses value + provenance adjacent to vs_baseline (the
+    never-silent rule)."""
+    import bench
+
+    # Shrink the headline config to test scale, keeping the real
+    # resolution path: _build's output is what --autotune rewrites.
+    real_build = bench._build
+
+    def tiny_build(config_name, small):
+        from consensus_clustering_tpu.config import SweepConfig
+        from consensus_clustering_tpu.models.kmeans import KMeans
+
+        x = bench._blobs(80, 6)
+        cfg = SweepConfig(
+            n_samples=80, n_features=6, k_values=(2, 3),
+            n_iterations=8, store_matrices=False,
+        )
+        return KMeans(n_init=2), cfg, x, "tiny bench", None
+
+    monkeypatch.setattr(bench, "_build", tiny_build)
+    monkeypatch.setenv("BENCH_SUPERVISED", "1")
+    store = CalibrationStore(str(tmp_path))
+    store.save(make_record(
+        "max_iter", shape_bucket(80, 6, 8, (2, 3)), 25,
+        parity=_passing_parity(), rate=200.0, baseline_value=100,
+        baseline_rate=150.0, env=store.env,
+    ))
+    bench.main(["--autotune", str(tmp_path)])
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    keys = list(record)
+    # Adjacency: the disclosure sits immediately after vs_baseline.
+    assert keys.index("autotune") == keys.index("vs_baseline") + 1
+    assert record["autotune"]["max_iter"]["provenance"] == (
+        PROVENANCE_CALIBRATED
+    )
+    assert record["autotune"]["max_iter"]["value"] == 25
+    assert "[max_iter=25 calibrated]" in record["metric"]
+    # The unpinned cluster_batch fell through to the default tier, and
+    # the record says so rather than staying silent.
+    assert record["autotune"]["cluster_batch"]["provenance"] == (
+        PROVENANCE_DEFAULT
+    )
+    del real_build

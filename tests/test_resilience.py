@@ -361,19 +361,26 @@ class TestAutotune:
             autotune_stream_block(0)
 
     def test_executor_resolution_precedence(self):
-        spec, _ = parse_job_spec(
+        spec, x = parse_job_spec(
             {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
              "config": {"k": [2], "iterations": 400}}
         )
+        n, d = x.shape
         auto = SweepExecutor(use_compilation_cache=False)
-        assert auto._resolve_h_block(spec) == 50  # 400 // 8
+        res = auto._resolve_h_block(spec, n, d)
+        assert (res.value, res.provenance) == (50, "default")  # 400 // 8
         pinned = SweepExecutor(
             use_compilation_cache=False, default_h_block=24
         )
-        assert pinned._resolve_h_block(spec) == 24
+        res = pinned._resolve_h_block(spec, n, d)
+        assert (res.value, res.provenance) == (24, "user-pinned")
         explicit = dataclasses.replace(spec, stream_h_block=8)
-        assert auto._resolve_h_block(explicit) == 8
-        assert pinned._resolve_h_block(explicit) == 8
+        assert auto._resolve_h_block(explicit, n, d).value == 8
+        assert pinned._resolve_h_block(explicit, n, d).value == 8
+        assert (
+            pinned._resolve_h_block(explicit, n, d).provenance
+            == "user-pinned"
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -105,6 +105,12 @@ def test_submit_poll_result_roundtrip(base, service):
     assert set(result["pac_area"]) == {"2", "3"}
     assert result["backend"] == service.executor.backend()
     assert result["timings"]["run_seconds"] > 0
+    # Block-size resolution provenance (docs/AUTOTUNE.md): no job pin,
+    # no operator pin, no calibration store on this executor, so the
+    # H/8-clamped heuristic answered — and the result says so.
+    disclosure = result["autotune"]["stream_h_block"]
+    assert disclosure["provenance"] == "default"
+    assert disclosure["value"] == 16  # autotune_stream_block(10)
 
 
 def test_duplicate_submission_served_from_jobstore(base, service):
@@ -160,9 +166,11 @@ def test_metrics_schema(base):
         "jobs_retried", "jobs_timed_out", "jobs_requeued", "cache_hits",
         "executable_cache_hits", "sweeps_executed", "backend",
         "checkpoint_writes_total", "checkpoint_resume_total", "retry_total",
+        "autotune_provenance_total",
     ):
         assert field in m, field
     assert isinstance(m["retry_total"], dict)
+    assert isinstance(m["autotune_provenance_total"], dict)
 
 
 def test_events_jsonl_lifecycle(base, service):
