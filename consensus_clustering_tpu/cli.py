@@ -7,6 +7,7 @@ one for benchmarking and batch use:
         --iterations 100 --seed 23 --out results.json
     python -m consensus_clustering_tpu bench
     python -m consensus_clustering_tpu serve --port 8000   # docs/SERVING.md
+    python -m consensus_clustering_tpu serve-admin --store-dir serve_store list
     python -m consensus_clustering_tpu lint                # docs/LINT.md
     python -m consensus_clustering_tpu autotune run        # docs/AUTOTUNE.md
 
@@ -250,9 +251,12 @@ def cmd_serve(args):
     import os
 
     from consensus_clustering_tpu.serve import (
+        BackendInitTimeout,
         ConsensusService,
         JobSpec,
+        ShedPolicy,
         SweepExecutor,
+        await_backend_init,
     )
 
     logging.basicConfig(level=logging.INFO)
@@ -272,6 +276,39 @@ def cmd_serve(args):
         checkpoint_every=args.checkpoint_every,
         calibration_store=calibration,
     )
+    # Bounded backend init BEFORE binding the port or reconciling jobs:
+    # a wedged device plugin (the r02-r05 `backend init hung` failure)
+    # must fail the process fast and named, not hang it forever in a
+    # state no liveness probe can tell from a slow start.
+    try:
+        await_backend_init(executor.backend, args.backend_init_timeout)
+    except BackendInitTimeout as e:
+        raise SystemExit(f"serve: {e}")
+    memory_budget = None
+    if args.memory_budget != "off":
+        from consensus_clustering_tpu.serve.preflight import (
+            resolve_memory_budget,
+        )
+
+        if args.memory_budget == "auto":
+            explicit = None
+        else:
+            try:
+                explicit = int(args.memory_budget)
+            except ValueError:
+                raise SystemExit(
+                    f"serve: --memory-budget {args.memory_budget!r} is "
+                    "not valid; expected 'auto', 'off', or an integer "
+                    "byte count"
+                )
+        memory_budget = resolve_memory_budget(explicit)
+        if memory_budget is None:
+            print(
+                "warning: no memory budget could be determined; the "
+                "preflight gate is open (set --memory-budget BYTES or "
+                "CCTPU_MEMORY_BUDGET)",
+                file=sys.stderr,
+            )
     service = ConsensusService(
         store_dir=args.store_dir,
         host=args.host,
@@ -282,6 +319,17 @@ def cmd_serve(args):
         events_path=args.events_path,
         executor=executor,
         job_checkpoints=not args.no_job_checkpoints,
+        quarantine_after=args.quarantine_after,
+        watchdog=not args.no_watchdog,
+        wedge_floor=args.wedge_floor,
+        wedge_scale=args.wedge_scale,
+        wedge_compile_grace=args.wedge_compile_grace,
+        shed_policy=None if args.no_shed else ShedPolicy(
+            low_frac=args.shed_low_frac,
+            normal_frac=args.shed_normal_frac,
+            retry_after=args.shed_retry_after,
+        ),
+        memory_budget_bytes=memory_budget,
     )
     if args.port_file:
         # The orchestration handshake for --port 0 (ephemeral): whoever
@@ -487,7 +535,63 @@ def main(argv=None):
                          metavar="N,D,KSPEC,H",
                          help="pre-compile a shape bucket at startup, "
                          "e.g. 500,16,2:6,50 (repeatable)")
+    # Hostile-path hardening (docs/SERVING.md "Overload & wedge
+    # runbook"): watchdog, quarantine, preflight, shedding.
+    serve_p.add_argument("--backend-init-timeout", type=float, default=120,
+                         help="fail startup if backend/device-plugin "
+                         "initialisation hangs past this many seconds "
+                         "(the r02-r05 wedge class); 0 disables the "
+                         "bound")
+    serve_p.add_argument("--no-watchdog", action="store_true",
+                         help="disable the hang watchdog (a job whose "
+                         "block heartbeat goes silent is then only "
+                         "bounded by --job-timeout, if set)")
+    serve_p.add_argument("--wedge-floor", type=float, default=30.0,
+                         help="minimum heartbeat-silence deadline in "
+                         "seconds (no block is ever declared wedged "
+                         "faster than this)")
+    serve_p.add_argument("--wedge-scale", type=float, default=8.0,
+                         help="wedge deadline = max(floor, scale x the "
+                         "bucket's observed/calibrated block seconds)")
+    serve_p.add_argument("--wedge-compile-grace", type=float, default=600.0,
+                         help="heartbeat-silence allowance before the "
+                         "first block (engine build + XLA compile)")
+    serve_p.add_argument("--quarantine-after", type=int, default=3,
+                         help="restart-requeues allowed before a "
+                         "crash-looping job is quarantined (payload + "
+                         "checkpoint ring retained; release with "
+                         "serve-admin)")
+    serve_p.add_argument("--memory-budget", default="auto",
+                         metavar="auto|off|BYTES",
+                         help="memory preflight budget: 'auto' resolves "
+                         "from CCTPU_MEMORY_BUDGET, the device's "
+                         "bytes_limit, or host RAM; 'off' disables the "
+                         "413 gate; an integer pins bytes")
+    serve_p.add_argument("--no-shed", action="store_true",
+                         help="disable priority-aware overload shedding "
+                         "(admission then only bounds at --queue-size)")
+    serve_p.add_argument("--shed-low-frac", type=float, default=0.5,
+                         help="queue fraction at which low-priority "
+                         "admissions shed (429 + Retry-After)")
+    serve_p.add_argument("--shed-normal-frac", type=float, default=0.85,
+                         help="queue fraction at which normal-priority "
+                         "admissions shed")
+    serve_p.add_argument("--shed-retry-after", type=float, default=15.0,
+                         help="Retry-After seconds on shed responses")
     serve_p.set_defaults(fn=cmd_serve)
+
+    admin_p = sub.add_parser(
+        "serve-admin",
+        help="operate on a serve jobstore: quarantine list/show/release "
+        "(docs/SERVING.md runbook; jax-free, safe with a wedged backend)",
+    )
+    from consensus_clustering_tpu.serve.admin import (
+        add_arguments as admin_add_arguments,
+        cmd_serve_admin,
+    )
+
+    admin_add_arguments(admin_p)
+    admin_p.set_defaults(fn=lambda a: sys.exit(cmd_serve_admin(a)))
 
     lint_p = sub.add_parser(
         "lint",
@@ -511,11 +615,14 @@ def main(argv=None):
     autotune_p.set_defaults(fn=cmd_autotune)
 
     args = parser.parse_args(argv)
-    if args.cmd != "lint":
-        # Everything below needs (or will need) jax; the lint subcommand
-        # must stay import-free of it — a pure-AST pass has to run in
-        # milliseconds on CI boxes with no accelerator stack, and must
-        # not hang on a wedged TPU tunnel at device discovery.
+    if args.cmd not in ("lint", "serve-admin"):
+        # Everything below needs (or will need) jax; the lint and
+        # serve-admin subcommands must stay import-free of it — lint is
+        # a pure-AST pass that has to run in milliseconds on CI boxes
+        # with no accelerator stack, and serve-admin exists for exactly
+        # the moments the device stack is wedged or the service is
+        # crash-looping — neither may hang on a wedged TPU tunnel at
+        # device discovery.
         from consensus_clustering_tpu.utils.platform import (
             enable_compilation_cache,
             pin_platform_from_env,

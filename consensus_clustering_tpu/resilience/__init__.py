@@ -29,6 +29,7 @@ from consensus_clustering_tpu.resilience.blocks import (
 from consensus_clustering_tpu.resilience.faults import (
     FaultInjector,
     InjectedFault,
+    InjectedOOM,
     classify_error,
     faults,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "CheckpointFrameError",
     "FaultInjector",
     "InjectedFault",
+    "InjectedOOM",
     "StreamCheckpointer",
     "classify_error",
     "faults",

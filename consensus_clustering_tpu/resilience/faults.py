@@ -18,12 +18,22 @@ test instead of trusted:
 
       block_start=3            raise InjectedFault before block 3
       block_start=3:kill       os._exit(137) there instead (SIGKILL-like)
+      block_start=2:hang       sleep 3600 s there (a backend wedge: the
+                               thread goes silent but the process lives —
+                               what the hang watchdog exists to catch)
+      block_start=2:hang:30    same, bounded to 30 s (tests); after the
+                               sleep an InjectedFault is raised so an
+                               unwatched run still terminates
+      block_start=1:oom        raise a RESOURCE_EXHAUSTED-worded
+                               RuntimeError (classify_error triages it
+                               "retryable"/"oom", like a real device OOM)
       checkpoint_mid_write=1   raise with a torn temp file half-written
       checkpoint_post_write=0:kill   die after the atomic rename
 
   Every rule fires ONCE and disarms: a retried / resumed run must not
   trip over the same mine again — that is precisely what lets one plan
-  drive an interrupt-then-recover test end to end.
+  drive a full interrupt-then-recover (or hang-then-watchdog-retry)
+  cycle end to end.
 - **Triage.**  :func:`classify_error` is the scheduler's
   retryable-vs-fatal decision: deterministic programming/validation
   errors fail a job immediately, while device/runtime/IO faults (the
@@ -38,17 +48,33 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 _ENV = "CCTPU_FAULTS"
-_ACTIONS = ("raise", "kill")
+_ACTIONS = ("raise", "kill", "hang", "oom")
 _KILL_EXIT_CODE = 137  # what a SIGKILL'd process reports (128 + 9)
+# A 'hang' with no duration: long enough that nothing short of the hang
+# watchdog (or the end of the test process) notices the thread again —
+# the r02-r05 wedges ran 10-22 h, so "an hour of silence" is a faithful
+# simulation, not an exaggeration.
+_DEFAULT_HANG_SECONDS = 3600.0
 
 
 class InjectedFault(RuntimeError):
     """A deliberately injected, *retryable* failure (fault-plan 'raise')."""
+
+
+class InjectedOOM(RuntimeError):
+    """An injected device-OOM stand-in (fault-plan 'oom').
+
+    The message carries the XLA ``RESOURCE_EXHAUSTED`` vocabulary so
+    :func:`classify_error` triages it exactly like the real thing
+    (``retryable``/``oom``) — the chaos harness asserts the retry path,
+    not a special case for the injection.
+    """
 
 
 @dataclasses.dataclass
@@ -56,6 +82,7 @@ class _Rule:
     point: str
     index: int
     action: str
+    seconds: float = _DEFAULT_HANG_SECONDS  # hang duration (hang only)
 
 
 def _parse_plan(spec: Optional[str]) -> List[_Rule]:
@@ -67,11 +94,22 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
         try:
             point, rest = entry.split("=", 1)
             index_s, _, action = rest.partition(":")
-            rule = _Rule(point.strip(), int(index_s), action or "raise")
+            # hang takes an optional duration: "hang" or "hang:30".
+            action = action or "raise"
+            base, _, arg = action.partition(":")
+            seconds = _DEFAULT_HANG_SECONDS
+            if arg:
+                if base != "hang":
+                    raise ValueError(arg)  # only hang is parameterised
+                seconds = float(arg)
+                if seconds < 0:
+                    raise ValueError(arg)
+            rule = _Rule(point.strip(), int(index_s), base, seconds)
         except ValueError:
             raise ValueError(
                 f"bad fault spec entry {entry!r}: expected "
-                "point=index[:action]"
+                "point=index[:action] with action raise | kill | "
+                "hang[:seconds] | oom"
             )
         if rule.action not in _ACTIONS:
             raise ValueError(
@@ -127,6 +165,30 @@ class FaultInjector:
             # Mimic SIGKILL: no atexit, no finally blocks, no flushes —
             # exactly the torn state a preempted process leaves behind.
             os._exit(_KILL_EXIT_CODE)
+        if rule.action == "hang":
+            # A backend wedge: the calling thread goes silent while the
+            # process (and its HTTP surface) stays alive — the failure
+            # mode the hang watchdog exists to catch.  After the sleep
+            # an InjectedFault is raised so an UNWATCHED run still
+            # terminates (and a watched run's abandoned thread wakes
+            # into cancelled-event oblivion instead of resuming work).
+            logger.warning(
+                "fault injection: hanging %.1fs at %s[%d]",
+                rule.seconds, point, index,
+            )
+            time.sleep(rule.seconds)
+            raise InjectedFault(
+                f"injected hang at {point}[{index}] "
+                f"(slept {rule.seconds:.1f}s)"
+            )
+        if rule.action == "oom":
+            logger.warning(
+                "fault injection: raising OOM at %s[%d]", point, index
+            )
+            raise InjectedOOM(
+                "RESOURCE_EXHAUSTED: injected out of memory at "
+                f"{point}[{index}] (fault plan)"
+            )
         logger.warning(
             "fault injection: raising at %s[%d]", point, index
         )

@@ -4,45 +4,67 @@ The serving subsystem over the batch API — see docs/SERVING.md:
 
 - :mod:`.jobstore`  — persistent dedup-by-fingerprint result store
 - :mod:`.executor`  — compile-cache-aware sweep executor (warm path)
-- :mod:`.scheduler` — bounded FIFO queue, timeout, retry/backoff
+- :mod:`.scheduler` — bounded FIFO queue, timeout, retry/backoff, hang
+  watchdog, crash-loop quarantine, memory preflight, overload shedding
 - :mod:`.service`   — stdlib HTTP JSON API (POST /jobs, GET /jobs/<id>,
   /healthz, /metrics)
 - :mod:`.events`    — structured JSONL lifecycle events
+- :mod:`.watchdog`  — liveness heartbeats, the wedge verdict, and the
+  bounded backend-init guard
+- :mod:`.preflight` — admission-time memory estimate vs backend budget
+- :mod:`.admin`     — ``serve-admin``: quarantine list/show/release over
+  a store directory (stdlib-only, usable while the device stack is
+  wedged)
 
 Durability rides on :mod:`consensus_clustering_tpu.resilience`: job
 payloads and per-fingerprint block-checkpoint rings persist in the
 jobstore, retries and restarts resume from the last completed block
-(docs/SERVING.md "Crash recovery").
-
-Everything here is stdlib + the existing package; importing
-``consensus_clustering_tpu.serve`` does not initialise JAX (that happens
-on the first executed job / warmup).
+(docs/SERVING.md "Crash recovery"); the hostile-path layer on top is
+docs/SERVING.md "Overload & wedge runbook".
 """
 
-from consensus_clustering_tpu.serve.events import EventLog
-from consensus_clustering_tpu.serve.executor import (
-    JobSpec,
-    JobSpecError,
-    SweepExecutor,
-    parse_job_spec,
-)
-from consensus_clustering_tpu.serve.jobstore import JobStore
-from consensus_clustering_tpu.serve.scheduler import (
-    JobTimeout,
-    QueueFull,
-    Scheduler,
-)
-from consensus_clustering_tpu.serve.service import ConsensusService
+import importlib
 
-__all__ = [
-    "ConsensusService",
-    "EventLog",
-    "JobSpec",
-    "JobSpecError",
-    "JobStore",
-    "JobTimeout",
-    "QueueFull",
-    "Scheduler",
-    "SweepExecutor",
-    "parse_job_spec",
-]
+# Lazy exports (PEP 562, the autotune package's pattern): the CLI builds
+# the ``serve-admin`` argparse subtree from :mod:`.admin` on EVERY
+# invocation — including ``lint``, which must stay importable with no
+# numpy/jax installed (the zero-dependency CI job), and ``serve-admin``
+# itself, which exists for wedged-backend moments and must not import
+# the accelerator stack — so this __init__ must not pull
+# :mod:`.executor`/:mod:`.scheduler` (→ SweepConfig → jax) eagerly.
+_EXPORTS = {
+    "EventLog": "consensus_clustering_tpu.serve.events",
+    "JobSpec": "consensus_clustering_tpu.serve.executor",
+    "JobSpecError": "consensus_clustering_tpu.serve.executor",
+    "PRIORITIES": "consensus_clustering_tpu.serve.executor",
+    "SweepExecutor": "consensus_clustering_tpu.serve.executor",
+    "parse_job_spec": "consensus_clustering_tpu.serve.executor",
+    "JobStore": "consensus_clustering_tpu.serve.jobstore",
+    "PreflightReject": "consensus_clustering_tpu.serve.preflight",
+    "estimate_job_bytes": "consensus_clustering_tpu.serve.preflight",
+    "JobTimeout": "consensus_clustering_tpu.serve.scheduler",
+    "QueueFull": "consensus_clustering_tpu.serve.scheduler",
+    "QueueShed": "consensus_clustering_tpu.serve.scheduler",
+    "Scheduler": "consensus_clustering_tpu.serve.scheduler",
+    "ShedPolicy": "consensus_clustering_tpu.serve.scheduler",
+    "ConsensusService": "consensus_clustering_tpu.serve.service",
+    "BackendInitTimeout": "consensus_clustering_tpu.serve.watchdog",
+    "Heartbeat": "consensus_clustering_tpu.serve.watchdog",
+    "JobWedged": "consensus_clustering_tpu.serve.watchdog",
+    "await_backend_init": "consensus_clustering_tpu.serve.watchdog",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,211 @@
+"""serve-admin: operator tooling over a jobstore directory.
+
+The quarantine release surface (docs/SERVING.md "Overload & wedge
+runbook").  A crash-looping job is quarantined by the scheduler's
+startup reconciliation — payload and checkpoint ring retained, never
+auto-requeued — and the ONLY way back into the queue is this explicit
+release: an operator decision, because the last N attempts each killed
+the service.
+
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR list
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR show JOB_ID
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR release JOB_ID
+
+``release`` resets the payload's restart counter and flips the record
+back to ``queued``; the NEXT service start over the store re-queues it
+through the normal reconciliation path (and its surviving checkpoint
+ring resumes whatever progress the attempts made).  Run it against a
+STOPPED service: a live scheduler only reconciles at startup, so a
+release under a running service sits inert until the next restart —
+``release`` prints exactly that so nobody waits on a poll that will
+never flip.
+
+Deliberately STDLIB-ONLY — it operates on the store's JSON files
+directly instead of importing :class:`~consensus_clustering_tpu.serve.
+jobstore.JobStore` (whose import chain reaches jax via SweepConfig):
+this tool exists for exactly the moments the device stack is wedged or
+the service is crash-looping, and must never import — let alone
+initialise — the accelerator stack to do its job.  The file formats it
+touches (job records; the payload JSON envelope with
+``restart_attempts``) are the jobstore's own, written with the same
+write-temp + ``os.replace`` discipline; tests/test_hostile.py
+round-trips both against a real ``JobStore`` so the two
+implementations cannot drift silently, and a ``-X importtime`` test
+pins the no-jax property.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
+    # Same unique-temp + rename rule as the jobstore: two writers must
+    # never rename each other's half-written temp out from under them.
+    tmp = f"{path}.{uuid.uuid4().hex}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True, default=float)
+    os.replace(tmp, path)
+
+
+def _load_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def _job_path(store_dir: str, job_id: str) -> str:
+    # The jobstore's traversal guard, duplicated verbatim: a crafted id
+    # must not escape the store directory here either.
+    if not job_id.replace("-", "").isalnum():
+        raise ValueError(f"invalid job id {job_id!r}")
+    return os.path.join(store_dir, "jobs", f"{job_id}.json")
+
+
+def _payload_json_path(store_dir: str, job_id: str) -> str:
+    if not job_id.replace("-", "").isalnum():
+        raise ValueError(f"invalid job id {job_id!r}")
+    return os.path.join(store_dir, "payloads", f"{job_id}.json")
+
+
+def load_job(store_dir: str, job_id: str) -> Optional[Dict[str, Any]]:
+    try:
+        return _load_json(_job_path(store_dir, job_id))
+    except ValueError:
+        return None
+
+
+def _load_payload_envelope(
+    store_dir: str, job_id: str
+) -> Optional[Tuple[Dict[str, Any], int]]:
+    """(spec payload, restart_attempts) from the payload JSON —
+    understanding both the envelope format and the pre-envelope plain
+    spec dict (attempts 0)."""
+    raw = _load_json(_payload_json_path(store_dir, job_id))
+    if raw is None:
+        return None
+    if isinstance(raw, dict) and "spec" in raw and "restart_attempts" in raw:
+        return raw["spec"], int(raw["restart_attempts"])
+    return raw, 0
+
+
+def quarantined_jobs(store_dir: str) -> List[Dict[str, Any]]:
+    """Every quarantined record in the store, oldest first."""
+    jobs_dir = os.path.join(store_dir, "jobs")
+    out = []
+    try:
+        names = sorted(os.listdir(jobs_dir))
+    except FileNotFoundError:
+        return []
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        record = _load_json(os.path.join(jobs_dir, name))
+        if record is not None and record.get("status") == "quarantined":
+            out.append(record)
+    out.sort(key=lambda r: r.get("quarantined_at", 0))
+    return out
+
+
+def release_job(store_dir: str, job_id: str) -> Dict[str, Any]:
+    """Flip a quarantined job back to ``queued`` with a zeroed restart
+    counter; returns the updated record.
+
+    Raises ``KeyError`` for an unknown job, ``ValueError`` when the job
+    is not quarantined (releasing a live or completed job would corrupt
+    its lifecycle) or its payload is gone (nothing left to re-run —
+    the record is all that survived).
+    """
+    record = load_job(store_dir, job_id)
+    if record is None:
+        raise KeyError(f"unknown job {job_id!r}")
+    if record.get("status") != "quarantined":
+        raise ValueError(
+            f"job {job_id} is {record.get('status')!r}, not quarantined "
+            "— only quarantined jobs can be released"
+        )
+    payload = _load_payload_envelope(store_dir, job_id)
+    npy = os.path.join(store_dir, "payloads", f"{job_id}.npy")
+    if payload is None or not os.path.exists(npy):
+        raise ValueError(
+            f"job {job_id} has no usable payload — it cannot be re-run "
+            "(the quarantine retains payloads, so this store was "
+            "modified externally)"
+        )
+    spec_payload, _attempts = payload
+    # Zero the counter FIRST: if this process dies between the two
+    # writes, the job is still quarantined (safe) rather than queued
+    # with a stale counter (would re-quarantine after one restart).
+    _atomic_write_json(
+        _payload_json_path(store_dir, job_id),
+        {"spec": spec_payload, "restart_attempts": 0},
+    )
+    record.update(status="queued", released_at=round(time.time(), 3))
+    record.pop("error", None)
+    record.pop("quarantined_at", None)
+    _atomic_write_json(_job_path(store_dir, job_id), record)
+    return record
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument(
+        "--store-dir", required=True,
+        help="the service's jobstore directory",
+    )
+    sub = parser.add_subparsers(dest="admin_cmd", required=True)
+    sub.add_parser(
+        "list", help="list quarantined jobs (id, restarts, when, error)"
+    )
+    show = sub.add_parser("show", help="print one job's full record")
+    show.add_argument("job_id")
+    release = sub.add_parser(
+        "release",
+        help="re-queue a quarantined job (restart counter zeroed; takes "
+        "effect at the next service start over this store)",
+    )
+    release.add_argument("job_id")
+
+
+def cmd_serve_admin(args) -> int:
+    if args.admin_cmd == "list":
+        jobs = quarantined_jobs(args.store_dir)
+        if not jobs:
+            print("no quarantined jobs")
+            return 0
+        for record in jobs:
+            print(
+                f"{record['job_id']}  "
+                f"restarts={record.get('restart_requeues', '?')}  "
+                f"quarantined_at={record.get('quarantined_at', '?')}  "
+                f"fingerprint={record.get('fingerprint', '?')}"
+            )
+        return 0
+    if args.admin_cmd == "show":
+        record = load_job(args.store_dir, args.job_id)
+        if record is None:
+            print(f"unknown job {args.job_id}", file=sys.stderr)
+            return 1
+        print(json.dumps(record, indent=1, sort_keys=True, default=float))
+        return 0
+    if args.admin_cmd == "release":
+        try:
+            record = release_job(args.store_dir, args.job_id)
+        except (KeyError, ValueError) as e:
+            print(f"release refused: {e}", file=sys.stderr)
+            return 1
+        print(
+            f"released {args.job_id}: status=queued, restart counter "
+            "zeroed. It will be re-queued by the NEXT service start "
+            "over this store (a running service only reconciles at "
+            "startup)."
+        )
+        print(json.dumps(record, indent=1, sort_keys=True, default=float))
+        return 0
+    return 2

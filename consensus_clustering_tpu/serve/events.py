@@ -24,6 +24,20 @@ Events emitted by the service:
   backoff_seconds, error)
 - ``job_failed``      — permanent failure / retries exhausted / timeout
   (job_id, error, kind)
+
+Hostile-path events (docs/SERVING.md "Overload & wedge runbook"):
+
+- ``job_wedged``      — the hang watchdog abandoned a silent attempt
+  (job_id, attempt, point, silent_seconds, deadline_seconds); followed
+  by ``job_retry`` with reason ``wedged:<point>`` or ``job_failed``
+- ``job_requeued``    — restart reconciliation re-queued an orphan
+  (job_id, fingerprint, restart_requeues)
+- ``job_quarantined`` — a crash-looping orphan crossed the requeue cap
+  (job_id, fingerprint, restarts); payload + ring retained
+- ``job_preflight_reject`` — admission refused on the memory estimate
+  (fingerprint, shape, estimated_bytes, budget_bytes); HTTP 413
+- ``job_shed``        — admission refused by the overload shed policy
+  (fingerprint, priority, reason, queue_depth); HTTP 429 + Retry-After
 """
 
 from __future__ import annotations

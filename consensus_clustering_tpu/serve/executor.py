@@ -53,9 +53,13 @@ _CONFIG_KEYS = frozenset(
         "clusterer_options", "bins", "pac_interval", "parity_zeros",
         "analysis", "delta_k_threshold", "dtype", "chunk_size",
         "stream_h_block", "adaptive_tol", "adaptive_patience",
-        "adaptive_min_h",
+        "adaptive_min_h", "priority",
     }
 )
+
+#: Admission priorities, highest first — the overload shed policy's
+#: vocabulary (docs/SERVING.md "Overload & wedge runbook").
+PRIORITIES = ("high", "normal", "low")
 
 # Spec fields that never enter the executable bucket: runtime inputs to
 # the compiled block program (seed, H) or host-side driver/post-
@@ -98,6 +102,10 @@ class JobSpec:
     adaptive_tol: Optional[float] = None
     adaptive_patience: int = 2
     adaptive_min_h: int = 0
+    # Admission priority for the overload shed policy — a scheduling
+    # hint, never part of the result: excluded from the fingerprint (a
+    # resubmission at another priority must dedup) and from the bucket.
+    priority: str = "normal"
 
     def fingerprint_payload(self) -> Dict[str, Any]:
         """The JSON payload hashed into the job fingerprint.
@@ -105,10 +113,13 @@ class JobSpec:
         Everything that determines the RESULT, including the seed;
         ``chunk_size`` is excluded for the same reason the checkpoint
         fingerprint pops it — it only shapes the accumulation GEMMs,
-        counts are exact integers either way.
+        counts are exact integers either way.  ``priority`` is excluded
+        because it steers only admission: the same job submitted high
+        and low is the same result, and must dedup as such.
         """
         payload = dataclasses.asdict(self)
         payload.pop("chunk_size")
+        payload.pop("priority")
         payload["k_values"] = list(self.k_values)
         payload["pac_interval"] = list(self.pac_interval)
         payload["clusterer_options"] = dict(self.clusterer_options)
@@ -302,6 +313,12 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
             f"config.adaptive_tol must be a number >= 0, got "
             f"{adaptive_tol!r}"
         )
+    priority = cfg.get("priority", "normal")
+    if priority not in PRIORITIES:
+        raise JobSpecError(
+            f"config.priority must be one of {list(PRIORITIES)}, got "
+            f"{priority!r}"
+        )
     spec = JobSpec(
         k_values=tuple(int(k) for k in k_values),
         n_iterations=_int("iterations", 25, 2, 100_000),
@@ -322,6 +339,7 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         ),
         adaptive_patience=_int("adaptive_patience", 2, 1, 1000),
         adaptive_min_h=_int("adaptive_min_h", 0, 0, 100_000),
+        priority=priority,
     )
     return spec, x
 
@@ -389,6 +407,11 @@ class SweepExecutor:
         # engine cache: calibration records are read once per process;
         # a record added mid-flight applies after a restart).
         self._resolutions: Dict[Any, Any] = {}
+        # Observed per-bucket block wall-clock (EWMA over evaluated
+        # blocks), the hang watchdog's expectation source: the deadline
+        # for "no block completed" scales off what blocks at this
+        # bucket actually cost on this box.  Guarded by _lock.
+        self._block_seconds: Dict[str, float] = {}
         self.run_count = 0
         self.executable_cache_hits = 0
         self.executable_cache_misses = 0
@@ -569,10 +592,48 @@ class SweepExecutor:
 
     def cancel_events(self) -> None:
         """Invalidate the current job's event generation (called on job
-        timeout so an abandoned execution's late block/K events are
-        dropped, not attributed to a newer job)."""
+        timeout — and by the hang watchdog on a wedge verdict — so an
+        abandoned execution's late block/K events are dropped, not
+        attributed to a newer job)."""
         with self._lock:
             self._cb_gen += 1
+
+    def expected_block_seconds(
+        self, spec: JobSpec, n: int, d: int
+    ) -> Optional[float]:
+        """What one evaluated H-block at this job's bucket is expected
+        to cost, for the hang watchdog's deadline.
+
+        Observed first (the EWMA this process's own blocks feed —
+        ground truth for this box under this load), else derived from
+        the bucket's calibrated record (``rate`` is resamples/s over
+        all K, so one block ≈ ``h_block · nK / rate``), else ``None``
+        (cold bucket: the watchdog falls back to its floor).
+        """
+        resolution = self._resolve_h_block(spec, n, d)
+        key = spec.bucket(n, d, resolution.value)
+        with self._lock:
+            observed = self._block_seconds.get(key)
+        if observed is not None:
+            return observed
+        record = getattr(resolution, "record", None)
+        if record and record.get("rate"):
+            try:
+                return (
+                    float(resolution.value)
+                    * len(spec.k_values)
+                    / float(record["rate"])
+                )
+            except (TypeError, ValueError, ZeroDivisionError):
+                return None
+        return None
+
+    def _observe_block_seconds(self, bucket_key: str, dt: float) -> None:
+        with self._lock:
+            prev = self._block_seconds.get(bucket_key)
+            self._block_seconds[bucket_key] = (
+                dt if prev is None else 0.7 * prev + 0.3 * dt
+            )
 
     # -- execution -------------------------------------------------------
 
@@ -583,6 +644,7 @@ class SweepExecutor:
         progress_cb: Optional[Callable[[int, float], None]] = None,
         block_cb: Optional[Callable[[int, int, list], None]] = None,
         checkpoint_dir: Optional[str] = None,
+        heartbeat=None,
     ) -> Dict[str, Any]:
         """Execute one streamed sweep; returns the JSON-able result.
 
@@ -599,17 +661,31 @@ class SweepExecutor:
         process after a transient failure, or a restarted process after
         a crash — continues from the newest valid generation instead of
         from zero.  The result's ``resumed_from_block`` records which.
+
+        ``heartbeat`` (a :class:`~consensus_clustering_tpu.serve.
+        watchdog.Heartbeat`) is beaten at engine-ready and on every
+        evaluated block — the liveness signal the scheduler's hang
+        watchdog reads.  Block completions also feed the per-bucket
+        block-time EWMA (:meth:`expected_block_seconds`) regardless of
+        callbacks, so the watchdog's deadline tightens as the bucket
+        warms.
         """
         from consensus_clustering_tpu.ops.analysis import (
             area_under_cdf,
             delta_k,
             select_best_k,
         )
+        from consensus_clustering_tpu.serve.watchdog import (
+            PHASE_ENGINE_READY,
+        )
 
         n, d = x.shape
         engine, compile_seconds, cached, resolution = self._get_engine(
             spec, n, d
         )
+        bucket_key = spec.bucket(n, d, resolution.value)
+        if heartbeat is not None:
+            heartbeat.beat(PHASE_ENGINE_READY)
 
         checkpointer = None
         if checkpoint_dir is not None:
@@ -629,11 +705,31 @@ class SweepExecutor:
             with self._lock:
                 return self._cb_gen == gen
 
-        guarded_block_cb = None
-        if block_cb is not None:
-            def guarded_block_cb(block, h_done, pac_list):
-                if _live():
-                    block_cb(block, h_done, pac_list)
+        # One internal per-block hook, always installed: the EWMA and
+        # the heartbeat must advance even for callers that didn't ask
+        # for block events (a wedge is a wedge whether or not anyone
+        # subscribed to progress).
+        last_block_at = [time.monotonic()]
+
+        def guarded_block_cb(block, h_done, pac_list):
+            if not _live():
+                # An abandoned (timed-out/wedged) attempt's device call
+                # finally returned: its dt is the whole stall, and one
+                # 0.3-weighted sample of hours would inflate the wedge
+                # deadline for this bucket — blinding the watchdog the
+                # stall proved necessary.  Nothing from a dead
+                # generation may feed the EWMA, the heartbeat, or the
+                # event stream.
+                return
+            now = time.monotonic()
+            self._observe_block_seconds(
+                bucket_key, now - last_block_at[0]
+            )
+            last_block_at[0] = now
+            if heartbeat is not None:
+                heartbeat.beat(f"block:{block}")
+            if block_cb is not None:
+                block_cb(block, h_done, pac_list)
 
         try:
             t0 = time.perf_counter()

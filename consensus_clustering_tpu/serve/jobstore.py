@@ -112,6 +112,9 @@ class JobStore:
         ``.npy`` payloads accumulate forever on a preemption-heavy pod.
         The grace window spares another live process's in-flight
         admission (payload written moments before its record).
+        QUARANTINED jobs' payloads are explicitly spared: retaining the
+        exact poison (config, data) for offline debugging — and for a
+        ``serve-admin release`` re-run — is the quarantine contract.
         """
         now = time.time()
         for name in os.listdir(self.payloads_dir):
@@ -126,7 +129,7 @@ class JobStore:
                 continue
             record = self.load_job(job_id)
             if record is None or record.get("status") not in (
-                "queued", "running",
+                "queued", "running", "quarantined",
             ):
                 self.delete_payload(job_id)
 
@@ -232,12 +235,25 @@ class JobStore:
         return base + ".json", base + ".npy"
 
     def save_payload(
-        self, job_id: str, payload: Dict[str, Any], x: np.ndarray
+        self,
+        job_id: str,
+        payload: Dict[str, Any],
+        x: np.ndarray,
+        restart_attempts: int = 0,
     ) -> None:
         """Persist what re-running the job needs: the fingerprint-bearing
         config payload plus the data matrix.  Written at admission and
         deleted on the terminal transition — the window in between is
-        exactly when a process death would otherwise strand the job."""
+        exactly when a process death would otherwise strand the job.
+
+        ``restart_attempts`` rides in an envelope AROUND the spec
+        payload (never inside it — the spec payload is hashed into the
+        job fingerprint, and a counter there would change the job's
+        identity on every restart).  It is the monotonically increasing
+        requeue counter the crash-loop quarantine threshold reads: a
+        one-shot record flag forgets previous restarts, this survives
+        *all* of them.
+        """
         json_path, npy_path = self._payload_paths(job_id)
         tmp = f"{npy_path}.{uuid.uuid4().hex}.tmp.npy"
         np.save(tmp, np.ascontiguousarray(x))
@@ -245,25 +261,60 @@ class JobStore:
         # Data first, record second: a crash between the two leaves an
         # orphan .npy (garbage, never loaded) instead of a payload whose
         # load would fail mid-reconciliation.
+        self._write_payload_json(
+            json_path, payload, int(restart_attempts)
+        )
+
+    @staticmethod
+    def _write_payload_json(
+        json_path: str, payload: Dict[str, Any], restart_attempts: int
+    ) -> None:
+        envelope = {
+            "spec": payload,
+            "restart_attempts": int(restart_attempts),
+        }
         tmp = f"{json_path}.{uuid.uuid4().hex}.tmp"
         with open(tmp, "w") as f:
-            json.dump(payload, f, sort_keys=True, default=float)
+            json.dump(envelope, f, sort_keys=True, default=float)
         os.replace(tmp, json_path)
+
+    def set_payload_attempts(
+        self, job_id: str, payload: Dict[str, Any], restart_attempts: int
+    ) -> None:
+        """Rewrite the payload's restart counter (JSON only — the
+        matrix-sized ``.npy`` is untouched).  Called by reconciliation
+        BEFORE re-enqueueing, so a crash-loop that dies again before
+        running still advances the counter — the property that makes
+        the quarantine threshold reachable at all."""
+        json_path, _ = self._payload_paths(job_id)
+        self._write_payload_json(json_path, payload, restart_attempts)
 
     def load_payload(
         self, job_id: str
-    ) -> Optional[Tuple[Dict[str, Any], np.ndarray]]:
+    ) -> Optional[Tuple[Dict[str, Any], np.ndarray, int]]:
+        """(spec payload, data, restart_attempts) or None.
+
+        Pre-envelope payloads (stores written before the quarantine
+        counter existed) load with ``restart_attempts=0`` — a restarted
+        service over an old store starts counting from now.
+        """
         try:
             json_path, npy_path = self._payload_paths(job_id)
         except ValueError:
             return None
         try:
             with open(json_path) as f:
-                payload = json.load(f)
+                raw = json.load(f)
             x = np.load(npy_path)
         except (FileNotFoundError, ValueError):
             return None
-        return payload, x
+        if (
+            isinstance(raw, dict)
+            and "spec" in raw
+            and "restart_attempts" in raw
+        ):
+            return raw["spec"], x, int(raw["restart_attempts"])
+        return raw, x, 0
 
     def delete_payload(self, job_id: str) -> None:
         try:

@@ -1,0 +1,167 @@
+"""Admission-time memory preflight: reject over-budget jobs with a 413.
+
+Without this, the failure is collective punishment: one job whose
+accumulators don't fit OOMs the backend and takes every in-flight job
+(and on most OOM shapes, the process) down with it.  The O(N²) terms
+that dominate a sweep's footprint are *exactly computable at admission*
+— the streaming state is dense int32 by construction — so an
+over-budget job can be refused with a structured 413 before anything is
+compiled or admitted, and the client gets the sizing model instead of a
+dead connection.
+
+The model mirrors what ``benchmarks/memory_scaling.py`` measures on the
+compiled plan (its finding: the N² accumulator/consensus terms dominate
+and everything else is shape-noise at serving scales):
+
+- **streaming state** — per-K ``Mij`` (nK, N, N) + ``Iij`` (N, N),
+  int32: ``4·(nK+1)·N²`` bytes, exact.
+- **checkpoint pinning** — with block checkpointing on the non-donated
+  path, the async writer pins up to ~3 extra state generations
+  (in-flight snapshot, one queued, one serializing —
+  ``parallel/streaming.py``'s overlap caveat), so the state term is
+  multiplied by ``1 + 2`` as the middle-of-road bound the writer's
+  queue=1 backpressure enforces.
+- **consensus workspace** — the per-K scan materialises a float32
+  consensus block + histogram temps: ``~8·N²`` bytes.
+- **data + clustering lanes** — ``N·d`` at the working dtype plus the
+  per-block lane workspace ``h_block · n_sub · (d + k_max)`` floats,
+  doubled for XLA temps.
+
+This is a deliberately *simple lower bound with exact leading terms*:
+if the estimate alone exceeds the budget, the real plan certainly does.
+It is not a substitute for XLA's own plan (which requires the compile
+this check exists to avoid paying for a doomed job).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+#: Extra state generations the checkpoint writer can pin concurrently
+#: (streaming.py overlap caveat: in-flight snapshot + queued +
+#: serializing, bounded by the writer's maxsize=1 queue).
+_CHECKPOINT_PIN_GENERATIONS = 2
+
+_ENV_BUDGET = "CCTPU_MEMORY_BUDGET"
+
+
+class PreflightReject(Exception):
+    """The job's estimated footprint exceeds the memory budget (413).
+
+    ``payload`` is the structured body the HTTP layer returns: the
+    estimate breakdown, the budget, and the knobs that would shrink the
+    job — an actionable refusal, not a bare status code.
+    """
+
+    def __init__(self, payload: Dict[str, Any]):
+        self.payload = payload
+        super().__init__(payload.get("error", "memory preflight reject"))
+
+
+def estimate_job_bytes(
+    n: int,
+    d: int,
+    k_values: Sequence[int],
+    dtype: str = "float32",
+    h_block: int = 16,
+    subsampling: float = 0.8,
+    checkpoints: bool = True,
+) -> Dict[str, Any]:
+    """Estimated device-memory footprint for one streamed job, in bytes.
+
+    Returns the breakdown (each term separately) plus ``total_bytes`` —
+    the number the admission gate compares against the budget.
+    Monotonic in N, |K| and h_block by construction, which is what the
+    preflight tests pin down.
+    """
+    n = int(n)
+    nk = len(tuple(k_values))
+    k_max = max(int(k) for k in k_values)
+    itemsize = 8 if dtype == "float64" else 4
+    n_sub = max(1, int(round(n * float(subsampling))))
+
+    state = 4 * (nk + 1) * n * n
+    pin = 1 + (_CHECKPOINT_PIN_GENERATIONS if checkpoints else 0)
+    workspace = 8 * n * n
+    data = n * d * itemsize
+    lanes = 2 * int(h_block) * n_sub * (d + k_max) * itemsize
+    total = state * pin + workspace + data + lanes
+    return {
+        "state_bytes": int(state),
+        "pinned_state_generations": int(pin),
+        "workspace_bytes": int(workspace),
+        "data_bytes": int(data),
+        "lane_bytes": int(lanes),
+        "total_bytes": int(total),
+        "model": "dense int32 accumulators (exact) + f32 consensus "
+        "workspace + data + clustering lanes; see serve/preflight.py",
+    }
+
+
+def resolve_memory_budget(explicit: Optional[int] = None) -> Optional[int]:
+    """The budget the preflight gate compares against, in bytes.
+
+    Precedence: an explicit operator value, then ``CCTPU_MEMORY_BUDGET``
+    (bytes), then the backend device's own ``bytes_limit`` (TPU/GPU
+    report it), then — on the CPU fallback, where "device memory" is
+    host RAM — total physical memory.  ``None`` means no budget could
+    be determined and the gate stays open (logged once by the caller).
+    """
+    if explicit is not None:
+        return int(explicit) if explicit > 0 else None
+    env = os.environ.get(_ENV_BUDGET)
+    if env:
+        try:
+            v = int(env)
+            return v if v > 0 else None
+        except ValueError:
+            logger.warning("ignoring non-integer %s=%r", _ENV_BUDGET, env)
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            return int(limit)
+    except Exception:  # noqa: BLE001 — budget resolution is best-effort
+        pass
+    try:
+        return int(os.sysconf("SC_PHYS_PAGES")) * int(
+            os.sysconf("SC_PAGE_SIZE")
+        )
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+def check_admission(
+    estimate: Dict[str, Any], budget_bytes: int, shape: Sequence[int]
+) -> None:
+    """Raise :class:`PreflightReject` when the estimate exceeds the
+    budget; no-op otherwise.  Split from the estimate so the scheduler
+    can count/emit on the reject path with the payload in hand."""
+    total = int(estimate["total_bytes"])
+    if total <= budget_bytes:
+        return
+    raise PreflightReject(
+        {
+            "error": (
+                f"memory preflight: job at shape {list(shape)} needs an "
+                f"estimated {total} bytes but the backend budget is "
+                f"{budget_bytes} bytes — admitting it would OOM every "
+                "in-flight job"
+            ),
+            "estimated_bytes": total,
+            "budget_bytes": int(budget_bytes),
+            "estimate": dict(estimate),
+            "hint": (
+                "shrink N (the N² accumulator term dominates), the K "
+                "list, or stream_h_block; or raise the budget "
+                "(--memory-budget / CCTPU_MEMORY_BUDGET) if the model "
+                "is wrong for your backend"
+            ),
+        }
+    )

@@ -31,6 +31,33 @@ Each job runs with:
   then resume from their checkpoint ring) instead of failing them; only
   orphans whose payload is missing (pre-durability stores) are failed.
 
+Hostile-path hardening (docs/SERVING.md "Overload & wedge runbook"):
+
+- **hang watchdog**: with ``watchdog=True`` the per-job thread's
+  liveness heartbeat (beaten by the executor on engine-ready and every
+  evaluated H-block) is supervised; silence past
+  ``max(wedge_floor, wedge_scale × expected_block_seconds)`` (compile
+  grace before the first beat) declares the job *wedged* — the thread
+  is abandoned, the attempt triaged ``wedged:<point>``, and the retry
+  resumes from the checkpoint ring.  The r02-r05 10-22 h backend wedges
+  become one deadline of lost time;
+- **crash-loop quarantine**: reconciliation reads the monotonically
+  increasing restart counter persisted in the job payload; a job
+  re-queued more than ``quarantine_after`` times is marked
+  ``quarantined`` — payload and checkpoint ring RETAINED for offline
+  debugging, never auto-requeued, released only by an explicit
+  ``serve-admin release`` — so one poison job cannot take the service
+  down N times;
+- **memory preflight**: with a ``memory_budget_bytes``, admission
+  estimates the job's accumulator/state footprint
+  (:mod:`~consensus_clustering_tpu.serve.preflight`) and rejects
+  over-budget jobs with a structured 413 instead of an OOM that kills
+  every in-flight job;
+- **overload shedding**: with a :class:`ShedPolicy`, low-priority
+  admissions are refused (429 + Retry-After) once queue depth or the
+  recent wedge rate crosses thresholds, so high-priority traffic still
+  lands under stress.
+
 Job records live in memory for speed and are mirrored to the jobstore on
 every transition, so ``GET /jobs/<id>`` survives a restart.
 """
@@ -42,18 +69,29 @@ import queue
 import threading
 import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from consensus_clustering_tpu.resilience.faults import classify_error
 from consensus_clustering_tpu.serve.events import EventLog
 from consensus_clustering_tpu.serve.executor import (
+    PRIORITIES,
     JobSpec,
     JobSpecError,
     SweepExecutor,
 )
 from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.preflight import (
+    PreflightReject,
+    check_admission,
+    estimate_job_bytes,
+)
+from consensus_clustering_tpu.serve.watchdog import (
+    Heartbeat,
+    JobWedged,
+    wedge_deadline,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -62,9 +100,82 @@ class QueueFull(Exception):
     """Admission rejected: the job queue is at capacity (HTTP 429)."""
 
 
+class QueueShed(Exception):
+    """Admission refused by the overload shed policy (HTTP 429 +
+    ``Retry-After``): the service is protecting higher-priority
+    traffic, not full — retrying after the hint is expected to land."""
+
+    def __init__(self, priority: str, reason: str, retry_after: float):
+        self.priority = priority
+        self.reason = reason
+        self.retry_after = retry_after
+        super().__init__(
+            f"shedding {priority}-priority admission ({reason}); "
+            f"retry after {retry_after:.0f}s"
+        )
+
+
+class ShedPolicy:
+    """When to refuse admissions to protect higher-priority traffic.
+
+    Two pressure signals, both cheap to read at admission time:
+
+    - **queue depth** — ``low`` sheds at ``low_frac`` of capacity,
+      ``normal`` at ``normal_frac``; ``high`` is never shed by policy
+      (a genuinely full queue still 429s everyone via ``QueueFull``).
+    - **wedge rate** — ``wedge_threshold`` wedge verdicts inside
+      ``wedge_window`` seconds shed ``low`` at ANY depth: a backend
+      that keeps wedging is about to stop clearing the queue, and
+      admitting more best-effort work into it only deepens the hole.
+    """
+
+    def __init__(
+        self,
+        low_frac: float = 0.5,
+        normal_frac: float = 0.85,
+        wedge_window: float = 300.0,
+        wedge_threshold: int = 3,
+        retry_after: float = 15.0,
+    ):
+        if not 0.0 < low_frac <= normal_frac <= 1.0:
+            raise ValueError(
+                f"need 0 < low_frac <= normal_frac <= 1, got "
+                f"{low_frac}/{normal_frac}"
+            )
+        self.low_frac = low_frac
+        self.normal_frac = normal_frac
+        self.wedge_window = wedge_window
+        self.wedge_threshold = wedge_threshold
+        self.retry_after = retry_after
+
+    def decide(
+        self, priority: str, depth: int, capacity: int, recent_wedges: int
+    ) -> Optional[str]:
+        """A shed reason, or None to admit."""
+        if priority == "high":
+            return None
+        # capacity <= 0 is queue.Queue's "unbounded" spelling (a valid
+        # --queue-size 0 deployment): there is no fraction to be "at",
+        # so depth-based shedding is off and only a wedge storm sheds.
+        frac = depth / capacity if capacity > 0 else 0.0
+        if priority == "low" and recent_wedges >= self.wedge_threshold:
+            return (
+                f"wedge storm: {recent_wedges} wedges in the last "
+                f"{self.wedge_window:.0f}s"
+            )
+        if priority == "low" and frac >= self.low_frac:
+            return f"queue at {depth}/{capacity} (low watermark)"
+        if priority == "normal" and frac >= self.normal_frac:
+            return f"queue at {depth}/{capacity} (normal watermark)"
+        return None
+
+
 # Statuses that never transition again: once mirrored to the jobstore,
 # records in these states are served from disk and evicted from memory.
-_TERMINAL = frozenset({"done", "failed", "timeout"})
+# "quarantined" is terminal for the SCHEDULER (never auto-requeued) but
+# deliberately keeps its payload + checkpoint ring — see _update and
+# the jobstore's orphan-payload sweep.
+_TERMINAL = frozenset({"done", "failed", "timeout", "quarantined"})
 
 
 class JobTimeout(Exception):
@@ -85,7 +196,19 @@ class Scheduler:
         events: Optional[EventLog] = None,
         sleep=time.sleep,
         checkpoints: bool = True,
+        quarantine_after: int = 3,
+        watchdog: bool = False,
+        wedge_floor: float = 30.0,
+        wedge_scale: float = 8.0,
+        wedge_compile_grace: float = 600.0,
+        wedge_poll: float = 0.25,
+        shed_policy: Optional[ShedPolicy] = None,
+        memory_budget_bytes: Optional[int] = None,
     ):
+        if quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got {quarantine_after}"
+            )
         self.executor = executor
         self.store = store
         self.events = events or EventLog(None)
@@ -96,6 +219,19 @@ class Scheduler:
         # without a ring); payload persistence and restart re-queue stay
         # on — they cost one small write per job, not one per block.
         self.checkpoints = checkpoints
+        # Crash-loop cap: an orphan re-queued more than this many times
+        # across restarts is quarantined instead of re-queued again.
+        self.quarantine_after = quarantine_after
+        # Hang watchdog knobs (serve/watchdog.py): enabled, the floor /
+        # scale for the per-block silence deadline, the pre-first-block
+        # compile grace, and the supervisor's poll cadence.
+        self.watchdog = watchdog
+        self.wedge_floor = wedge_floor
+        self.wedge_scale = wedge_scale
+        self.wedge_compile_grace = wedge_compile_grace
+        self.wedge_poll = wedge_poll
+        self.shed_policy = shed_policy
+        self.memory_budget_bytes = memory_budget_bytes
         self._sleep = sleep  # injectable so retry tests need not wait
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._jobs: Dict[str, Dict[str, Any]] = {}
@@ -106,16 +242,27 @@ class Scheduler:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
-        # Counters for GET /metrics; guarded by _lock.
+        # Counters for GET /metrics; guarded by _lock.  Every counter —
+        # including each jobs_shed_total priority key — is PRE-SEEDED
+        # here: metrics() dict-copies these without coordination, and a
+        # first-key insertion racing that copy would 500 the /metrics
+        # endpoint (the PR-5 dict-copy-races-first-insert class).
         self.jobs_completed = 0
         self.jobs_failed = 0
         self.jobs_retried = 0
         self.jobs_timed_out = 0
         self.jobs_requeued = 0
+        self.jobs_wedged_total = 0
+        self.jobs_quarantined = 0
+        self.preflight_rejects_total = 0
+        self.jobs_shed_total: Dict[str, int] = {p: 0 for p in PRIORITIES}
         self.cache_hits = 0
         # Retries by classify_error reason ({"injected": 1, "oom": 2,
         # ...}) — the /metrics retry_total{reason} satellite.
         self.retry_total: Dict[str, int] = {}
+        # Wedge verdict timestamps inside the shed policy's window —
+        # the wedge-rate pressure signal.  Guarded by _lock.
+        self._recent_wedges: List[float] = []
 
     # -- lifecycle -------------------------------------------------------
 
@@ -129,20 +276,33 @@ class Scheduler:
         self._worker.start()
 
     def _reconcile_orphans(self) -> None:
-        """Re-queue (or, failing that, fail over) jobs a previous
-        process left non-terminal.
+        """Re-queue, quarantine, or fail over jobs a previous process
+        left non-terminal.
 
         The jobstore persists every job's (config, data) payload for its
         non-terminal life, so a ``queued``/``running`` orphan from a
         dead process is RE-QUEUED here: the worker re-runs it, and the
         executor resumes from the job's checkpoint ring — the crash
-        costs at most one block of work plus the re-queue.  Orphans
-        whose payload is missing (stores written before durability, or a
-        crash inside the admission window) are failed as before — a
-        client polling from before the restart must terminate either
-        way.  Jobs this scheduler tracks in memory are skipped (a
-        stop()/start() cycle within one process must not touch live
-        work).
+        costs at most one block of work plus the re-queue.
+
+        The payload also carries the job's monotonically increasing
+        restart counter.  Unconditional re-queueing is how one poison
+        job (one that deterministically kills the process — a real XLA
+        abort, or the ``CCTPU_FAULTS`` kill class) crash-loops the
+        service forever: every restart re-queues it, it kills the
+        process again.  So the counter is bumped — and PERSISTED —
+        before the job becomes runnable, and an orphan past
+        ``quarantine_after`` re-queues is marked ``quarantined``
+        instead: payload and checkpoint ring retained for offline
+        debugging, never auto-requeued, released only by an explicit
+        ``serve-admin release``.
+
+        Orphans whose payload is missing (stores written before
+        durability, or a crash inside the admission window) are failed
+        as before — a client polling from before the restart must
+        terminate either way.  Jobs this scheduler tracks in memory are
+        skipped (a stop()/start() cycle within one process must not
+        touch live work).
         """
         for job_id, record in self.store.iter_jobs():
             with self._lock:
@@ -154,7 +314,7 @@ class Scheduler:
             reason = "interrupted by service restart"
             payload = self.store.load_payload(job_id)
             if payload is not None:
-                spec_payload, x = payload
+                spec_payload, x, prior_requeues = payload
                 try:
                     spec = JobSpec.from_payload(spec_payload)
                 except (KeyError, TypeError, ValueError) as e:
@@ -170,9 +330,51 @@ class Scheduler:
                         job_id, e,
                     )
                 else:
+                    requeues = int(prior_requeues) + 1
+                    if requeues > self.quarantine_after:
+                        record.update(
+                            status="quarantined",
+                            error=(
+                                "crash-looped: interrupted by "
+                                f"{requeues} service restarts (cap "
+                                f"{self.quarantine_after}); payload and "
+                                "checkpoint ring retained — inspect and "
+                                "release with `python -m "
+                                "consensus_clustering_tpu serve-admin "
+                                "release`"
+                            ),
+                            restart_requeues=requeues - 1,
+                            quarantined_at=round(time.time(), 3),
+                        )
+                        self.store.save_job(record)
+                        # Payload + ring deliberately NOT deleted: the
+                        # exact poison (config, data, partial state) is
+                        # the debugging artefact.
+                        with self._lock:
+                            self.jobs_quarantined += 1
+                        self.events.emit(
+                            "job_quarantined", job_id=job_id,
+                            fingerprint=record.get("fingerprint"),
+                            restarts=requeues - 1,
+                        )
+                        logger.error(
+                            "quarantined crash-looping job %s after %d "
+                            "restarts (release with serve-admin)",
+                            job_id, requeues - 1,
+                        )
+                        continue
+                    # Persist the bumped counter BEFORE the job becomes
+                    # runnable: if it kills the process again before (or
+                    # during) its run, the NEXT reconciliation must see
+                    # this restart counted — that ordering is what makes
+                    # the quarantine threshold reachable at all.
+                    self.store.set_payload_attempts(
+                        job_id, spec_payload, requeues
+                    )
                     record.update(
                         status="queued",
                         requeued_after_restart=True,
+                        restart_requeues=requeues,
                         requeued_at=round(time.time(), 3),
                     )
                     record.pop("error", None)
@@ -209,6 +411,7 @@ class Scheduler:
                         self.events.emit(
                             "job_requeued", job_id=job_id,
                             fingerprint=record.get("fingerprint"),
+                            restart_requeues=record["restart_requeues"],
                         )
                         continue
             record.update(
@@ -243,7 +446,12 @@ class Scheduler:
         Identical (config, data) submissions dedup: if the fingerprint's
         result is stored, the job is born ``done`` with that result and
         never queues.  Raises :class:`QueueFull` when the queue is at
-        capacity.
+        capacity, :class:`PreflightReject` (413) when the job's
+        estimated memory footprint exceeds the budget, and
+        :class:`QueueShed` (429 + Retry-After) when the shed policy
+        refuses this priority under current pressure.  The gates run in
+        that order, after the dedup check — a stored result is served
+        whatever the pressure, it costs one disk read.
         """
         fp = self.store.fingerprint(spec.fingerprint_payload(), x)
         job_id = uuid.uuid4().hex
@@ -254,6 +462,7 @@ class Scheduler:
             "shape": [int(v) for v in x.shape],
             "submitted_at": round(time.time(), 3),
             "attempt": 0,
+            "priority": spec.priority,
         }
         cached = self.store.get_result(fp)
         if cached is not None:
@@ -272,6 +481,8 @@ class Scheduler:
             )
             return record
 
+        self._preflight(spec, x, fp)
+        self._shed_gate(spec, fp)
         record["from_cache"] = False
         with self._lock:
             self._jobs[job_id] = record
@@ -320,6 +531,72 @@ class Scheduler:
         )
         return snapshot
 
+    def _preflight(self, spec: JobSpec, x: np.ndarray, fp: str) -> None:
+        """Reject an over-budget job with a structured 413 BEFORE it
+        can compile/admit and OOM every in-flight job.  No-op without a
+        configured budget."""
+        if self.memory_budget_bytes is None:
+            return
+        n, d = (int(v) for v in x.shape)
+        h_block = 16
+        if hasattr(self.executor, "_resolve_h_block"):
+            try:
+                h_block = int(
+                    self.executor._resolve_h_block(spec, n, d).value
+                )
+            except Exception:  # noqa: BLE001 — the estimate survives a
+                pass  # resolution hiccup; 16 is the heuristic floor
+        estimate = estimate_job_bytes(
+            n, d, spec.k_values,
+            dtype=spec.dtype,
+            h_block=h_block,
+            subsampling=spec.subsampling,
+            checkpoints=self.checkpoints,
+        )
+        try:
+            check_admission(estimate, self.memory_budget_bytes, x.shape)
+        except PreflightReject as e:
+            with self._lock:
+                self.preflight_rejects_total += 1
+            self.events.emit(
+                "job_preflight_reject", fingerprint=fp,
+                shape=[n, d],
+                estimated_bytes=e.payload["estimated_bytes"],
+                budget_bytes=e.payload["budget_bytes"],
+            )
+            raise
+
+    def _shed_gate(self, spec: JobSpec, fp: str) -> None:
+        """Apply the overload shed policy to this admission; raises
+        :class:`QueueShed` when the policy refuses.  No-op without a
+        policy."""
+        if self.shed_policy is None:
+            return
+        now = time.time()
+        with self._lock:
+            self._recent_wedges = [
+                t for t in self._recent_wedges
+                if now - t <= self.shed_policy.wedge_window
+            ]
+            wedges = len(self._recent_wedges)
+        reason = self.shed_policy.decide(
+            spec.priority, self._queue.qsize(), self._queue.maxsize,
+            wedges,
+        )
+        if reason is None:
+            return
+        with self._lock:
+            self.jobs_shed_total[spec.priority] = (
+                self.jobs_shed_total.get(spec.priority, 0) + 1
+            )
+        self.events.emit(
+            "job_shed", fingerprint=fp, priority=spec.priority,
+            reason=reason, queue_depth=self._queue.qsize(),
+        )
+        raise QueueShed(
+            spec.priority, reason, self.shed_policy.retry_after
+        )
+
     def get(self, job_id: str) -> Optional[Dict[str, Any]]:
         with self._lock:
             record = self._jobs.get(job_id)
@@ -367,6 +644,15 @@ class Scheduler:
                 ),
                 "retry_total": dict(self.retry_total),
                 "jobs_requeued": self.jobs_requeued,
+                # Hostile-path counters (docs/SERVING.md "Overload &
+                # wedge runbook"): wedge verdicts, crash-loop
+                # quarantines, admissions shed by priority, and
+                # preflight 413s.  All pre-seeded at construction.
+                "jobs_wedged_total": self.jobs_wedged_total,
+                "jobs_quarantined": self.jobs_quarantined,
+                "jobs_shed_total": dict(self.jobs_shed_total),
+                "preflight_rejects_total": self.preflight_rejects_total,
+                "memory_budget_bytes": self.memory_budget_bytes,
                 # Block-size resolution tiers over executed jobs
                 # (docs/AUTOTUNE.md "Provenance"): whether calibration
                 # actually steers traffic, or jobs pin their own block,
@@ -395,27 +681,54 @@ class Scheduler:
             with self._lock:
                 self._jobs.pop(job_id, None)
             # The payload exists to survive a crash of a NON-terminal
-            # job; past this point it is dead weight.  The checkpoint
-            # ring goes only on success: a failed/timed-out job's ring
-            # lets an identical resubmission resume the lost progress.
-            self.store.delete_payload(job_id)
+            # job; past this point it is dead weight — EXCEPT for a
+            # quarantined job, whose payload (the exact poison) is the
+            # debugging artefact the quarantine retains by contract.
+            # The checkpoint ring goes only on success: a failed/
+            # timed-out/quarantined job's ring lets a resubmission or a
+            # released job resume the lost progress.
+            if snapshot.get("status") != "quarantined":
+                self.store.delete_payload(job_id)
             if snapshot.get("status") == "done" and snapshot.get(
                 "fingerprint"
             ):
                 self.store.clear_checkpoints(snapshot["fingerprint"])
         return snapshot
 
-    def _run_with_timeout(self, spec: JobSpec, x, progress_cb, **kwargs):
-        """Run the executor, bounding wall-clock with a per-job thread.
+    def _run_with_timeout(
+        self,
+        spec: JobSpec,
+        x,
+        progress_cb,
+        heartbeat: Optional[Heartbeat] = None,
+        expected_block_fn=None,
+        **kwargs,
+    ):
+        """Run the executor on a supervised per-job thread.
 
-        A compiled XLA program has no cancellation point (the streaming
-        driver does check between blocks, but a single block can still
-        be long), so on timeout the job thread is abandoned (daemon; it
-        dies with the process) and its event generation invalidated —
-        see the executor docstring for the attribution corner this
-        accepts.
+        Two independent verdicts can abandon the thread (a compiled XLA
+        program has no cancellation point, so "abandon" is the only
+        cancel: daemon thread, event generation invalidated — see the
+        executor docstring for the attribution corner this accepts):
+
+        - **timeout** — total wall-clock exceeded ``job_timeout``
+          (terminal, as before);
+        - **wedged** — the liveness heartbeat (``heartbeat``, beaten by
+          the executor on engine-ready and every evaluated block) went
+          silent past the phase's deadline
+          (:func:`~consensus_clustering_tpu.serve.watchdog.
+          wedge_deadline` over ``expected_block_fn()``, the bucket's
+          observed/calibrated block time).  Raises
+          :class:`~consensus_clustering_tpu.serve.watchdog.JobWedged`,
+          which the retry loop triages as retryable — the retry resumes
+          from the checkpoint ring.
         """
-        if self.job_timeout is None:
+        supervise_wedge = self.watchdog and heartbeat is not None
+        if heartbeat is not None:
+            # Only set for streaming executors (which accept the
+            # kwarg); stub executors never see it.
+            kwargs["heartbeat"] = heartbeat
+        if self.job_timeout is None and not supervise_wedge:
             return self.executor.run(spec, x, progress_cb, **kwargs)
         box: Dict[str, Any] = {}
 
@@ -429,12 +742,41 @@ class Scheduler:
 
         t = threading.Thread(target=_target, daemon=True)
         t.start()
-        t.join(self.job_timeout)
-        if t.is_alive():
-            self.executor.cancel_events()
-            raise JobTimeout(
-                f"job exceeded {self.job_timeout}s wall-clock budget"
-            )
+        started = time.monotonic()
+        # Poll fast relative to the smallest deadline in play so a
+        # wedge is detected well inside the 2×-deadline acceptance
+        # bound (chaos_soak asserts it).
+        poll = (
+            min(self.wedge_poll, max(self.wedge_floor / 4, 0.01))
+            if supervise_wedge
+            else self.job_timeout
+        )
+        while True:
+            t.join(poll)
+            if not t.is_alive():
+                break
+            if (
+                self.job_timeout is not None
+                and time.monotonic() - started >= self.job_timeout
+            ):
+                self.executor.cancel_events()
+                raise JobTimeout(
+                    f"job exceeded {self.job_timeout}s wall-clock budget"
+                )
+            if supervise_wedge:
+                silent, phase = heartbeat.read()
+                expected = (
+                    expected_block_fn() if expected_block_fn else None
+                )
+                allowed = wedge_deadline(
+                    phase, expected,
+                    floor=self.wedge_floor,
+                    scale=self.wedge_scale,
+                    compile_grace=self.wedge_compile_grace,
+                )
+                if silent > allowed:
+                    self.executor.cancel_events()
+                    raise JobWedged(phase, silent, allowed)
         if "error" in box:
             raise box["error"]
         return box["result"]
@@ -508,17 +850,37 @@ class Scheduler:
             )
 
         # Duck-typed executors (test stubs) may not stream; only a real
-        # streaming executor gets the per-block callback and the
-        # checkpoint ring (the resume surface).
+        # streaming executor gets the per-block callback, the
+        # checkpoint ring (the resume surface), and the hang watchdog's
+        # heartbeat/expectation plumbing.
         run_kwargs: Dict[str, Any] = {}
-        if hasattr(self.executor, "default_h_block"):
+        streaming_executor = hasattr(self.executor, "default_h_block")
+        expected_block_fn = None
+        if streaming_executor:
             run_kwargs["block_cb"] = block_cb
             if self.checkpoints:
                 run_kwargs["checkpoint_dir"] = self.store.checkpoint_dir(
                     fp
                 )
+            if self.watchdog and hasattr(
+                self.executor, "expected_block_seconds"
+            ):
+                n, d = (int(v) for v in x.shape)
+
+                def expected_block_fn():
+                    try:
+                        return self.executor.expected_block_seconds(
+                            spec, n, d
+                        )
+                    except Exception:  # noqa: BLE001 — an expectation
+                        return None  # hiccup must not fail a live job
 
         for attempt in range(self.max_retries + 1):
+            heartbeat = None
+            if self.watchdog and streaming_executor:
+                # Fresh per attempt: a retry's deadline clock must not
+                # inherit the wedged attempt's silence.
+                heartbeat = Heartbeat()
             self._update(
                 job_id, status="running", attempt=attempt,
                 started_at=round(time.time(), 3),
@@ -527,7 +889,10 @@ class Scheduler:
             t0 = time.perf_counter()
             try:
                 result = self._run_with_timeout(
-                    spec, x, progress_cb, **run_kwargs
+                    spec, x, progress_cb,
+                    heartbeat=heartbeat,
+                    expected_block_fn=expected_block_fn,
+                    **run_kwargs,
                 )
             except JobTimeout as e:
                 with self._lock:
@@ -560,8 +925,24 @@ class Scheduler:
                 # the transient class (preemptions, device/runtime/IO
                 # faults) re-runs after backoff and — because the
                 # executor keeps the checkpoint ring — resumes from the
-                # last completed block, not from zero.
-                kind, reason = classify_error(e)
+                # last completed block, not from zero.  A wedge verdict
+                # is retryable by construction (the watchdog already
+                # abandoned the silent thread; the backend may well
+                # serve the retry fine) and carries its own triage
+                # label, ``wedged:<point>``.
+                if isinstance(e, JobWedged):
+                    kind, reason = "retryable", e.reason
+                    with self._lock:
+                        self.jobs_wedged_total += 1
+                        self._recent_wedges.append(time.time())
+                    self.events.emit(
+                        "job_wedged", job_id=job_id, attempt=attempt,
+                        point=e.point,
+                        silent_seconds=round(e.silent_seconds, 3),
+                        deadline_seconds=round(e.deadline, 3),
+                    )
+                else:
+                    kind, reason = classify_error(e)
                 if kind == "retryable" and attempt < self.max_retries:
                     backoff = self.backoff_base * (2 ** attempt)
                     with self._lock:

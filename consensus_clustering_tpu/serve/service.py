@@ -8,7 +8,11 @@ framework, nothing the container doesn't already have.  Endpoints:
   executor.parse_job_spec` for the config schema).  202 + job record on
   admission, 200 + completed record when the (config, data) fingerprint
   dedups against the jobstore, 400 on a malformed body, 429 when the
-  queue is full, 413 when the body exceeds ``max_body_bytes``.
+  queue is full — or, with ``Retry-After``, when the overload shed
+  policy refuses this ``config.priority`` under pressure — and 413 when
+  the body exceeds ``max_body_bytes`` or the memory preflight estimates
+  the job over the backend budget (structured body with the estimate
+  breakdown).
 - ``GET /jobs/<id>``   — poll a job; embeds ``result`` once done.
 - ``GET /healthz``     — liveness: status, backend label, uptime.
 - ``GET /metrics``     — queue depth/capacity, jobs completed/failed/
@@ -47,7 +51,13 @@ from consensus_clustering_tpu.serve.executor import (
     parse_job_spec,
 )
 from consensus_clustering_tpu.serve.jobstore import JobStore
-from consensus_clustering_tpu.serve.scheduler import QueueFull, Scheduler
+from consensus_clustering_tpu.serve.preflight import PreflightReject
+from consensus_clustering_tpu.serve.scheduler import (
+    QueueFull,
+    QueueShed,
+    Scheduler,
+    ShedPolicy,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -65,11 +75,18 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route access logs to logging
         logger.debug("http: " + fmt, *args)
 
-    def _send_json(self, code: int, payload: Dict[str, Any]) -> None:
+    def _send_json(
+        self,
+        code: int,
+        payload: Dict[str, Any],
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         blob = json.dumps(payload, sort_keys=True, default=float).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(blob)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(blob)
 
@@ -105,6 +122,26 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             record = self.service.scheduler.submit(spec, x)
+        except PreflightReject as e:
+            # Structured 413: the estimate breakdown and the budget —
+            # an actionable refusal (shrink N / K / block, or raise the
+            # budget), not a bare status code.
+            self._send_json(413, dict(e.payload))
+            return
+        except QueueShed as e:
+            # Shed ≠ full: the service is protecting higher-priority
+            # traffic.  Retry-After is the client's backoff contract.
+            self._send_json(
+                429,
+                {
+                    "error": str(e),
+                    "shed": True,
+                    "priority": e.priority,
+                    "retry_after_seconds": e.retry_after,
+                },
+                headers={"Retry-After": str(int(e.retry_after))},
+            )
+            return
         except QueueFull as e:
             self._send_json(429, {"error": str(e)})
             return
@@ -153,6 +190,13 @@ class ConsensusService:
         executor: Optional[SweepExecutor] = None,
         max_body_bytes: int = _DEFAULT_MAX_BODY,
         job_checkpoints: bool = True,
+        quarantine_after: int = 3,
+        watchdog: bool = False,
+        wedge_floor: float = 30.0,
+        wedge_scale: float = 8.0,
+        wedge_compile_grace: float = 600.0,
+        shed_policy: Optional[ShedPolicy] = None,
+        memory_budget_bytes: Optional[int] = None,
     ):
         self.store = JobStore(store_dir)
         self.events = EventLog(events_path)
@@ -166,6 +210,13 @@ class ConsensusService:
             backoff_base=backoff_base,
             events=self.events,
             checkpoints=job_checkpoints,
+            quarantine_after=quarantine_after,
+            watchdog=watchdog,
+            wedge_floor=wedge_floor,
+            wedge_scale=wedge_scale,
+            wedge_compile_grace=wedge_compile_grace,
+            shed_policy=shed_policy,
+            memory_budget_bytes=memory_budget_bytes,
         )
         self.max_body_bytes = max_body_bytes
         self.started_at = time.time()

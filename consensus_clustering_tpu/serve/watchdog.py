@@ -1,0 +1,161 @@
+"""Hang watchdog primitives: liveness heartbeats and the wedge verdict.
+
+The failure mode this closes is the one this environment actually
+produces: rounds 2-5 logged 10 h and 22 h backend wedges
+(``benchmarks/onchip_followup_r0{4,5}/session.log``) — the process
+lives, the HTTP surface answers, and the job thread is silently stuck
+inside a device call that will never return.  Timeouts don't cover it
+(a wedged 10-minute job under a 2-hour budget burns 2 hours), and
+retries never trigger (nothing raises).
+
+The design rides on a signal the streaming engine already emits: every
+evaluated H-block fires ``h_block_complete``.  The executor turns those
+firings into heartbeats on a :class:`Heartbeat`, and the scheduler's
+supervising wait loop (it already owns a per-job thread for timeouts)
+declares the job *wedged* when the heartbeat goes silent past a
+deadline scaled from the bucket's observed/calibrated block time —
+``max(floor, scale × expected_block_seconds)``, with a separate grace
+for the pre-first-block phase (engine build + XLA compile).  A wedged
+job is treated exactly like a retryable failure: the thread is
+abandoned (its late events are generation-cancelled), the attempt is
+triaged ``wedged:<point>``, and the retry resumes from the checkpoint
+ring — the wedge costs one deadline, not the job.
+
+:func:`await_backend_init` is the startup twin: backend/device-plugin
+initialisation runs on a bounded thread so a wedged tunnel fails the
+process fast with a named error instead of hanging it forever before it
+ever binds a port (the exact r02-r05 `backend init hung` shape).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+#: Heartbeat label for the pre-execution phase (engine build + compile +
+#: block-size resolution).  Everything after it is ``block:<i>``.
+PHASE_START = "start"
+PHASE_ENGINE_READY = "engine_ready"
+
+
+class JobWedged(Exception):
+    """A running job's heartbeat went silent past its deadline.
+
+    ``point`` is the last heartbeat label (``start`` /
+    ``engine_ready`` / ``block:<i>``): where the execution wedged.
+    Triaged as retryable with reason ``wedged:<point>`` — the retry
+    resumes from the checkpoint ring.
+    """
+
+    def __init__(self, point: str, silent_seconds: float, deadline: float):
+        self.point = point
+        self.silent_seconds = silent_seconds
+        self.deadline = deadline
+        super().__init__(
+            f"no liveness heartbeat for {silent_seconds:.1f}s "
+            f"(deadline {deadline:.1f}s) — job wedged at {point}"
+        )
+
+    @property
+    def reason(self) -> str:
+        """The triage label (``retry_total``/event ``reason`` field)."""
+        return f"wedged:{self.point}"
+
+
+class Heartbeat:
+    """Thread-safe (monotonic timestamp, label) liveness marker.
+
+    One per job *attempt*: the executor beats it at the phase
+    transitions it owns (engine ready) and on every evaluated block;
+    the scheduler's supervisor reads ``silent_seconds``/``phase`` to
+    decide wedged-or-not.  Cheap on the hot path — one lock, two
+    assignments per block.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._at = time.monotonic()
+        self._label = PHASE_START
+
+    def beat(self, label: str) -> None:
+        with self._lock:
+            self._at = time.monotonic()
+            self._label = label
+
+    def read(self) -> Tuple[float, str]:
+        """(seconds since last beat, label of that beat)."""
+        with self._lock:
+            return time.monotonic() - self._at, self._label
+
+
+def wedge_deadline(
+    phase: str,
+    expected_block_seconds: Optional[float],
+    *,
+    floor: float,
+    scale: float,
+    compile_grace: float,
+) -> float:
+    """Allowed heartbeat silence for ``phase``.
+
+    Before the engine is ready (``start``) the compile grace applies —
+    an XLA compile is legitimately minutes of silence.  From
+    ``engine_ready`` on, the deadline follows the bucket's block time:
+    ``max(floor, scale × expected)`` when an expectation exists
+    (observed EWMA from this process's own blocks, else the calibrated
+    record's rate), just ``floor`` when the bucket is cold — the floor
+    is the operator's "no block is ever slower than this" knob.
+    """
+    if phase == PHASE_START:
+        return max(compile_grace, floor)
+    if expected_block_seconds is not None and expected_block_seconds > 0:
+        return max(floor, scale * expected_block_seconds)
+    return floor
+
+
+class BackendInitTimeout(RuntimeError):
+    """Backend/device-plugin initialisation exceeded its startup bound."""
+
+
+def await_backend_init(
+    init_fn: Callable[[], object], timeout: float
+) -> object:
+    """Run ``init_fn`` (e.g. ``executor.backend``) on a bounded thread.
+
+    Returns its result, re-raises its exception, or raises
+    :class:`BackendInitTimeout` after ``timeout`` seconds — at which
+    point the init thread is abandoned (daemon: it dies with the
+    process; there is nothing else to do with a wedged device plugin).
+    ``timeout <= 0`` disables the bound and calls inline.
+
+    This is the r02-r05 failure made fast: a wedged TPU tunnel used to
+    hang the serving process forever *before it bound a port*, which no
+    liveness probe can distinguish from a slow start.  Now it exits
+    non-zero with a named error inside the bound.
+    """
+    if timeout <= 0:
+        return init_fn()
+    box: dict = {}
+
+    def _target():
+        try:
+            box["result"] = init_fn()
+        except BaseException as e:  # noqa: BLE001 — reraised below
+            box["error"] = e
+
+    t = threading.Thread(
+        target=_target, name="backend-init", daemon=True
+    )
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise BackendInitTimeout(
+            f"backend initialisation still hung after {timeout:.0f}s — "
+            "a wedged device plugin/tunnel (the r02-r05 failure). "
+            "Fix the device stack, raise --backend-init-timeout, or "
+            "serve on the CPU fallback with JAX_PLATFORMS=cpu."
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")

@@ -1,0 +1,818 @@
+"""The hostile path (docs/SERVING.md "Overload & wedge runbook"): hang
+watchdog, crash-loop quarantine, memory preflight, overload shedding.
+
+Fast lane: fault-grammar parsing, watchdog units and stub-driven wedge
+verdicts, quarantine state machine across successive reconciliations,
+preflight math and the 413/429 HTTP surfaces — nothing here compiles.
+Slow lane: the real streaming executor driven through injected hang and
+OOM faults, asserting retry-from-checkpoint with byte-identical
+fingerprints.  The process-scale version (scripted kills against a live
+service subprocess) is ``benchmarks/chaos_soak.py``, run by the
+``chaos-smoke`` CI job.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedFault,
+    InjectedOOM,
+    classify_error,
+    faults,
+)
+from consensus_clustering_tpu.serve import (
+    ConsensusService,
+    JobStore,
+    PreflightReject,
+    QueueShed,
+    Scheduler,
+    ShedPolicy,
+    SweepExecutor,
+    estimate_job_bytes,
+    parse_job_spec,
+)
+from consensus_clustering_tpu.serve.admin import (
+    quarantined_jobs,
+    release_job,
+)
+from consensus_clustering_tpu.serve.events import EventLog
+from consensus_clustering_tpu.serve.preflight import (
+    check_admission,
+    resolve_memory_budget,
+)
+from consensus_clustering_tpu.serve.watchdog import (
+    PHASE_ENGINE_READY,
+    PHASE_START,
+    BackendInitTimeout,
+    Heartbeat,
+    JobWedged,
+    await_backend_init,
+    wedge_deadline,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _spec(seed=23, priority=None, n=4, k=(2,)):
+    cfg = {"k": list(k), "iterations": 8, "seed": seed}
+    if priority is not None:
+        cfg["priority"] = priority
+    rng = np.random.default_rng(seed)
+    return parse_job_spec({"data": rng.normal(size=(n, 2)).tolist(),
+                           "config": cfg})
+
+
+def _wait(sched, job_id, budget=30.0,
+          terminal=("done", "failed", "timeout")):
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        cur = sched.get(job_id)
+        if cur["status"] in terminal:
+            return cur
+        time.sleep(0.02)
+    raise AssertionError(f"job still {cur['status']} after {budget}s")
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: hang[:seconds] and oom
+
+
+class TestFaultGrammar:
+    def test_hang_parses_fires_once_and_disarms(self):
+        fi = FaultInjector("block_start=2:hang:0.05")
+        t0 = time.monotonic()
+        with pytest.raises(InjectedFault, match="hang"):
+            fi.fire("block_start", 2)
+        assert time.monotonic() - t0 >= 0.05
+        fi.fire("block_start", 2)  # disarmed after firing
+        assert fi.fired == [("block_start", 2, "hang")]
+
+    def test_hang_default_duration_is_long(self):
+        import importlib
+
+        # importlib, not attribute-style import: the package re-exports
+        # the injector INSTANCE as `faults`, shadowing the submodule.
+        fmod = importlib.import_module(
+            "consensus_clustering_tpu.resilience.faults"
+        )
+        [rule] = fmod._parse_plan("p=0:hang")
+        assert rule.seconds == fmod._DEFAULT_HANG_SECONDS
+
+    def test_oom_fires_once_with_resource_exhausted_text(self):
+        fi = FaultInjector("block_start=1:oom")
+        with pytest.raises(InjectedOOM, match="RESOURCE_EXHAUSTED"):
+            fi.fire("block_start", 1)
+        fi.fire("block_start", 1)  # disarmed
+
+    def test_oom_triaged_like_a_real_device_oom(self):
+        exc = None
+        try:
+            FaultInjector("p=0:oom").fire("p", 0)
+        except InjectedOOM as e:
+            exc = e
+        assert classify_error(exc) == ("retryable", "oom")
+
+    def test_bad_hostile_specs_rejected(self):
+        for bad in (
+            "p=0:hang:-1",      # negative duration
+            "p=0:hang:soon",    # non-numeric duration
+            "p=0:oom:5",        # only hang takes an argument
+            "p=0:wedge",        # unknown action
+        ):
+            with pytest.raises(ValueError):
+                FaultInjector(bad)
+
+    def test_mixed_plan_with_legacy_actions(self):
+        fi = FaultInjector("a=0,b=1:kill,c=2:hang:0.01,d=3:oom")
+        assert fi.active()
+        with pytest.raises(InjectedFault):
+            fi.fire("a", 0)
+        with pytest.raises(InjectedFault):
+            fi.fire("c", 2)
+        with pytest.raises(InjectedOOM):
+            fi.fire("d", 3)
+
+
+# ---------------------------------------------------------------------------
+# Watchdog units
+
+
+class TestWatchdogUnits:
+    def test_wedge_deadline_phases(self):
+        kw = dict(floor=10.0, scale=4.0, compile_grace=300.0)
+        # Pre-first-beat: the compile grace governs.
+        assert wedge_deadline(PHASE_START, None, **kw) == 300.0
+        # Warm bucket: scale x expected, floored.
+        assert wedge_deadline("block:3", 5.0, **kw) == 20.0
+        assert wedge_deadline("block:3", 0.5, **kw) == 10.0
+        # Cold bucket after engine-ready: the floor alone.
+        assert wedge_deadline(PHASE_ENGINE_READY, None, **kw) == 10.0
+
+    def test_heartbeat_read_and_beat(self):
+        hb = Heartbeat()
+        silent, label = hb.read()
+        assert label == PHASE_START and silent < 1.0
+        hb.beat("block:7")
+        silent, label = hb.read()
+        assert label == "block:7" and silent < 1.0
+
+    def test_job_wedged_reason_label(self):
+        e = JobWedged("block:4", 12.5, 6.0)
+        assert e.reason == "wedged:block:4"
+        assert "12.5" in str(e)
+
+    def test_await_backend_init_passes_results_and_errors(self):
+        assert await_backend_init(lambda: "tpu", timeout=5.0) == "tpu"
+        assert await_backend_init(lambda: "cpu", timeout=0) == "cpu"
+
+        def boom():
+            raise RuntimeError("plugin exploded")
+
+        with pytest.raises(RuntimeError, match="plugin exploded"):
+            await_backend_init(boom, timeout=5.0)
+
+    def test_await_backend_init_bounds_a_wedged_init(self):
+        release = threading.Event()
+        t0 = time.monotonic()
+        with pytest.raises(BackendInitTimeout, match="wedged"):
+            await_backend_init(release.wait, timeout=0.2)
+        assert time.monotonic() - t0 < 5.0
+        release.set()
+
+
+class _WedgingStub:
+    """Streaming-shaped stub: first run beats once then goes silent
+    (the wedge), later runs complete — the retry-after-wedge script."""
+
+    default_h_block = 4  # duck-types as a streaming executor
+    run_count = 0
+    executable_cache_hits = 0
+
+    def __init__(self, wedge_runs=1, beat_before_wedge=True):
+        self._wedge_runs = wedge_runs
+        self._beat = beat_before_wedge
+        self._releases = []
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        # Wake every abandoned thread promptly (each attempt hangs on
+        # its OWN event — cancel must not leak into the next attempt).
+        while self._releases:
+            self._releases.pop().set()
+
+    def run(self, spec, x, progress_cb=None, block_cb=None,
+            checkpoint_dir=None, heartbeat=None):
+        self.run_count += 1
+        if self.run_count <= self._wedge_runs:
+            if self._beat and heartbeat is not None:
+                heartbeat.beat("block:0")
+            release = threading.Event()
+            self._releases.append(release)
+            release.wait(30.0)  # silent: no further beats
+            raise InjectedFault("abandoned attempt woke up")
+        return {"ok": True, "attempt": self.run_count}
+
+
+class TestWatchdogScheduler:
+    def _sched(self, tmp_path, ex, **kw):
+        defaults = dict(
+            max_retries=2, sleep=lambda _s: None, watchdog=True,
+            wedge_floor=0.2, wedge_scale=4.0, wedge_compile_grace=0.5,
+            wedge_poll=0.02,
+        )
+        defaults.update(kw)
+        return Scheduler(ex, JobStore(str(tmp_path)), **defaults)
+
+    def test_wedged_job_is_detected_and_retried(self, tmp_path):
+        events_path = str(tmp_path / "ev.jsonl")
+        ex = _WedgingStub()
+        sched = self._sched(
+            tmp_path / "store", ex, events=EventLog(events_path)
+        )
+        sched.start()
+        try:
+            spec, x = _spec()
+            t0 = time.monotonic()
+            rec = sched.submit(spec, x)
+            done = _wait(sched, rec["job_id"])
+            assert done["status"] == "done"
+            assert done["result"]["attempt"] == 2
+            # Detection latency: inside 2x the 0.2s floor deadline plus
+            # scheduling slack — the acceptance bound at unit scale.
+            assert time.monotonic() - t0 < 10.0
+            m = sched.metrics()
+            assert m["jobs_wedged_total"] == 1
+            assert m["retry_total"] == {"wedged:block:0": 1}
+            with open(events_path) as f:
+                events = [json.loads(line) for line in f]
+            wedge = [e for e in events if e["event"] == "job_wedged"]
+            assert len(wedge) == 1
+            assert wedge[0]["point"] == "block:0"
+            assert (
+                wedge[0]["silent_seconds"]
+                <= 2 * wedge[0]["deadline_seconds"] + 1.0
+            )
+            retry = [e for e in events if e["event"] == "job_retry"]
+            assert retry and retry[0]["reason"] == "wedged:block:0"
+        finally:
+            sched.stop()
+
+    def test_wedge_before_first_beat_uses_compile_grace(self, tmp_path):
+        ex = _WedgingStub(beat_before_wedge=False)
+        sched = self._sched(tmp_path, ex)
+        sched.start()
+        try:
+            spec, x = _spec()
+            rec = sched.submit(spec, x)
+            done = _wait(sched, rec["job_id"])
+            assert done["status"] == "done"
+            assert sched.metrics()["retry_total"] == {"wedged:start": 1}
+        finally:
+            sched.stop()
+
+    def test_persistent_wedge_exhausts_retries_and_fails(self, tmp_path):
+        ex = _WedgingStub(wedge_runs=99)
+        sched = self._sched(tmp_path, ex, max_retries=1)
+        sched.start()
+        try:
+            spec, x = _spec()
+            rec = sched.submit(spec, x)
+            done = _wait(sched, rec["job_id"])
+            assert done["status"] == "failed"
+            assert "wedged" in done["error"]
+            assert sched.metrics()["jobs_wedged_total"] == 2
+        finally:
+            sched.stop()
+
+    def test_watchdog_off_leaves_stub_executors_alone(self, tmp_path):
+        # Stubs without streaming plumbing must never be wedge-judged
+        # (no heartbeat exists to read).
+        class _Plain:
+            run_count = 0
+            executable_cache_hits = 0
+
+            def backend(self):
+                return "cpu-fallback"
+
+            def cancel_events(self):
+                pass
+
+            def run(self, spec, x, progress_cb=None):
+                time.sleep(0.3)  # longer than the wedge floor
+                return {"ok": True}
+
+        sched = self._sched(tmp_path, _Plain(), wedge_floor=0.05)
+        sched.start()
+        try:
+            rec = sched.submit(*_spec())
+            done = _wait(sched, rec["job_id"])
+            assert done["status"] == "done"
+            assert sched.metrics()["jobs_wedged_total"] == 0
+        finally:
+            sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Crash-loop quarantine
+
+
+class _NeverRuns:
+    run_count = 0
+    executable_cache_hits = 0
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, *a, **k):
+        raise AssertionError("reconciliation test: worker must not run")
+
+
+def _orphan(store, job_id="poison1", seed=23):
+    spec, x = _spec(seed=seed)
+    fp = store.fingerprint(spec.fingerprint_payload(), x)
+    store.save_job({
+        "job_id": job_id, "status": "running", "fingerprint": fp,
+        "attempt": 0,
+    })
+    store.save_payload(job_id, spec.fingerprint_payload(), x)
+    return spec, x, fp
+
+
+class TestQuarantine:
+    def test_requeue_counter_survives_successive_reconciliations(
+        self, tmp_path
+    ):
+        """The satellite fix: the counter is persisted in the payload,
+        so TWO successive restart reconciliations count 1 then 2 —
+        a one-shot record flag would read 1 both times."""
+        store = JobStore(str(tmp_path))
+        _orphan(store)
+        for expected in (1, 2):
+            Scheduler(_NeverRuns(), store,
+                      quarantine_after=5)._reconcile_orphans()
+            record = store.load_job("poison1")
+            assert record["status"] == "queued"
+            assert record["restart_requeues"] == expected
+            assert record["requeued_after_restart"] is True
+            _, _, attempts = store.load_payload("poison1")
+            assert attempts == expected
+            # Simulate the next crash: the record is left mid-flight.
+            record["status"] = "running"
+            store.save_job(record)
+
+    def test_quarantined_at_cap_with_payload_and_ring_retained(
+        self, tmp_path, caplog
+    ):
+        store = JobStore(str(tmp_path))
+        _spec_obj, _x, fp = _orphan(store)
+        ring = store.checkpoint_dir(fp)
+        os.makedirs(ring, exist_ok=True)
+        (lambda p: open(p, "wb").write(b"gen"))(
+            os.path.join(ring, "gen-00000000.ckpt")
+        )
+        events_path = str(tmp_path / "ev.jsonl")
+        statuses = []
+        for _ in range(3):
+            sched = Scheduler(
+                _NeverRuns(), store, quarantine_after=2,
+                events=EventLog(events_path),
+            )
+            sched._reconcile_orphans()
+            record = store.load_job("poison1")
+            statuses.append(record["status"])
+            if record["status"] == "quarantined":
+                break
+            record["status"] = "running"
+            store.save_job(record)
+        assert statuses == ["queued", "queued", "quarantined"]
+        assert record["restart_requeues"] == 2  # exactly the cap
+        assert "serve-admin" in record["error"]
+        # The contract: poison artefacts retained for offline debugging.
+        assert store.load_payload("poison1") is not None
+        assert os.path.exists(ring)
+        assert sched.jobs_quarantined == 1
+        with open(events_path) as f:
+            events = [json.loads(line) for line in f]
+        q = [e for e in events if e["event"] == "job_quarantined"]
+        assert len(q) == 1 and q[0]["restarts"] == 2
+        # A quarantined job is TERMINAL for reconciliation: one more
+        # restart must not touch it (that is the whole point).
+        Scheduler(_NeverRuns(), store,
+                  quarantine_after=2)._reconcile_orphans()
+        assert store.load_job("poison1")["status"] == "quarantined"
+
+    def test_quarantined_payload_survives_store_gc(self, tmp_path):
+        store = JobStore(str(tmp_path))
+        _orphan(store)
+        record = store.load_job("poison1")
+        record.update(status="quarantined")
+        store.save_job(record)
+        # Age the payload far past the GC grace window: a terminal
+        # failed/done job's payload would be swept, quarantined must not.
+        for name in os.listdir(store.payloads_dir):
+            path = os.path.join(store.payloads_dir, name)
+            past = time.time() - 10 * JobStore._TMP_GRACE_SECONDS
+            os.utime(path, (past, past))
+        JobStore(str(tmp_path))  # restart (runs the sweeps)
+        assert store.load_payload("poison1") is not None
+
+    def test_release_requeues_with_zeroed_counter(self, tmp_path):
+        store = JobStore(str(tmp_path))
+        spec, x, _fp = _orphan(store)
+        record = store.load_job("poison1")
+        record.update(status="quarantined", restart_requeues=2,
+                      quarantined_at=1.0, error="crash-looped")
+        store.save_job(record)
+        store.set_payload_attempts(
+            "poison1", spec.fingerprint_payload(), 2
+        )
+        # The admin tool reads/writes the store's files directly
+        # (stdlib-only, no JobStore import): this round trip against a
+        # JobStore-written store is the no-drift guarantee.
+        assert [r["job_id"] for r in quarantined_jobs(str(tmp_path))] == [
+            "poison1"
+        ]
+        released = release_job(str(tmp_path), "poison1")
+        assert released["status"] == "queued"
+        assert "error" not in released
+        _, _, attempts = store.load_payload("poison1")
+        assert attempts == 0
+        # The next service start runs it like any orphan.
+        class _Ok(_NeverRuns):
+            def run(self, spec, x, progress_cb=None):
+                self.run_count += 1
+                return {"ok": True}
+
+        sched = Scheduler(_Ok(), store, quarantine_after=2)
+        sched.start()
+        try:
+            done = _wait(sched, "poison1")
+            assert done["status"] == "done"
+            assert done["restart_requeues"] == 1
+        finally:
+            sched.stop()
+
+    def test_release_refuses_non_quarantined_and_unknown(self, tmp_path):
+        store = JobStore(str(tmp_path))
+        store.save_job({"job_id": "livejob1", "status": "running"})
+        with pytest.raises(ValueError, match="not quarantined"):
+            release_job(str(tmp_path), "livejob1")
+        with pytest.raises(KeyError):
+            release_job(str(tmp_path), "nosuchjob")
+        # Quarantined but payload externally deleted: refuse, don't
+        # enqueue a job that can never run.
+        store.save_job({"job_id": "bare1", "status": "quarantined"})
+        with pytest.raises(ValueError, match="payload"):
+            release_job(str(tmp_path), "bare1")
+
+    def test_pre_envelope_payloads_load_with_zero_attempts(self, tmp_path):
+        """Back-compat: a payload written by the pre-quarantine store
+        (plain spec dict, no envelope) must still reconcile — counting
+        restarts from now."""
+        store = JobStore(str(tmp_path))
+        spec, x = _spec()
+        store.save_payload("oldjob1", spec.fingerprint_payload(), x)
+        json_path, _ = store._payload_paths("oldjob1")
+        with open(json_path, "w") as f:  # rewrite in the OLD format
+            json.dump(spec.fingerprint_payload(), f)
+        payload, x2, attempts = store.load_payload("oldjob1")
+        assert attempts == 0
+        from consensus_clustering_tpu.serve import JobSpec
+
+        assert JobSpec.from_payload(payload) == spec
+        np.testing.assert_array_equal(x2, x)
+
+    def test_serve_admin_cli_is_wired(self, tmp_path, capsys):
+        from consensus_clustering_tpu.cli import main
+
+        JobStore(str(tmp_path))
+        with pytest.raises(SystemExit) as exc:
+            main(["serve-admin", "--store-dir", str(tmp_path), "list"])
+        assert exc.value.code == 0
+        assert "no quarantined jobs" in capsys.readouterr().out
+
+    def test_serve_admin_never_imports_jax(self, tmp_path):
+        """serve-admin exists for the moments the device stack is
+        wedged: it must not import — let alone initialise — jax (the
+        same ``-X importtime`` pin the lint subcommand carries)."""
+        import subprocess
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [_sys.executable, "-X", "importtime", "-m",
+             "consensus_clustering_tpu", "serve-admin",
+             "--store-dir", str(tmp_path), "list"],
+            capture_output=True, text=True, cwd=repo, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "no quarantined jobs" in proc.stdout
+        imported = {
+            line.split("|")[-1].strip()
+            for line in proc.stderr.splitlines()
+            if line.startswith("import time:")
+        }
+        assert "jax" not in imported, "serve-admin imported jax"
+        assert "numpy" not in imported, "serve-admin imported numpy"
+
+
+# ---------------------------------------------------------------------------
+# Memory preflight
+
+
+class TestPreflight:
+    def test_estimate_monotonic_in_n_k_and_block(self):
+        base = estimate_job_bytes(500, 8, (2, 3))["total_bytes"]
+        assert estimate_job_bytes(1000, 8, (2, 3))["total_bytes"] > base
+        assert (
+            estimate_job_bytes(500, 8, (2, 3, 4, 5))["total_bytes"] > base
+        )
+        assert (
+            estimate_job_bytes(500, 8, (2, 3), h_block=64)["total_bytes"]
+            > base
+        )
+
+    def test_estimate_leading_term_is_exact_accumulator_bytes(self):
+        est = estimate_job_bytes(1000, 8, (2, 3, 4))
+        assert est["state_bytes"] == 4 * (3 + 1) * 1000 * 1000
+        # Checkpointing pins extra generations; off drops the factor.
+        off = estimate_job_bytes(1000, 8, (2, 3, 4), checkpoints=False)
+        assert off["pinned_state_generations"] == 1
+        assert off["total_bytes"] < est["total_bytes"]
+
+    def test_check_admission_payload_shape(self):
+        est = estimate_job_bytes(1000, 8, (2, 3, 4))
+        check_admission(est, est["total_bytes"], (1000, 8))  # at budget: ok
+        with pytest.raises(PreflightReject) as exc:
+            check_admission(est, est["total_bytes"] - 1, (1000, 8))
+        payload = exc.value.payload
+        assert payload["estimated_bytes"] == est["total_bytes"]
+        assert payload["budget_bytes"] == est["total_bytes"] - 1
+        assert "hint" in payload and "estimate" in payload
+
+    def test_resolve_budget_precedence(self, monkeypatch):
+        assert resolve_memory_budget(12345) == 12345
+        assert resolve_memory_budget(0) is None  # explicit off
+        monkeypatch.setenv("CCTPU_MEMORY_BUDGET", "777")
+        assert resolve_memory_budget() == 777
+        monkeypatch.setenv("CCTPU_MEMORY_BUDGET", "not-bytes")
+        budget = resolve_memory_budget()  # falls through, never raises
+        assert budget is None or budget > 0
+
+    def test_scheduler_rejects_and_counts(self, tmp_path):
+        class _Plain(_NeverRuns):
+            pass
+
+        sched = Scheduler(
+            _Plain(), JobStore(str(tmp_path)),
+            memory_budget_bytes=1_000_000,
+        )
+        spec, x = _spec(n=200, k=(2, 3, 4))
+        with pytest.raises(PreflightReject):
+            sched.submit(spec, x)
+        assert sched.metrics()["preflight_rejects_total"] == 1
+        # Nothing persisted for a rejected job: no record, no payload.
+        assert list(sched.store.iter_jobs()) == []
+
+    def test_cached_result_served_even_over_budget(self, tmp_path):
+        # Dedup outranks preflight: a stored result costs one disk
+        # read, not an OOM.
+        store = JobStore(str(tmp_path))
+        spec, x = _spec(n=200, k=(2, 3, 4))
+        fp = store.fingerprint(spec.fingerprint_payload(), x)
+        store.put_result(fp, {"best_k": 2})
+        sched = Scheduler(_NeverRuns(), store, memory_budget_bytes=1)
+        record = sched.submit(spec, x)
+        assert record["status"] == "done" and record["from_cache"]
+
+
+# ---------------------------------------------------------------------------
+# Overload shedding
+
+
+class TestShedPolicy:
+    def test_decide_matrix(self):
+        p = ShedPolicy(low_frac=0.5, normal_frac=0.75, wedge_threshold=3)
+        assert p.decide("high", 16, 16, 99) is None  # high never shed
+        assert p.decide("low", 7, 16, 0) is None     # below watermark
+        assert "low watermark" in p.decide("low", 8, 16, 0)
+        assert p.decide("normal", 11, 16, 0) is None
+        assert "normal watermark" in p.decide("normal", 12, 16, 0)
+        assert "wedge storm" in p.decide("low", 0, 16, 3)
+        assert p.decide("normal", 0, 16, 3) is None  # storms shed low only
+        # capacity <= 0 = unbounded queue (--queue-size 0): no fraction
+        # to be "at" — depth never sheds, only a wedge storm does.
+        assert p.decide("low", 50, 0, 0) is None
+        assert p.decide("normal", 50, 0, 0) is None
+        assert "wedge storm" in p.decide("low", 50, 0, 3)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ShedPolicy(low_frac=0.9, normal_frac=0.5)
+        with pytest.raises(ValueError):
+            ShedPolicy(low_frac=0.0)
+
+    def test_priority_excluded_from_fingerprint_and_bucket(self):
+        low, x = _spec(priority="low")
+        high, _ = _spec(priority="high")
+        assert low.fingerprint_payload() == high.fingerprint_payload()
+        n, d = x.shape
+        assert low.bucket(n, d, 8) == high.bucket(n, d, 8)
+
+    def test_scheduler_sheds_and_counts(self, tmp_path):
+        sched = Scheduler(
+            _NeverRuns(), JobStore(str(tmp_path)),
+            shed_policy=ShedPolicy(wedge_threshold=0),  # storm always on
+        )
+        spec, x = _spec(priority="low")
+        with pytest.raises(QueueShed) as exc:
+            sched.submit(spec, x)
+        assert exc.value.priority == "low"
+        assert exc.value.retry_after == 15.0
+        m = sched.metrics()
+        assert m["jobs_shed_total"] == {"high": 0, "normal": 0, "low": 1}
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: structured 413, shed 429 + Retry-After, priority 400
+
+
+def _http(base, path, body=None):
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+class _OkStub:
+    run_count = 0
+    executable_cache_hits = 0
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, spec, x, progress_cb=None):
+        self.run_count += 1
+        return {"ok": True}
+
+
+class TestHttpSurfaces:
+    def test_preflight_413_is_structured_and_shed_429_has_retry_after(
+        self, tmp_path
+    ):
+        svc = ConsensusService(
+            store_dir=str(tmp_path / "store"), port=0,
+            executor=_OkStub(),
+            memory_budget_bytes=1_000_000,
+            shed_policy=ShedPolicy(wedge_threshold=0, retry_after=7),
+        ).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            rng = np.random.default_rng(0)
+            big = {
+                "data": rng.normal(size=(300, 3)).tolist(),
+                "config": {"k": [2, 3, 4]},
+            }
+            code, payload, _ = _http(base, "/jobs", big)
+            assert code == 413
+            assert payload["estimated_bytes"] > payload["budget_bytes"]
+            assert "hint" in payload
+
+            small_low = {
+                "data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
+                "config": {"k": [2], "priority": "low"},
+            }
+            code, payload, headers = _http(base, "/jobs", small_low)
+            assert code == 429
+            assert payload["shed"] is True
+            assert headers.get("Retry-After") == "7"
+
+            small_high = {
+                "data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
+                "config": {"k": [2], "priority": "high"},
+            }
+            code, record, _ = _http(base, "/jobs", small_high)
+            assert code == 202
+            assert record["priority"] == "high"
+
+            code, m, _ = _http(base, "/metrics")
+            assert m["preflight_rejects_total"] == 1
+            assert m["jobs_shed_total"]["low"] == 1
+            assert m["jobs_wedged_total"] == 0
+            assert m["jobs_quarantined"] == 0
+            assert m["memory_budget_bytes"] == 1_000_000
+        finally:
+            svc.stop()
+
+    def test_bad_priority_is_a_400(self, tmp_path):
+        svc = ConsensusService(
+            store_dir=str(tmp_path / "store"), port=0, executor=_OkStub(),
+        ).start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            code, payload, _ = _http(base, "/jobs", {
+                "data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0]],
+                "config": {"k": [2], "priority": "urgent"},
+            })
+            assert code == 400
+            assert "priority" in payload["error"]
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Slow lane: the real streaming executor through hang and oom faults
+
+
+@pytest.mark.slow
+def test_injected_hang_and_oom_resume_bit_identically(tmp_path):
+    """In-process acceptance: one warm executor, two hostile jobs —
+    a hang (watchdog wedge verdict → retry) and an OOM (triage → retry)
+    — both finish byte-identical to their uninterrupted runs, resuming
+    from the checkpoint ring.  The process-scale twin (SIGKILLs against
+    a live service) is benchmarks/chaos_soak.py."""
+    rng = np.random.default_rng(5)
+    x = np.concatenate([
+        rng.normal(0.0, 0.4, (12, 3)), rng.normal(3.0, 0.4, (12, 3)),
+    ])
+
+    def body(seed):
+        return {
+            "data": x.tolist(),
+            "config": {"k": [2], "iterations": 12, "seed": seed,
+                       "stream_h_block": 4},
+        }
+
+    ex = SweepExecutor(use_compilation_cache=False)
+    sched = Scheduler(
+        ex, JobStore(str(tmp_path / "store")), max_retries=2,
+        sleep=lambda _s: None, watchdog=True, wedge_floor=1.0,
+        wedge_scale=4.0, wedge_compile_grace=120.0, wedge_poll=0.05,
+    )
+    sched.start()
+    try:
+        # Hang at block 2: blocks 0-1 complete (EWMA seeded), then the
+        # thread goes silent; the watchdog wedges and the retry resumes.
+        faults.configure("block_start=2:hang:600")
+        spec, xp = parse_job_spec(body(9))
+        rec = sched.submit(spec, xp)
+        done = _wait(sched, rec["job_id"], budget=120)
+        assert done["status"] == "done"
+        m = sched.metrics()
+        assert m["jobs_wedged_total"] == 1
+        [(reason, count)] = [
+            (r, c) for r, c in m["retry_total"].items()
+            if r.startswith("wedged:")
+        ]
+        assert count == 1
+        assert done["result"]["resumed_from_block"] > 0
+        ref = ex.run(spec, xp)
+        assert (
+            ref["result_fingerprint"]
+            == done["result"]["result_fingerprint"]
+        )
+
+        # OOM at block 2 of a different seed: classify_error triage,
+        # not the watchdog, drives this retry.
+        faults.configure("block_start=2:oom")
+        spec2, xp2 = parse_job_spec(body(10))
+        rec2 = sched.submit(spec2, xp2)
+        done2 = _wait(sched, rec2["job_id"], budget=120)
+        assert done2["status"] == "done"
+        assert sched.metrics()["retry_total"].get("oom") == 1
+        assert done2["result"]["resumed_from_block"] > 0
+        ref2 = ex.run(spec2, xp2)
+        assert (
+            ref2["result_fingerprint"]
+            == done2["result"]["result_fingerprint"]
+        )
+    finally:
+        faults.clear()
+        sched.stop()

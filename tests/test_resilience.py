@@ -724,10 +724,16 @@ class TestServeCrashResume:
         store = JobStore(str(tmp_path))
         spec, x = parse_job_spec(_serve_body(k=(2, 3), seed=77))
         store.save_payload("abc123", spec.fingerprint_payload(), x)
-        payload, x2 = store.load_payload("abc123")
+        payload, x2, attempts = store.load_payload("abc123")
         assert JobSpec.from_payload(payload) == spec
+        assert attempts == 0
         np.testing.assert_array_equal(x2, x)
         assert x2.dtype == x.dtype
+        # The restart counter persists independently of the matrix.
+        store.set_payload_attempts("abc123", payload, 3)
+        _, x3, attempts = store.load_payload("abc123")
+        assert attempts == 3
+        np.testing.assert_array_equal(x3, x)
         # The rebuilt spec fingerprints identically — the re-queued job
         # keeps its dedup/checkpoint identity.
         assert store.fingerprint(

@@ -166,11 +166,15 @@ def test_metrics_schema(base):
         "jobs_retried", "jobs_timed_out", "jobs_requeued", "cache_hits",
         "executable_cache_hits", "sweeps_executed", "backend",
         "checkpoint_writes_total", "checkpoint_resume_total", "retry_total",
-        "autotune_provenance_total",
+        "autotune_provenance_total", "jobs_wedged_total",
+        "jobs_quarantined", "jobs_shed_total", "preflight_rejects_total",
     ):
         assert field in m, field
     assert isinstance(m["retry_total"], dict)
     assert isinstance(m["autotune_provenance_total"], dict)
+    # Pre-seeded with every priority at construction (the dict-copy-
+    # races-first-insert class): the key set never changes.
+    assert set(m["jobs_shed_total"]) == {"high", "normal", "low"}
 
 
 def test_events_jsonl_lifecycle(base, service):
