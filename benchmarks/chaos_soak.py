@@ -26,12 +26,22 @@ asserting the invariants the hostile path must hold:
   complete unharmed;
 - **overload shedding** (full schedule) — under queue pressure,
   low-priority admissions get 429 + Retry-After while high-priority
-  still lands.
+  still lands;
+- **zero silent corruptions** (corrupt schedule) — an injected
+  accumulator bitflip is detected by the integrity sentinel within
+  the check cadence, emitted as ``integrity_violation``, retried with
+  reason ``corrupt:accumulator``, and finishes byte-identical to the
+  uninterrupted oracle; an injected checkpoint-state bitflip (a
+  CRC-valid frame whose content lies) is REFUSED at resume — counted
+  in ``checkpoint_verify_rejects_total`` — and recovery replays from
+  the last *verified* generation, again byte-identically.
 
 Schedules::
 
     python benchmarks/chaos_soak.py --schedule smoke   # kill + hang (CI)
-    python benchmarks/chaos_soak.py --schedule full    # + oom, preflight, flood
+    python benchmarks/chaos_soak.py --schedule corrupt # bitflip defense (CI)
+    python benchmarks/chaos_soak.py --schedule full    # everything above
+                                                       # + oom, preflight, flood
 
 Prints a JSON report; exits non-zero on any violation.  CPU-pinned
 (``JAX_PLATFORMS=cpu``) like every CI harness — the chaos being soaked
@@ -454,6 +464,155 @@ def phase_hang(root, report, refs):
         svc.stop()
 
 
+def phase_corrupt_accumulator(root, report, refs):
+    """An injected HBM bitflip in the device accumulators must be
+    DETECTED by the integrity sentinel (within the check cadence),
+    surfaced (event + counters), retried as ``corrupt:accumulator``
+    from the checkpoint ring, and finish byte-identical to the
+    uninterrupted oracle — never completed silently with corrupt
+    state."""
+    store = os.path.join(root, "corrupt_acc_store")
+    events_path = os.path.join(root, "corrupt_acc_events.jsonl")
+    # The SHIPPED default cadence (--integrity-every 4), not a
+    # test-friendly 1: the fault at block 2 is detected at the next
+    # due block (3), which also exercises the ring-retention sizing —
+    # generation 2 was checkpointed from corrupt state before
+    # detection, and the retry must land on the clean generation
+    # behind it, not restart from zero.
+    fault_block, every = 2, 4
+    body = _body(707, n=48, d=3, iters=24)
+    svc = ServiceProc(
+        store,
+        env_faults=f"accumulator={fault_block}:bitflip",
+        events_path=events_path,
+    )
+    try:
+        _, rec, _ = svc.post("/jobs", body)
+        record = svc.poll_job(rec["job_id"])
+        if record["status"] != "done":
+            raise Violation(
+                f"bitflipped job ended {record['status']}: "
+                f"{record.get('error')}"
+            )
+        hits = [e for e in _events(events_path)
+                if e["event"] == "integrity_violation"]
+        if not hits:
+            raise Violation(
+                "no integrity_violation event — the bitflip went "
+                "UNDETECTED (a silent corruption)"
+            )
+        hit = hits[0]
+        if hit["point"] != "accumulator":
+            raise Violation(f"violation at {hit['point']}, expected "
+                            "accumulator")
+        if hit["block"] - fault_block > every:
+            raise Violation(
+                f"detected at block {hit['block']}, over "
+                f"{every} block(s) past the corruption at "
+                f"{fault_block} — the cadence bound failed"
+            )
+        metrics = svc.get("/metrics")
+        if metrics["integrity_violations_total"].get("accumulator", 0) < 1:
+            raise Violation("integrity_violations_total not counted")
+        if metrics["integrity_checks_total"] < 1:
+            raise Violation("integrity_checks_total not counted")
+        if metrics["retry_total"].get("corrupt:accumulator", 0) < 1:
+            raise Violation(
+                "corrupt:accumulator retry not counted — the corrupt "
+                "state was not abandoned"
+            )
+        if record["result"]["result_fingerprint"] != refs["corrupt_acc"]:
+            raise Violation(
+                "post-corruption fingerprint differs from the "
+                "uninterrupted oracle"
+            )
+        resumed = record["result"]["resumed_from_block"]
+        if resumed != fault_block:
+            raise Violation(
+                f"retry resumed from block {resumed}, expected "
+                f"{fault_block}: the generations written from corrupt "
+                "state during the detection lag were not refused "
+                "(or the ring no longer reached a clean one)"
+            )
+        report["corrupt_accumulator"] = {
+            "detected_block": hit["block"],
+            "fault_block": fault_block,
+            "details": hit["details"],
+            "integrity_checks_total": metrics["integrity_checks_total"],
+            "retry_total": metrics["retry_total"],
+            "resumed_from_block": record["result"]["resumed_from_block"],
+            "fingerprint_parity": True,
+        }
+    finally:
+        svc.stop()
+
+
+def phase_corrupt_checkpoint(root, report, refs):
+    """A checkpoint generation corrupted AFTER its semantic digest was
+    taken (CRC-valid, fully readable, content lies) must be REFUSED at
+    resume: the service is killed right after the poisoned generation
+    lands, and the restart must fall back to the previous VERIFIED
+    generation and finish byte-identically."""
+    store = os.path.join(root, "corrupt_ckpt_store")
+    gen = 5
+    body = _body(708, n=160, d=5, iters=160)
+    # Deterministic kill window: die on the writer thread immediately
+    # after the corrupted generation is renamed into place — the ring
+    # then holds valid gens plus the poisoned newest one.
+    svc = ServiceProc(
+        store,
+        env_faults=(
+            f"checkpoint_payload={gen}:bitflip,"
+            f"checkpoint_post_write={gen}:kill"
+        ),
+    )
+    try:
+        _, rec, _ = svc.post("/jobs", body)
+        job_id = rec["job_id"]
+        rc = svc.wait_dead()
+        if rc != _KILL_EXIT:
+            raise Violation(f"kill-after-gen-{gen} exited {rc}, "
+                            "expected 137")
+    finally:
+        svc.stop()
+
+    svc2 = ServiceProc(store)  # no faults armed on the relaunch
+    try:
+        record = svc2.poll_job(job_id)
+        if record["status"] != "done":
+            raise Violation(
+                f"corrupt-checkpoint job ended {record['status']}: "
+                f"{record.get('error')}"
+            )
+        metrics = svc2.get("/metrics")
+        if metrics["checkpoint_verify_rejects_total"] < 1:
+            raise Violation(
+                "checkpoint_verify_rejects_total == 0 — the corrupt "
+                "generation was RESUMED, not refused"
+            )
+        resumed = record["result"]["resumed_from_block"]
+        if resumed != gen:
+            raise Violation(
+                f"resumed_from_block={resumed}, expected {gen} "
+                f"(fallback to gen {gen - 1}); {gen + 1} would mean "
+                "the poisoned generation was trusted"
+            )
+        if record["result"]["result_fingerprint"] != refs["corrupt_ckpt"]:
+            raise Violation(
+                "post-fallback fingerprint differs from the "
+                "uninterrupted oracle"
+            )
+        report["corrupt_checkpoint"] = {
+            "poisoned_generation": gen,
+            "verify_rejects_total":
+                metrics["checkpoint_verify_rejects_total"],
+            "resumed_from_block": resumed,
+            "fingerprint_parity": True,
+        }
+    finally:
+        svc2.stop()
+
+
 def phase_oom(root, report, refs):
     """An injected device-OOM is triaged retryable and the retry
     resumes from checkpoint, bit-identically."""
@@ -577,7 +736,9 @@ def phase_flood(root, report):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--schedule", choices=["smoke", "full"], default="smoke")
+    p.add_argument(
+        "--schedule", choices=["smoke", "corrupt", "full"], default="smoke"
+    )
     p.add_argument("--out", default=None, help="write the JSON report here")
     p.add_argument("--root", default=None,
                    help="work directory (default: a fresh temp dir)")
@@ -590,17 +751,35 @@ def main(argv=None):
 
     # The parity oracle: uninterrupted in-process runs, computed first
     # so a fingerprint mismatch is never confounded by harness state.
-    refs = _reference_fingerprints({
-        "kill": _body(101, n=160, d=5, iters=160),
-        "hang": _body(202, n=48, d=3, iters=24),
-        "oom": _body(404, n=48, d=3, iters=24),
-    })
+    ref_bodies = {}
+    if args.schedule in ("smoke", "full"):
+        ref_bodies.update({
+            "kill": _body(101, n=160, d=5, iters=160),
+            "hang": _body(202, n=48, d=3, iters=24),
+        })
+    if args.schedule in ("corrupt", "full"):
+        ref_bodies.update({
+            "corrupt_acc": _body(707, n=48, d=3, iters=24),
+            "corrupt_ckpt": _body(708, n=160, d=5, iters=160),
+        })
+    if args.schedule == "full":
+        ref_bodies["oom"] = _body(404, n=48, d=3, iters=24)
+    refs = _reference_fingerprints(ref_bodies)
 
-    phases = [
-        ("kill_resume", lambda: phase_kill_resume(root, report, refs)),
-        ("quarantine", lambda: phase_quarantine(root, report)),
-        ("hang", lambda: phase_hang(root, report, refs)),
-    ]
+    phases = []
+    if args.schedule in ("smoke", "full"):
+        phases += [
+            ("kill_resume", lambda: phase_kill_resume(root, report, refs)),
+            ("quarantine", lambda: phase_quarantine(root, report)),
+            ("hang", lambda: phase_hang(root, report, refs)),
+        ]
+    if args.schedule in ("corrupt", "full"):
+        phases += [
+            ("corrupt_accumulator",
+             lambda: phase_corrupt_accumulator(root, report, refs)),
+            ("corrupt_checkpoint",
+             lambda: phase_corrupt_checkpoint(root, report, refs)),
+        ]
     if args.schedule == "full":
         phases += [
             ("oom", lambda: phase_oom(root, report, refs)),

@@ -209,6 +209,14 @@ class ConsensusClustering:
     adaptive_patience, adaptive_min_h : keyword-only
         Early-stop patience (consecutive quiet blocks, default 2) and
         resample floor (default 0) — see ``SweepConfig``.
+    integrity_check_every : int, keyword-only
+        With ``stream_h_block``: run the accumulator invariant sentinel
+        (``resilience.integrity`` — ``0 <= Mij <= Iij <= h_seen``,
+        ``diag(Mij) == diag(Iij)``, sampled-row symmetry) every this
+        many streamed blocks; 0 (default) disables it.  A breach raises
+        ``IntegrityError`` instead of silently finishing with corrupt
+        counts (the HBM-bitflip class).  Pure observer: results and
+        checkpoint fingerprints are identical either way.
     autotune : bool, keyword-only
         Fill UNSET performance knobs (``cluster_batch``, ``split_init``,
         ``stream_h_block``, and the default KMeans clusterer's
@@ -280,6 +288,7 @@ class ConsensusClustering:
         adaptive_tol: Optional[float] = None,
         adaptive_patience: int = 2,
         adaptive_min_h: int = 0,
+        integrity_check_every: int = 0,
         autotune: bool = False,
         calibration_dir: Optional[str] = None,
     ):
@@ -356,6 +365,7 @@ class ConsensusClustering:
         self.adaptive_tol = adaptive_tol
         self.adaptive_patience = adaptive_patience
         self.adaptive_min_h = adaptive_min_h
+        self.integrity_check_every = integrity_check_every
         self.autotune = autotune
         self.calibration_dir = calibration_dir
         # Calibrated clusterer options (currently the default KMeans'
@@ -454,6 +464,18 @@ class ConsensusClustering:
         X = np.asarray(X)
         if X.ndim != 2:
             raise ValueError(f"X must be 2-D, got shape {X.shape}")
+        # Input admission (resilience.integrity, shared with serve):
+        # NaN is absorbing under the accumulation GEMMs — one poisoned
+        # cell silently zeroes whole count rows and skews every PAC —
+        # so inadmissible values fail HERE, with the offending indices,
+        # not as a wrong best_k_ after a full sweep.
+        from consensus_clustering_tpu.resilience.integrity import (
+            check_input_matrix,
+        )
+
+        problem = check_input_matrix(X)
+        if problem is not None:
+            raise ValueError(f"{problem['error']} — {problem['hint']}")
         n, d = X.shape
 
         if self.compute_consensus_labels and not self._resolve_store_matrices(n):
@@ -576,6 +598,7 @@ class ConsensusClustering:
             adaptive_tol=self.adaptive_tol,
             adaptive_patience=self.adaptive_patience,
             adaptive_min_h=self.adaptive_min_h,
+            integrity_check_every=self.integrity_check_every,
             use_pallas=self.use_pallas,
             dtype=self.compute_dtype,
         )

@@ -275,6 +275,7 @@ def cmd_serve(args):
         default_h_block=args.stream_block or None,
         checkpoint_every=args.checkpoint_every,
         calibration_store=calibration,
+        integrity_check_every=args.integrity_every,
     )
     # Bounded backend init BEFORE binding the port or reconciling jobs:
     # a wedged device plugin (the r02-r05 `backend init hung` failure)
@@ -524,6 +525,15 @@ def main(argv=None):
                          help="checkpoint the streamed block state every "
                          "N evaluated blocks (1 = every block; a "
                          "preemption loses at most N blocks of work)")
+    serve_p.add_argument("--integrity-every", type=int, default=4,
+                         help="run the accumulator integrity sentinel "
+                         "(0 <= Mij <= Iij <= h_seen, diagonal, "
+                         "sampled symmetry) every N evaluated blocks "
+                         "and at the final block; a breach is retried "
+                         "from the last VERIFIED checkpoint generation "
+                         "(corrupt:accumulator).  0 disables.  "
+                         "Default 4: measured within CPU session noise "
+                         "(benchmarks/integrity_overhead.py, PERF.md)")
     serve_p.add_argument("--no-job-checkpoints", action="store_true",
                          help="disable per-job block checkpointing "
                          "(payload persistence and restart re-queue "

@@ -135,6 +135,22 @@ class SweepConfig:
       adaptive_patience: consecutive sub-tolerance blocks required
         before stopping (default 2 — one quiet block can be luck).
       adaptive_min_h: resample floor before early stop may trigger.
+      integrity_check_every: run the accumulator invariant sentinel
+        (``resilience.integrity``: elementwise ``0 <= Mij <= Iij <=
+        h_seen``, ``diag(Mij) == diag(Iij)``, sampled-row symmetry)
+        every this many evaluated streaming blocks, plus the final
+        block — and every block when adaptive early stop is active
+        (any block can become the final one there); 0 (default)
+        disables it.  A breach raises
+        ``IntegrityError`` — triaged retryable (``corrupt:accumulator``)
+        by the serving scheduler, which retries from the last verified
+        checkpoint generation.  Streaming only (the monolithic program
+        exposes no mid-sweep state); an OBSERVER knob: it never changes
+        any count, so it is excluded from checkpoint fingerprints and
+        the serving executable bucket, and ``StreamingSweep.run`` can
+        override it per run.  The check is one fused pass over the
+        state per checked block (measured within CPU session noise at
+        every cadence — benchmarks/integrity_overhead.py, PERF.md).
       use_pallas: True forces the Pallas consensus-histogram kernel, False
         forces the XLA fallback, None picks by backend (Pallas on TPU).
       dtype: working float dtype for the data and the inner clusterers
@@ -165,6 +181,7 @@ class SweepConfig:
     adaptive_tol: Optional[float] = None
     adaptive_patience: int = 2
     adaptive_min_h: int = 0
+    integrity_check_every: int = 0
     use_pallas: Optional[bool] = None
     dtype: str = "float32"
 
@@ -220,6 +237,17 @@ class SweepConfig:
         if self.adaptive_min_h < 0:
             raise ValueError(
                 f"adaptive_min_h must be >= 0, got {self.adaptive_min_h}"
+            )
+        if (
+            isinstance(self.integrity_check_every, bool)
+            or not isinstance(
+                self.integrity_check_every, (int, np.integer)
+            )
+            or self.integrity_check_every < 0
+        ):
+            raise ValueError(
+                f"integrity_check_every must be an int >= 0 (0 = off), "
+                f"got {self.integrity_check_every!r}"
             )
         if not self.k_values:
             raise ValueError("k_values must be non-empty")

@@ -100,7 +100,13 @@ from consensus_clustering_tpu.parallel.sweep import (
     shard_map,
     sweep_geometry,
 )
-from consensus_clustering_tpu.resilience.faults import faults
+from consensus_clustering_tpu.resilience.faults import IntegrityError, faults
+from consensus_clustering_tpu.resilience.integrity import (
+    build_sentinel,
+    flip_array_bits,
+    sentinel_sample_rows,
+    verify_state_frame,
+)
 from consensus_clustering_tpu.utils.checkpoint import (
     data_fingerprint,
     stream_fingerprint,
@@ -389,6 +395,36 @@ class StreamingSweep:
         self._init = jax.jit(
             init_state_fn, out_shardings=dict(self._state_shardings)
         )
+        # The accumulator invariant sentinel (resilience.integrity),
+        # compiled lazily on the first checked block so runs with
+        # integrity_check_every=0 never pay its trace/compile.
+        self._sentinel = None
+
+    # -- integrity -------------------------------------------------------
+
+    def _integrity_stats(self, state, h_seen: int, block: int):
+        """Dispatch the invariant sentinel on ``state``; returns device
+        scalars (evaluated lazily by the driver, one block later, so
+        the check's compute overlaps the next in-flight block)."""
+        if self._sentinel is None:
+            self._sentinel = build_sentinel()
+        idx = sentinel_sample_rows(self.config.n_samples, block)
+        return self._sentinel(
+            state, jnp.int32(h_seen), jnp.asarray(idx)
+        )
+
+    def _flip_state_bits(self, state, nbits: int, block: int):
+        """Apply the ``accumulator`` bitflip fault: a deterministic
+        HBM-corruption stand-in (host round-trip of ``mij``, high bit
+        flipped, re-placed under the state sharding).  Test-path only —
+        reached when a fault plan armed the point, never otherwise."""
+        mij = np.array(state["mij"])
+        flip_array_bits(mij, nbits, seed=block)
+        corrupted = dict(state)
+        corrupted["mij"] = jax.device_put(
+            mij, self._state_shardings["mij"]
+        )
+        return corrupted
 
     # -- state -----------------------------------------------------------
 
@@ -435,6 +471,7 @@ class StreamingSweep:
         adaptive_patience: Optional[int] = None,
         adaptive_min_h: Optional[int] = None,
         checkpointer: Optional["StreamCheckpointer"] = None,
+        integrity_check_every: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Stream the sweep; returns host-side results + streaming stats.
 
@@ -467,6 +504,28 @@ class StreamingSweep:
         reconstruct every draw (tests/test_resilience.py asserts
         kill-and-resume parity against uninterrupted runs).
 
+        ``integrity_check_every`` (default: the build config's value;
+        0 = off) runs the accumulator invariant sentinel
+        (:mod:`~consensus_clustering_tpu.resilience.integrity`) on the
+        state after every that-many-th evaluated block, and always on
+        the final block — and on EVERY block when adaptive early stop
+        is active, because any block can turn out to be the final one
+        (the stop is decided one block later; a coarser cadence would
+        let an early-stopped run ship curves the sentinel never saw).  The check is dispatched right behind the block and its
+        scalars are pulled one block later, so it rides the pipeline;
+        a breach raises :class:`~consensus_clustering_tpu.resilience.
+        faults.IntegrityError` BEFORE the checked block's curves enter
+        the trajectory or its state enters the checkpoint ring.  At
+        cadence 1 the ring therefore never holds corrupt state; at
+        coarser cadences the unchecked blocks between corruption and
+        detection MAY have been checkpointed — which is why resume
+        accepts only generations that pass
+        :func:`~consensus_clustering_tpu.resilience.integrity.
+        verify_state_frame` (semantic digest + the same invariants):
+        those interim generations are refused, and the retry replays
+        from the last *verified* generation either way.  The two
+        layers compose; neither alone suffices.
+
         Overlap caveat: with state donation OFF (the CPU default —
         see the ``CCTPU_STREAM_DONATE`` note in the class docstring)
         the writer snapshots the still-device-resident state, so the
@@ -493,6 +552,14 @@ class StreamingSweep:
             adaptive_patience = config.adaptive_patience
         if adaptive_min_h is None:
             adaptive_min_h = config.adaptive_min_h
+        if integrity_check_every is None:
+            integrity_check_every = config.integrity_check_every
+        integrity_check_every = int(integrity_check_every)
+        if integrity_check_every < 0:
+            raise ValueError(
+                f"integrity_check_every must be >= 0, got "
+                f"{integrity_check_every}"
+            )
         adaptive = adaptive_tol is not None
         if adaptive and config.store_matrices:
             raise ValueError(
@@ -529,7 +596,12 @@ class StreamingSweep:
                 adaptive_min_h=adaptive_min_h,
             )
             ckpt_writes_before = checkpointer.writes_total
-            resume = checkpointer.latest(ckpt_fp)
+            # Verified resume: a generation must pass its semantic
+            # digest AND the accumulator invariants before its state is
+            # trusted — the ring falls back past CRC-valid frames whose
+            # content lies (resilience.integrity, docs/SERVING.md
+            # "Integrity runbook").
+            resume = checkpointer.latest(ckpt_fp, verify=verify_state_frame)
             if resume is not None:
                 header, arrays = resume
                 state = {
@@ -577,19 +649,59 @@ class StreamingSweep:
                 )
         if state is None:
             state = self.init_state()
-        pending = None  # (block, device curves, state snapshot) pending
+        integrity_checks = 0
+        # (block, device curves, state snapshot, sentinel scalars)
+        pending = None
 
         def h_done(b: int) -> int:
             return min((b + 1) * self._hb_pad, n_iterations)
 
-        def evaluate(b: int, curves, snap) -> bool:
+        def check_due(b: int) -> bool:
+            if integrity_check_every <= 0:
+                return False
+            # Under adaptive early stop ANY block can become the
+            # answer (the stop is decided one block after the fact),
+            # so the cadence collapses to every-block there — a stop
+            # at an unchecked block would otherwise ship curves the
+            # sentinel never saw.  The overhead A/B puts cadence 1
+            # within noise (PERF.md "Integrity sentinel").
+            if adaptive:
+                return True
+            return (
+                b % integrity_check_every == integrity_check_every - 1
+                or b == n_blocks - 1
+            )
+
+        def evaluate(b: int, curves, snap, check) -> bool:
             """Pull block b's curves to host; True when the run should
             stop early.  The np.asarray copy is the completion barrier —
             while it blocks, the next block already computes.  ``snap``
             (the exact accumulator state after block b, device- or
             host-resident) is handed to the checkpoint writer together
-            with the just-updated adaptive bookkeeping."""
+            with the just-updated adaptive bookkeeping.  ``check``
+            (the sentinel scalars dispatched on block b's state) is
+            judged FIRST: a corrupt block's curves must never enter the
+            trajectory and its state must never enter the ring."""
             nonlocal prev_pac, quiet, result_curves, h_effective
+            nonlocal integrity_checks
+            if check is not None:
+                integrity_checks += 1
+                bad = {
+                    name: int(v)
+                    for name, v in check.items()
+                    if int(v)
+                }
+                if bad:
+                    raise IntegrityError(
+                        "accumulator",
+                        f"integrity sentinel: block {b} state violates "
+                        f"the count invariants ({bad}) — corrupt "
+                        "accumulator (HBM bitflip class); retry from "
+                        "the last verified checkpoint",
+                        block=b,
+                        details=bad,
+                        checks_run=integrity_checks,
+                    )
             host = {
                 name: np.asarray(v) for name, v in curves.items()
             }
@@ -642,6 +754,24 @@ class StreamingSweep:
                 state, curves = self._step(
                     state, xj, key, jnp.int32(b * self._hb_pad), h_total
                 )
+                # Corruption fault point: a deterministic stand-in for
+                # an HBM bitflip in the device-resident accumulators,
+                # applied to block b's post-state.  Generations written
+                # between corruption and detection (possible at check
+                # cadences > 1) are refused by the resume-time
+                # verifier, so the retry replays from clean state
+                # either way.
+                nbits = faults.corrupt("accumulator", index=b)
+                if nbits:
+                    state = self._flip_state_bits(state, nbits, b)
+                # The sentinel is dispatched right behind the block and
+                # judged one block later (inside evaluate), so its
+                # compute overlaps the next in-flight block instead of
+                # stalling the pipeline.
+                check = (
+                    self._integrity_stats(state, h_done(b), b)
+                    if check_due(b) else None
+                )
                 if pending is not None and evaluate(*pending):
                     # Block b is the speculative in-flight dispatch; its
                     # state and curves never enter the answer — which is
@@ -677,9 +807,20 @@ class StreamingSweep:
                         # the writer thread, whose np.asarray waits
                         # off the driver's critical path.
                         snap = state
-                pending = (b, curves, snap)
+                pending = (b, curves, snap, check)
             if pending is not None:
                 evaluate(*pending)
+        except BaseException as e:
+            # Attach the sentinel accounting to WHATEVER ends this run
+            # (OOM, injected fault, runtime error — not just an
+            # IntegrityError, which carries checks_run already): the
+            # scheduler keeps integrity_checks_total honest for failed
+            # attempts, whose streaming stats never arrive.
+            try:
+                e.integrity_checks_run = integrity_checks
+            except Exception:  # noqa: BLE001 — accounting must never
+                pass  # mask the real failure (e.g. slotted exceptions)
+            raise
         finally:
             if checkpointer is not None:
                 # An injected fault / preemption-style abort must still
@@ -716,6 +857,11 @@ class StreamingSweep:
                 checkpointer.writes_total - ckpt_writes_before
                 if checkpointer is not None else 0
             ),
+            # Integrity accounting: sentinel evaluations this run (the
+            # /metrics integrity_checks_total feed) and the cadence
+            # they ran at (0 = the sentinel was off).
+            "integrity_checks": int(integrity_checks),
+            "integrity_check_every": int(integrity_check_every),
         }
         out["timing"] = {
             "run_seconds": run_seconds,

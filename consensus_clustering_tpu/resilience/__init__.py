@@ -15,6 +15,13 @@ package turns that property into crash recovery at BLOCK granularity:
 - :mod:`.faults`  — deterministic fault injection (env / programmatic)
   plus :func:`classify_error`, the retryable-vs-fatal triage the
   serving scheduler retries from checkpoint on.
+- :mod:`.integrity` — the silent-corruption defense: the accumulator
+  invariant sentinel the streaming driver runs every
+  ``integrity_check_every`` blocks, the semantic digest + invariant
+  verification that makes checkpoint resume trust only *verified*
+  generations, and the NaN/Inf/zero-variance input admission both the
+  api and serve share.  Driven by the ``bitflip`` fault action at the
+  ``accumulator`` / ``checkpoint_payload`` corruption points.
 
 Every recovery path here is exercised by tests/test_resilience.py via
 the fault hooks rather than trusted: raise at block *b*, die mid-write,
@@ -30,16 +37,28 @@ from consensus_clustering_tpu.resilience.faults import (
     FaultInjector,
     InjectedFault,
     InjectedOOM,
+    IntegrityError,
     classify_error,
     faults,
+)
+from consensus_clustering_tpu.resilience.integrity import (
+    INTEGRITY_POINTS,
+    check_input_matrix,
+    frame_digest,
+    verify_state_frame,
 )
 
 __all__ = [
     "CheckpointFrameError",
     "FaultInjector",
+    "INTEGRITY_POINTS",
     "InjectedFault",
     "InjectedOOM",
+    "IntegrityError",
     "StreamCheckpointer",
+    "check_input_matrix",
     "classify_error",
     "faults",
+    "frame_digest",
+    "verify_state_frame",
 ]

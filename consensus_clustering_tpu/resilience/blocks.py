@@ -55,11 +55,15 @@ import threading
 import time
 import uuid
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from consensus_clustering_tpu.resilience.faults import faults
+from consensus_clustering_tpu.resilience.integrity import (
+    flip_array_bits,
+    frame_digest,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -122,9 +126,21 @@ def decode_frame(blob: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
     payload = body[8 + header_len + 8:]
     if payload_len != len(payload):
         raise CheckpointFrameError("payload length mismatch")
-    header = json.loads(header_blob)
-    with np.load(io.BytesIO(payload)) as z:
-        arrays = {name: z[name] for name in z.files}
+    try:
+        header = json.loads(header_blob)
+    except ValueError as e:
+        # Reachable only for corruption that PREDATES the CRC (the
+        # trailer already vouched for these bytes); same fall-back
+        # contract as an undecodable payload.
+        raise CheckpointFrameError(f"header undecodable ({e})")
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            arrays = {name: z[name] for name in z.files}
+    except Exception as e:  # noqa: BLE001 — np.load raises zipfile/
+        # format/IO errors of several types for a damaged npz; ANY of
+        # them escaping here would crash the resume scan instead of
+        # letting the ring fall back to the previous generation.
+        raise CheckpointFrameError(f"payload undecodable ({e})")
     return header, arrays
 
 
@@ -155,6 +171,11 @@ class StreamCheckpointer:
         #: Incremented by the streaming driver when a run actually
         #: restored state from this ring (the /metrics resume counter).
         self.resumes_total = 0
+        #: Generations the reader REFUSED on semantic grounds (digest
+        #: mismatch / invariant breach — the verified-checkpoints gate,
+        #: distinct from CRC/framing failures) — the /metrics
+        #: checkpoint_verify_rejects_total counter.
+        self.verify_rejects = 0
         self.last_error: Optional[BaseException] = None
         #: (path, reason) pairs the reader skipped — surfaced for tests
         #: and for the resume log line.
@@ -248,6 +269,32 @@ class StreamCheckpointer:
         # open(tmp) — silently disabling durability mid-job.
         os.makedirs(self.directory, exist_ok=True)
         host = {name: np.asarray(v) for name, v in arrays.items()}
+        # Semantic digest from the PRISTINE host arrays, before any
+        # byte of the payload exists: resume re-derives it from the
+        # decoded arrays, so payload corruption between here and the
+        # CRC — which the CRC itself would bless — is refused at read
+        # time (integrity.verify_state_frame).  One pass over the state
+        # on the writer thread, off the driver's critical path.
+        if "digest" not in header:
+            header = dict(header)
+            header["digest"] = frame_digest(host)
+        # Corruption fault point BETWEEN the digest and serialisation:
+        # the flipped bits land in the arrays the npz is built from, so
+        # the written frame is fully readable — zip CRCs, frame CRC,
+        # lengths all check out — and its content disagrees with the
+        # header's digest.  Only the verified-checkpoints gate can
+        # catch that lie at resume.  (Flipping the BYTES instead would
+        # trip the npz member CRC and degrade the fault to the
+        # unreadable-frame class the ring already survived.)  The
+        # largest array is flipped on a COPY: on the non-donated path
+        # ``host`` aliases live caller state.
+        nbits = faults.corrupt("checkpoint_payload", index=block)
+        if nbits:
+            victim = max(host, key=lambda name: host[name].nbytes)
+            corrupted = np.array(host[victim])
+            flip_array_bits(corrupted.view(np.int32), nbits, seed=block)
+            host = dict(host)
+            host[victim] = corrupted
         # Streamed framing, CRC accumulated piecewise: the state payload
         # is GBs at large N, and `_MAGIC + body + crc`-style
         # concatenation would peak at 3-4x that in host RAM per write,
@@ -369,7 +416,11 @@ class StreamCheckpointer:
         return sorted(out)
 
     def latest(
-        self, fingerprint: str
+        self,
+        fingerprint: str,
+        verify: Optional[
+            Callable[[Dict[str, Any], Dict[str, np.ndarray]], Optional[str]]
+        ] = None,
     ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
         """Newest VALID generation matching ``fingerprint``, or None.
 
@@ -378,6 +429,14 @@ class StreamCheckpointer:
         is skipped with a logged reason and the ring falls back to the
         previous generation — recovering less progress beats resuming
         from the wrong state.
+
+        ``verify`` (the streaming driver passes
+        :func:`~consensus_clustering_tpu.resilience.integrity.
+        verify_state_frame`) adds the SEMANTIC gate on top of framing:
+        a frame that decodes cleanly but fails its digest or the
+        accumulator invariants is refused the same way — counted in
+        ``verify_rejects`` — so recovery replays from the last
+        *verified* generation, never merely the last readable one.
         """
         self.flush()
         self.skipped = []
@@ -403,5 +462,16 @@ class StreamCheckpointer:
                 logger.warning("skipping checkpoint %s: %s", path, reason)
                 self.skipped.append((path, reason))
                 continue
+            if verify is not None:
+                bad = verify(header, arrays)
+                if bad is not None:
+                    self.verify_rejects += 1
+                    reason = f"refused by verification ({bad})"
+                    logger.warning(
+                        "skipping checkpoint %s: %s — falling back to "
+                        "the previous generation", path, reason,
+                    )
+                    self.skipped.append((path, reason))
+                    continue
             return header, arrays
         return None

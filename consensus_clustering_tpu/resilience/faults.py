@@ -29,6 +29,21 @@ test instead of trusted:
                                "retryable"/"oom", like a real device OOM)
       checkpoint_mid_write=1   raise with a torn temp file half-written
       checkpoint_post_write=0:kill   die after the atomic rename
+      accumulator=2:bitflip    flip 1 bit in the block-2 device
+                               accumulator state (an HBM bitflip: the
+                               silent-corruption class the integrity
+                               sentinel exists to catch)
+      checkpoint_payload=5:bitflip:3 flip 3 bits in generation 5's
+                               state AFTER the semantic digest is taken
+                               but BEFORE serialisation — a fully
+                               readable, CRC-valid frame whose content
+                               lies (what verified checkpoints refuse
+                               at resume)
+
+  ``bitflip`` rules never *raise*: they are consumed by
+  :meth:`FaultInjector.corrupt` at the two corruption points above, and
+  the corruption itself is applied by the caller (deterministically —
+  same plan, same flipped bits).  :func:`fire` leaves them armed.
 
   Every rule fires ONCE and disarms: a retried / resumed run must not
   trip over the same mine again — that is precisely what lets one plan
@@ -54,7 +69,7 @@ from typing import Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _ENV = "CCTPU_FAULTS"
-_ACTIONS = ("raise", "kill", "hang", "oom")
+_ACTIONS = ("raise", "kill", "hang", "oom", "bitflip")
 _KILL_EXIT_CODE = 137  # what a SIGKILL'd process reports (128 + 9)
 # A 'hang' with no duration: long enough that nothing short of the hang
 # watchdog (or the end of the test process) notices the thread again —
@@ -77,12 +92,54 @@ class InjectedOOM(RuntimeError):
     """
 
 
+class IntegrityError(RuntimeError):
+    """A data-integrity invariant was violated: the state is CORRUPT.
+
+    Raised by the integrity layer (:mod:`~consensus_clustering_tpu.
+    resilience.integrity`) when the accumulator sentinel finds counts
+    that cannot arise from any valid sweep (``Mij`` outside
+    ``[0, Iij]``, ``Iij`` beyond the resamples seen, a broken diagonal
+    or symmetry) — the signature of a flipped HBM bit or a poisoned
+    input, not of a code path.
+
+    Triaged ``retryable`` with reason ``corrupt:<point>``: the corrupt
+    state is abandoned and the retry resumes from the last *verified*
+    checkpoint generation — resume-time verification refuses any
+    generation written from corrupt state during the detection lag,
+    and the serving executor sizes ring retention to outlast that lag
+    (``serve.executor.ring_keep``).  ``point`` names where the breach
+    was detected (today only the sentinel's ``accumulator``;
+    checkpoint-layer refusals are recovery, not errors — they surface
+    as ``checkpoint_verify_rejects_total``); ``block`` is the streamed
+    block whose post-state failed; ``details`` carries the
+    per-invariant violation counts; ``checks_run`` lets the scheduler
+    keep ``integrity_checks_total`` honest for a run that ended in a
+    violation.
+    """
+
+    def __init__(
+        self,
+        point: str,
+        message: str,
+        *,
+        block: Optional[int] = None,
+        details: Optional[Dict[str, int]] = None,
+        checks_run: int = 0,
+    ):
+        self.point = point
+        self.block = block
+        self.details = dict(details or {})
+        self.checks_run = int(checks_run)
+        super().__init__(message)
+
+
 @dataclasses.dataclass
 class _Rule:
     point: str
     index: int
     action: str
     seconds: float = _DEFAULT_HANG_SECONDS  # hang duration (hang only)
+    nbits: int = 1  # bits to flip (bitflip only)
 
 
 def _parse_plan(spec: Optional[str]) -> List[_Rule]:
@@ -94,22 +151,31 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
         try:
             point, rest = entry.split("=", 1)
             index_s, _, action = rest.partition(":")
-            # hang takes an optional duration: "hang" or "hang:30".
+            # hang takes an optional duration ("hang" or "hang:30"),
+            # bitflip an optional bit count ("bitflip" or "bitflip:3").
             action = action or "raise"
             base, _, arg = action.partition(":")
             seconds = _DEFAULT_HANG_SECONDS
+            nbits = 1
             if arg:
-                if base != "hang":
-                    raise ValueError(arg)  # only hang is parameterised
-                seconds = float(arg)
-                if seconds < 0:
-                    raise ValueError(arg)
-            rule = _Rule(point.strip(), int(index_s), base, seconds)
+                if base == "hang":
+                    seconds = float(arg)
+                    if seconds < 0:
+                        raise ValueError(arg)
+                elif base == "bitflip":
+                    nbits = int(arg)
+                    if nbits < 1:
+                        raise ValueError(arg)
+                else:
+                    raise ValueError(arg)  # only hang/bitflip take args
+            rule = _Rule(
+                point.strip(), int(index_s), base, seconds, nbits
+            )
         except ValueError:
             raise ValueError(
                 f"bad fault spec entry {entry!r}: expected "
                 "point=index[:action] with action raise | kill | "
-                "hang[:seconds] | oom"
+                "hang[:seconds] | oom | bitflip[:nbits]"
             )
         if rule.action not in _ACTIONS:
             raise ValueError(
@@ -152,11 +218,14 @@ class FaultInjector:
         Rules are single-shot: once fired they disarm, so a retry or a
         resume-from-checkpoint of the same work does not re-trip — the
         property that lets one plan drive a full interrupt-then-recover
-        cycle.
+        cycle.  ``bitflip`` rules are left armed: they corrupt rather
+        than raise, and only :meth:`corrupt` (called at the corruption
+        points) consumes them.
         """
-        rule = self._armed.pop((point, index), None)
-        if rule is None:
+        rule = self._armed.get((point, index))
+        if rule is None or rule.action == "bitflip":
             return
+        self._armed.pop((point, index))
         self.fired.append((point, index, rule.action))
         if rule.action == "kill":
             logger.warning(
@@ -193,6 +262,30 @@ class FaultInjector:
             "fault injection: raising at %s[%d]", point, index
         )
         raise InjectedFault(f"injected fault at {point}[{index}]")
+
+    def corrupt(self, point: str, index: int) -> Optional[int]:
+        """Bits to flip at this corruption point, or None when unarmed.
+
+        The ``bitflip`` half of :meth:`fire`: durability-critical code
+        calls it at the corruption points (``accumulator`` before each
+        evaluated block's state is trusted, ``checkpoint_payload``
+        between the semantic digest and the CRC) and applies the
+        returned number of bit flips itself — deterministically, so one
+        plan reproduces one corruption.  Single-shot like every rule;
+        non-bitflip rules at the same (point, index) are left for
+        :meth:`fire` (nothing calls fire at corruption points today,
+        but the grammar does not forbid the spelling).
+        """
+        rule = self._armed.get((point, index))
+        if rule is None or rule.action != "bitflip":
+            return None
+        self._armed.pop((point, index))
+        self.fired.append((point, index, rule.action))
+        logger.warning(
+            "fault injection: flipping %d bit(s) at %s[%d]",
+            rule.nbits, point, index,
+        )
+        return rule.nbits
 
 
 #: The process-global injector production code fires into.  Armed from
@@ -240,8 +333,9 @@ def classify_error(exc: BaseException) -> Tuple[str, str]:
     ``kind`` is ``"retryable"`` (the scheduler re-runs with backoff,
     resuming from the newest checkpoint) or ``"fatal"`` (fail the job
     now).  ``reason`` is a short label for the ``retry_total{reason}``
-    metrics counter: ``injected`` | ``oom`` | ``device`` | ``io`` |
-    ``runtime`` — or the exception type name for fatal errors.
+    metrics counter: ``injected`` | ``corrupt:<point>`` | ``oom`` |
+    ``device`` | ``io`` | ``runtime`` — or the exception type name for
+    fatal errors.
 
     The default for an *unrecognised* exception is retryable: on a pod,
     the unknown-unknowns are overwhelmingly transient (plugin hiccups,
@@ -251,6 +345,11 @@ def classify_error(exc: BaseException) -> Tuple[str, str]:
     """
     if isinstance(exc, InjectedFault):
         return "retryable", "injected"
+    if isinstance(exc, IntegrityError):
+        # Corrupt state, not a deterministic bug: the retry abandons
+        # the poisoned accumulators and resumes from the last VERIFIED
+        # checkpoint generation — which predates the corruption.
+        return "retryable", f"corrupt:{exc.point}"
     if isinstance(exc, _FATAL_TYPES):
         return "fatal", type(exc).__name__
     text = str(exc).lower()

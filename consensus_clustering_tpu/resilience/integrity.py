@@ -1,0 +1,329 @@
+"""Silent-corruption defense: sentinels, verified checkpoints, admission.
+
+Everything in this repo leans on the int32 exactness of the Monti
+co-clustering counts — every parity gate (streamed vs monolithic,
+kill-and-resume, autotune eligibility) asserts bit-identical
+``Mij``/``Iij`` — yet exactness is only as good as the bytes holding
+it.  A flipped HBM bit, a checkpoint frame corrupted *before* its CRC
+was computed, or a NaN-poisoned input all produce wrong PAC curves and
+a wrong chosen K with zero errors raised.  This module is the
+data-hostile counterpart of the process-hostile hardening (watchdog /
+quarantine / preflight): three cheap checks, each placed where the
+corruption class it catches actually enters.
+
+- **Accumulator invariant sentinel** (:func:`build_sentinel`): a small
+  jitted program over the streaming engine's device-resident state,
+  run every ``integrity_check_every`` blocks by the driver.  The Monti
+  counts satisfy invariants no valid sweep can break — elementwise
+  ``0 <= Mij <= Iij <= h_seen``, ``diag(Mij) == diag(Iij)`` (a sampled
+  point always co-clusters with itself), and symmetry (checked on
+  sampled rows; the full matrix would double the check's reads for the
+  same detection power against random flips).  A breach raises
+  :class:`~consensus_clustering_tpu.resilience.faults.IntegrityError`
+  (triaged ``corrupt:accumulator``, retryable): the corrupt state is
+  abandoned and the retry resumes from the last verified generation.
+- **Verified checkpoints** (:func:`frame_digest` /
+  :func:`verify_state_frame`): every block-checkpoint frame carries a
+  semantic digest (per-array sum/min/max) computed from the pristine
+  host arrays *before* the payload is serialised, so a frame whose
+  content changed after the digest was taken — the CRC-valid-but-lying
+  class the ``checkpoint_payload`` bitflip fault simulates — is
+  *refused* at resume and the ring falls back to the previous
+  generation.  The
+  verifier also re-checks the accumulator invariants, so a CRC-valid,
+  digest-valid frame *written from already-corrupt state* (sentinel
+  off, or corruption between checks) is refused too: recovery replays
+  from the last **verified** generation, not merely the last readable.
+- **Input admission** (:func:`check_input_matrix`): NaN/Inf and
+  zero-variance matrices are rejected at ``api.fit`` and at serve
+  admission (structured 400, code ``invalid_data``) before a poisoned
+  matrix can burn a warm executable slot — NaN is absorbing under the
+  accumulation GEMMs, so one bad cell silently zeroes whole count
+  rows.
+
+Importing this module initialises neither JAX nor numpy (the helpers
+import lazily): stdlib-only consumers (:mod:`.faults`) stay stdlib-only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from consensus_clustering_tpu.resilience.faults import IntegrityError
+
+__all__ = [
+    "INTEGRITY_POINTS",
+    "IntegrityError",
+    "build_sentinel",
+    "check_input_matrix",
+    "flip_array_bits",
+    "frame_digest",
+    "verify_state_frame",
+]
+
+#: Detection points an :class:`IntegrityError` can name — the key set
+#: ``integrity_violations_total{point}`` is pre-seeded with (the
+#: dict-copy-races-first-insert rule: /metrics key sets never change
+#: after construction).  Deliberately ONLY the sentinel's point:
+#: checkpoint-layer breaches are not errors — a refused generation is
+#: RECOVERY (the ring falls back), surfaced as
+#: ``checkpoint_verify_rejects_total``, and pre-seeding an unreachable
+#: ``checkpoint`` key here would hand operators a counter that can
+#: never fire.
+INTEGRITY_POINTS = ("accumulator",)
+
+#: Bit flipped by the fault-injection corruption helpers: bit 30 of an
+#: int32 count turns a small exact integer into ~1e9, which violates
+#: ``Mij <= Iij <= h_seen`` with certainty — a deterministic stand-in
+#: for the worst-case random flip (a low-bit flip that *happens* to
+#: keep the invariants is exactly the corruption no invariant check
+#: can see; the digest still catches it on the checkpoint path).
+_FLIP_BIT = 30
+
+
+# ---------------------------------------------------------------------------
+# Accumulator invariant sentinel (device-side, jitted)
+
+
+def build_sentinel() -> Callable[..., Dict[str, Any]]:
+    """A jitted ``(state, h_seen, sample_idx) -> violation counts`` check.
+
+    ``state`` is the streaming engine's ``{"mij", "iij"}`` dict (padded,
+    mesh-sharded — the check computes under whatever sharding the state
+    carries); ``h_seen`` the resamples accumulated so far; ``sample_idx``
+    the row indices the symmetry probe gathers.  Returns int32 scalars:
+
+    - ``range_bad``  — elements with ``Mij < 0`` or ``Mij > Iij``
+    - ``bound_bad``  — elements with ``Iij < 0`` or ``Iij > h_seen``
+    - ``diag_bad``   — positions where ``diag(Mij) != diag(Iij)``
+    - ``sym_bad``    — sampled-row positions where ``A[i, :] != A[:, i]``
+
+    All zero for any state a valid sweep can produce (padding rows are
+    zero and symmetric, so the padded region never false-positives).
+    The whole check is one fused pass over the state in HBM — the same
+    read volume as one consensus-histogram pass, which the engine
+    already pays per K per block.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def sentinel(state, h_seen, sample_idx):
+        mij = state["mij"]
+        iij = state["iij"]
+        range_bad = jnp.sum(
+            ((mij < 0) | (mij > iij[None, :, :])).astype(jnp.int32)
+        )
+        bound_bad = jnp.sum(((iij < 0) | (iij > h_seen)).astype(jnp.int32))
+        diag_m = jnp.diagonal(mij, axis1=-2, axis2=-1)
+        diag_i = jnp.diagonal(iij)
+        diag_bad = jnp.sum((diag_m != diag_i[None, :]).astype(jnp.int32))
+        rows_m = jnp.take(mij, sample_idx, axis=1)
+        cols_m = jnp.swapaxes(jnp.take(mij, sample_idx, axis=2), 1, 2)
+        rows_i = jnp.take(iij, sample_idx, axis=0)
+        cols_i = jnp.swapaxes(jnp.take(iij, sample_idx, axis=1), 0, 1)
+        sym_bad = jnp.sum((rows_m != cols_m).astype(jnp.int32)) + jnp.sum(
+            (rows_i != cols_i).astype(jnp.int32)
+        )
+        return {
+            "range_bad": range_bad,
+            "bound_bad": bound_bad,
+            "diag_bad": diag_bad,
+            "sym_bad": sym_bad,
+        }
+
+    return sentinel
+
+
+def sentinel_sample_rows(n: int, block: int, count: int = 16):
+    """Deterministic symmetry-probe row indices for one check.
+
+    Varies with the block so repeated checks walk different rows (a
+    localised corruption is eventually sampled), stays a pure function
+    of (n, block) so an interrupted-and-retried run re-checks the same
+    rows — fault plans stay reproducible.
+    """
+    import numpy as np
+
+    s = max(1, min(int(n), int(count)))
+    return (
+        (np.arange(s, dtype=np.int64) * 7919 + int(block) * 104729) % int(n)
+    ).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Verified checkpoint frames (host-side, numpy only)
+
+
+def frame_digest(arrays: Dict[str, Any]) -> Dict[str, Any]:
+    """Semantic digest of a checkpoint generation's arrays.
+
+    Per array: shape, dtype, and exact sum/min/max (integer arrays sum
+    in int64 — exact; float arrays in float64 — deterministic for a
+    fixed array, and JSON round-trips binary64 exactly).  Computed from
+    the pristine host arrays *before* the npz payload is serialised, so
+    any later payload corruption — even one the CRC blesses because it
+    happened first — disagrees with the header's digest at resume.
+
+    Cheaper and more honest than a second content hash: the CRC already
+    covers bytes-as-written; what it cannot cover is bytes that were
+    wrong *before* it ran, and sum/min/max over the actual values is
+    exactly the evidence the invariant verifier wants anyway.
+    """
+    import numpy as np
+
+    digest: Dict[str, Any] = {}
+    for name in sorted(arrays):
+        a = np.asarray(arrays[name])
+        entry: Dict[str, Any] = {
+            "shape": [int(v) for v in a.shape],
+            "dtype": str(a.dtype),
+        }
+        if a.size:
+            if np.issubdtype(a.dtype, np.integer):
+                entry["sum"] = int(np.sum(a, dtype=np.int64))
+                entry["min"] = int(a.min())
+                entry["max"] = int(a.max())
+            else:
+                entry["sum"] = float(np.sum(a, dtype=np.float64))
+                entry["min"] = float(a.min())
+                entry["max"] = float(a.max())
+        digest[name] = entry
+    return digest
+
+
+def verify_state_frame(
+    header: Dict[str, Any], arrays: Dict[str, Any]
+) -> Optional[str]:
+    """Reason a decoded checkpoint frame must be REFUSED, or None.
+
+    The resume-side gate :meth:`~consensus_clustering_tpu.resilience.
+    blocks.StreamCheckpointer.latest` applies before trusting a
+    generation: first the semantic digest (catches payload bytes that
+    changed after the digest was taken — CRC-valid or not), then the
+    accumulator invariants on the state arrays themselves (catches a
+    frame faithfully recording state that was *already* corrupt when
+    written).  Frames from before the digest existed verify on
+    invariants alone — an old ring still resumes.
+    """
+    import numpy as np
+
+    recorded = header.get("digest")
+    if recorded is not None:
+        fresh = frame_digest(arrays)
+        if fresh != recorded:
+            changed = sorted(
+                name
+                for name in set(fresh) | set(recorded)
+                if fresh.get(name) != recorded.get(name)
+            )
+            return f"digest mismatch on {changed}"
+    mij = arrays.get("state_mij")
+    iij = arrays.get("state_iij")
+    if mij is not None and iij is not None:
+        mij = np.asarray(mij)
+        iij = np.asarray(iij)
+        if (mij < 0).any() or (mij > iij[None, :, :]).any():
+            return "invariant violation: Mij outside [0, Iij]"
+        h_done = header.get("h_done")
+        if (iij < 0).any() or (
+            h_done is not None and (iij > int(h_done)).any()
+        ):
+            return "invariant violation: Iij outside [0, h_done]"
+        diag_i = np.diagonal(iij)
+        if (np.diagonal(mij, axis1=-2, axis2=-1) != diag_i[None, :]).any():
+            return "invariant violation: diag(Mij) != diag(Iij)"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Deterministic corruption (the bitflip fault action's hands)
+
+
+def flip_array_bits(a, nbits: int, seed: int) -> None:
+    """Flip ``nbits`` high bits of an int array IN PLACE, deterministically.
+
+    The ``accumulator`` fault point's corruption: positions derive from
+    ``seed`` (the block index) alone, so one fault plan produces one
+    corruption.  Bit 30 guarantees the sentinel-visible invariant
+    breach; see :data:`_FLIP_BIT` for why that is the honest choice.
+    """
+    import numpy as np
+
+    flat = a.reshape(-1)
+    rng = np.random.default_rng(0xC0FFEE + int(seed))
+    # WITHOUT replacement: a duplicate position would XOR the same bit
+    # twice and cancel — an armed fault plan injecting zero corruption,
+    # which the chaos harness would then report as an UNDETECTED
+    # corruption against a healthy product.
+    positions = rng.choice(
+        flat.size, size=min(int(nbits), flat.size), replace=False
+    )
+    for pos in positions:
+        flat[pos] ^= np.int32(1) << _FLIP_BIT
+
+
+# ---------------------------------------------------------------------------
+# Input admission (host-side, numpy only)
+
+
+def check_input_matrix(
+    x, max_report: int = 20
+) -> Optional[Dict[str, Any]]:
+    """Why a data matrix is numerically inadmissible, or None if fine.
+
+    Returns the structured payload serve's 400 body carries (mirroring
+    the preflight 413 shape: ``error`` + machine fields + ``hint``)
+    with ``code="invalid_data"``:
+
+    - ``reason="non_finite"`` — NaN/Inf cells, with the offending
+      ``rows``/``cols`` (first ``max_report`` of each);
+    - ``reason="zero_variance"`` — every row identical: no K >= 2
+      partition is defined, and k-means++ distance weights are all
+      zero.
+
+    Shape validation stays with the callers (they already do it); this
+    is strictly the value check both admission surfaces share.
+    """
+    import numpy as np
+
+    x = np.asarray(x)
+    finite = np.isfinite(x)
+    if not finite.all():
+        bad_rows, bad_cols = np.nonzero(~finite)
+        rows = np.unique(bad_rows)[:max_report]
+        cols = np.unique(bad_cols)[:max_report]
+        n_bad = int((~finite).sum())
+        return {
+            "error": (
+                f"'data' contains {n_bad} non-finite value(s) "
+                f"(NaN/Inf); first at row {int(bad_rows[0])}, "
+                f"col {int(bad_cols[0])}"
+            ),
+            "code": "invalid_data",
+            "reason": "non_finite",
+            "rows": [int(v) for v in rows],
+            "cols": [int(v) for v in cols],
+            "hint": (
+                "NaN is absorbing under the co-clustering accumulation: "
+                "one bad cell silently poisons whole count rows. Clean "
+                "or impute the listed rows/cols and resubmit"
+            ),
+        }
+    if x.shape[0] > 1 and bool(np.all(x == x[0])):
+        return {
+            "error": (
+                "'data' has zero variance (every row identical): no "
+                "clustering into K >= 2 groups is defined"
+            ),
+            "code": "invalid_data",
+            "reason": "zero_variance",
+            "rows": [],
+            "cols": [],
+            "hint": (
+                "check the upstream feature pipeline — identical rows "
+                "usually mean a join or scaling step emitted a "
+                "constant matrix"
+            ),
+        }
+    return None

@@ -34,6 +34,7 @@ import importlib
 # :mod:`.executor`/:mod:`.scheduler` (→ SweepConfig → jax) eagerly.
 _EXPORTS = {
     "EventLog": "consensus_clustering_tpu.serve.events",
+    "InvalidDataError": "consensus_clustering_tpu.serve.executor",
     "JobSpec": "consensus_clustering_tpu.serve.executor",
     "JobSpecError": "consensus_clustering_tpu.serve.executor",
     "PRIORITIES": "consensus_clustering_tpu.serve.executor",

@@ -38,6 +38,14 @@ Hostile-path events (docs/SERVING.md "Overload & wedge runbook"):
   (fingerprint, shape, estimated_bytes, budget_bytes); HTTP 413
 - ``job_shed``        — admission refused by the overload shed policy
   (fingerprint, priority, reason, queue_depth); HTTP 429 + Retry-After
+
+Data-integrity events (docs/SERVING.md "Integrity runbook"):
+
+- ``integrity_violation`` — the accumulator sentinel found corrupt
+  state (job_id, attempt, point, block, details: per-invariant
+  violation counts); followed by ``job_retry`` with reason
+  ``corrupt:<point>`` — the retry resumes from the last VERIFIED
+  checkpoint generation
 """
 
 from __future__ import annotations

@@ -74,6 +74,26 @@ class JobSpecError(ValueError):
     """A submitted job payload failed validation (HTTP 400)."""
 
 
+class InvalidDataError(JobSpecError):
+    """The submitted data matrix is numerically inadmissible (HTTP 400,
+    STRUCTURED body — the preflight-413 shape: ``error`` + machine
+    fields + ``hint``).
+
+    Raised at ``parse_job_spec`` time, i.e. before admission: a
+    NaN-poisoned matrix is rejected before it can persist a payload,
+    enter the queue, or burn a warm executable slot on a sweep whose
+    counts are garbage by construction.  ``payload`` carries
+    ``code="invalid_data"``, the ``reason`` (``non_finite`` |
+    ``zero_variance``), the offending ``rows``/``cols``, and a hint —
+    see :func:`~consensus_clustering_tpu.resilience.integrity.
+    check_input_matrix`.
+    """
+
+    def __init__(self, payload: Dict[str, Any]):
+        self.payload = dict(payload)
+        super().__init__(self.payload.get("error", "invalid data"))
+
+
 @dataclasses.dataclass(frozen=True)
 class JobSpec:
     """Validated, JSON-able sweep request (no data — that rides separately).
@@ -218,8 +238,15 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         raise JobSpecError(
             f"'data' must be a non-empty 2-D array, got shape {x.shape}"
         )
-    if not np.all(np.isfinite(x)):
-        raise JobSpecError("'data' contains NaN/Inf")
+    from consensus_clustering_tpu.resilience.integrity import (
+        check_input_matrix,
+    )
+
+    problem = check_input_matrix(x)
+    if problem is not None:
+        # Structured 400 (the preflight-413 body shape): the offending
+        # row/col indices and a hint, not a bare "contains NaN".
+        raise InvalidDataError(problem)
 
     def _int(name, default, lo, hi):
         v = cfg.get(name, default)
@@ -344,6 +371,26 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
     return spec, x
 
 
+def ring_keep(integrity_check_every: int, checkpoint_every: int) -> int:
+    """Checkpoint-ring retention that outlasts the sentinel's lag.
+
+    With a sentinel check every C blocks and a checkpoint every W, up
+    to ``ceil(C / W)`` generations can be written from already-corrupt
+    state before the breach is detected (the corruption lands right
+    after a check, every later block accumulates on it, detection
+    raises just before the next due block's write).  The ring must
+    reach one generation PAST that window, or a detected corruption
+    would refuse every retained frame at resume and restart from zero
+    — instead of the documented last-verified generation.  Without the
+    sentinel the historical 2 suffices (resume-time verification still
+    guards the ring, but there is no systematic detection lag to
+    outlast).
+    """
+    if integrity_check_every <= 0:
+        return 2
+    return max(2, -(-integrity_check_every // max(checkpoint_every, 1)) + 1)
+
+
 class SweepExecutor:
     """Runs validated jobs as streamed compiled sweeps, caching engines.
 
@@ -365,6 +412,7 @@ class SweepExecutor:
         default_h_block: Optional[int] = None,
         checkpoint_every: int = 1,
         calibration_store=None,
+        integrity_check_every: int = 0,
     ):
         if default_h_block is not None and default_h_block < 1:
             raise ValueError(
@@ -375,6 +423,11 @@ class SweepExecutor:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}"
             )
+        if integrity_check_every < 0:
+            raise ValueError(
+                f"integrity_check_every must be >= 0 (0 = off), got "
+                f"{integrity_check_every}"
+            )
         # None: resolve per job through the autotune policy (a
         # calibrated record for this environment × shape bucket when
         # ``calibration_store`` has one, else the H/8-clamped-[16,128]
@@ -384,6 +437,11 @@ class SweepExecutor:
         self.default_h_block = default_h_block
         self.calibration_store = calibration_store
         self.checkpoint_every = checkpoint_every
+        # Accumulator-sentinel cadence for every executed job (serve
+        # --integrity-every): a RUNTIME knob of the streaming driver —
+        # never part of the executable bucket, results identical at any
+        # value (the sentinel only reads state).
+        self.integrity_check_every = integrity_check_every
         # Resolutions by provenance tier over EXECUTED jobs — the
         # /metrics autotune_provenance_total satellite: an operator can
         # see live whether calibration actually steers traffic or
@@ -419,6 +477,10 @@ class SweepExecutor:
         self.h_effective_total = 0
         self.checkpoint_writes_total = 0
         self.checkpoint_resume_total = 0
+        # Generations the verified-resume gate REFUSED (digest mismatch
+        # or invariant breach — resilience.integrity): each one is a
+        # corrupt frame that recovery correctly fell back past.
+        self.checkpoint_verify_rejects_total = 0
         self._engines: Dict[str, Any] = {}
         self._lock = threading.Lock()
         # Serialises build+compile per process, separate from _lock: a
@@ -694,7 +756,14 @@ class SweepExecutor:
             )
 
             checkpointer = StreamCheckpointer(
-                checkpoint_dir, every=self.checkpoint_every
+                checkpoint_dir,
+                every=self.checkpoint_every,
+                # Retention sized to the sentinel's worst-case
+                # detection lag (see ring_keep): a caught corruption
+                # must always find a verified generation behind it.
+                keep=ring_keep(
+                    self.integrity_check_every, self.checkpoint_every
+                ),
             )
 
         with self._lock:
@@ -740,6 +809,7 @@ class SweepExecutor:
                 adaptive_patience=spec.adaptive_patience,
                 adaptive_min_h=spec.adaptive_min_h,
                 checkpointer=checkpointer,
+                integrity_check_every=self.integrity_check_every,
             )
             # engine.run's curves copies are the completion barrier
             # (run_sweep's rule: block_until_ready can return early on
@@ -758,6 +828,9 @@ class SweepExecutor:
                     )
                     self.checkpoint_resume_total += (
                         checkpointer.resumes_total
+                    )
+                    self.checkpoint_verify_rejects_total += (
+                        checkpointer.verify_rejects
                     )
             if checkpointer is not None:
                 checkpointer.close()
@@ -834,6 +907,12 @@ class SweepExecutor:
                 ),
                 "checkpoint_writes": int(
                     streaming.get("checkpoint_writes", 0)
+                ),
+                # Sentinel evaluations this run (0 when --integrity-
+                # every is off); the scheduler rolls these into
+                # /metrics integrity_checks_total.
+                "integrity_checks": int(
+                    streaming.get("integrity_checks", 0)
                 ),
             },
             "timings": {

@@ -73,7 +73,11 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from consensus_clustering_tpu.resilience.faults import classify_error
+from consensus_clustering_tpu.resilience.faults import (
+    IntegrityError,
+    classify_error,
+)
+from consensus_clustering_tpu.resilience.integrity import INTEGRITY_POINTS
 from consensus_clustering_tpu.serve.events import EventLog
 from consensus_clustering_tpu.serve.executor import (
     PRIORITIES,
@@ -256,6 +260,14 @@ class Scheduler:
         self.jobs_quarantined = 0
         self.preflight_rejects_total = 0
         self.jobs_shed_total: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        # Silent-corruption defense counters (docs/SERVING.md
+        # "Integrity runbook"): sentinel evaluations across executed
+        # jobs, and breaches by detection point — pre-seeded with every
+        # point so the /metrics key set never changes.
+        self.integrity_checks_total = 0
+        self.integrity_violations_total: Dict[str, int] = {
+            p: 0 for p in INTEGRITY_POINTS
+        }
         self.cache_hits = 0
         # Retries by classify_error reason ({"injected": 1, "oom": 2,
         # ...}) — the /metrics retry_total{reason} satellite.
@@ -653,6 +665,18 @@ class Scheduler:
                 "jobs_shed_total": dict(self.jobs_shed_total),
                 "preflight_rejects_total": self.preflight_rejects_total,
                 "memory_budget_bytes": self.memory_budget_bytes,
+                # Silent-corruption defense (docs/SERVING.md "Integrity
+                # runbook"): sentinel evaluations, breaches by
+                # detection point (retried as corrupt:<point>), and
+                # checkpoint generations the verified-resume gate
+                # refused.  All pre-seeded.
+                "integrity_checks_total": self.integrity_checks_total,
+                "integrity_violations_total": dict(
+                    self.integrity_violations_total
+                ),
+                "checkpoint_verify_rejects_total": getattr(
+                    self.executor, "checkpoint_verify_rejects_total", 0
+                ),
                 # Block-size resolution tiers over executed jobs
                 # (docs/AUTOTUNE.md "Provenance"): whether calibration
                 # actually steers traffic, or jobs pin their own block,
@@ -941,8 +965,43 @@ class Scheduler:
                         silent_seconds=round(e.silent_seconds, 3),
                         deadline_seconds=round(e.deadline, 3),
                     )
+                elif isinstance(e, IntegrityError):
+                    # Silent corruption caught: count the breach by
+                    # detection point, keep the checks counter honest
+                    # for the violated run (its streaming stats never
+                    # arrive), and emit the operator signal.  Triage
+                    # stays classify_error's (retryable,
+                    # corrupt:<point>) — the retry abandons the corrupt
+                    # state and resumes from the last VERIFIED
+                    # checkpoint generation.
+                    kind, reason = classify_error(e)
+                    with self._lock:
+                        self.integrity_violations_total[e.point] = (
+                            self.integrity_violations_total.get(
+                                e.point, 0
+                            ) + 1
+                        )
+                        self.integrity_checks_total += getattr(
+                            e, "checks_run", 0
+                        )
+                    self.events.emit(
+                        "integrity_violation", job_id=job_id,
+                        attempt=attempt, point=e.point,
+                        block=getattr(e, "block", None),
+                        details=getattr(e, "details", {}),
+                    )
                 else:
                     kind, reason = classify_error(e)
+                    # Sentinel checks run by an attempt that died of
+                    # something ELSE (OOM, injected fault, runtime
+                    # error) still happened: the streaming driver
+                    # attaches the count to the exception so the
+                    # /metrics counter stays honest across the chaos
+                    # mix, not just for integrity verdicts.
+                    ran = getattr(e, "integrity_checks_run", 0)
+                    if ran:
+                        with self._lock:
+                            self.integrity_checks_total += int(ran)
                 if kind == "retryable" and attempt < self.max_retries:
                     backoff = self.backoff_base * (2 ** attempt)
                     with self._lock:
@@ -972,6 +1031,13 @@ class Scheduler:
                 )
                 return
             seconds = time.perf_counter() - t0
+            if isinstance(result, dict):
+                streaming = result.get("streaming")
+                if isinstance(streaming, dict):
+                    with self._lock:
+                        self.integrity_checks_total += int(
+                            streaming.get("integrity_checks", 0)
+                        )
             # Store first, then flip status: a GET that sees "done" must
             # always find the result bytes on disk.
             self.store.put_result(fp, result)

@@ -7,7 +7,10 @@ framework, nothing the container doesn't already have.  Endpoints:
   "config": {...}}`` (see :func:`~consensus_clustering_tpu.serve.
   executor.parse_job_spec` for the config schema).  202 + job record on
   admission, 200 + completed record when the (config, data) fingerprint
-  dedups against the jobstore, 400 on a malformed body, 429 when the
+  dedups against the jobstore, 400 on a malformed body (structured,
+  ``code: invalid_data`` with the offending row/col indices, when the
+  data matrix itself is inadmissible — NaN/Inf or zero variance),
+  429 when the
   queue is full — or, with ``Retry-After``, when the overload shed
   policy refuses this ``config.priority`` under pressure — and 413 when
   the body exceeds ``max_body_bytes`` or the memory preflight estimates
@@ -46,6 +49,7 @@ from typing import Any, Dict, Optional
 
 from consensus_clustering_tpu.serve.events import EventLog
 from consensus_clustering_tpu.serve.executor import (
+    InvalidDataError,
     JobSpecError,
     SweepExecutor,
     parse_job_spec,
@@ -117,6 +121,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             spec, x = parse_job_spec(body)
+        except InvalidDataError as e:
+            # Structured 400 (the preflight-413 body shape): code
+            # invalid_data, the offending row/col indices, and a hint —
+            # an actionable refusal for a poisoned matrix, rejected
+            # before anything persists or queues.
+            self._send_json(400, dict(e.payload))
+            return
         except JobSpecError as e:
             self._send_json(400, {"error": str(e)})
             return

@@ -33,9 +33,12 @@ def _fingerprint(config: SweepConfig, seed: int) -> str:
     payload.pop("store_matrices")
     # chunk_size only shapes the accumulation GEMMs and use_pallas only
     # selects the histogram kernel; counts are exact integers either way,
-    # so neither may invalidate checkpoints.
+    # so neither may invalidate checkpoints.  integrity_check_every is a
+    # pure observer (the sentinel reads state, never writes it), so it
+    # may not invalidate them either.
     payload.pop("chunk_size")
     payload.pop("use_pallas", None)
+    payload.pop("integrity_check_every", None)
     # stream_h_block is an execution strategy, not a semantic: the
     # streamed sweep is bit-exact to the monolithic one at full H (the
     # PR-3 parity proof), so block size must not invalidate per-K
@@ -99,13 +102,16 @@ def stream_fingerprint(
       decision.
 
     ``store_matrices``/``chunk_size``/``use_pallas`` are excluded for
-    the per-K scheme's reasons — exact integer counts either way.
+    the per-K scheme's reasons — exact integer counts either way — and
+    ``integrity_check_every`` because the sentinel only reads state: a
+    run checked at a different cadence must still resume this ring.
     """
     payload = dataclasses.asdict(config)
     payload["seed"] = seed
     payload.pop("store_matrices")
     payload.pop("chunk_size")
     payload.pop("use_pallas", None)
+    payload.pop("integrity_check_every", None)
     payload["n_iterations"] = (
         config.n_iterations if n_iterations is None else int(n_iterations)
     )

@@ -1,0 +1,815 @@
+"""Silent-corruption defense (docs/SERVING.md "Integrity runbook"):
+accumulator sentinel, verified checkpoints, bitflip fault injection,
+input admission.
+
+Fast lane: the bitflip fault grammar, ``corrupt:<point>`` triage, the
+semantic digest + invariant verifier on handcrafted frames, the
+verified-resume refusal through a real ``StreamCheckpointer``, NaN/Inf/
+zero-variance admission at ``check_input_matrix`` / ``parse_job_spec``
+/ ``api.fit`` / the live HTTP surface (structured 400, nothing
+persisted), and the scheduler's integrity counters driven by a stub —
+nothing here compiles.  Slow lane: the real streaming engine driven
+through accumulator and checkpoint bitflips, asserting detection at
+the corrupted block and bit-identical recovery from the last VERIFIED
+generation.  The process-scale version (bitflips against a live
+service subprocess) is ``benchmarks/chaos_soak.py --schedule corrupt``,
+run by the ``chaos-smoke`` CI job.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.resilience.blocks import (
+    CheckpointFrameError,
+    StreamCheckpointer,
+    decode_frame,
+    encode_frame,
+)
+from consensus_clustering_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedFault,
+    IntegrityError,
+    classify_error,
+    faults,
+)
+from consensus_clustering_tpu.resilience.integrity import (
+    INTEGRITY_POINTS,
+    check_input_matrix,
+    flip_array_bits,
+    frame_digest,
+    verify_state_frame,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: the bitflip action
+
+
+class TestBitflipGrammar:
+    def test_parse_and_single_shot_corrupt(self):
+        inj = FaultInjector("accumulator=2:bitflip")
+        assert inj.corrupt("accumulator", 1) is None
+        assert inj.corrupt("accumulator", 2) == 1
+        # Single-shot: a resumed/retried run must not re-trip the mine.
+        assert inj.corrupt("accumulator", 2) is None
+        assert inj.fired == [("accumulator", 2, "bitflip")]
+
+    def test_parse_nbits(self):
+        inj = FaultInjector("checkpoint_payload=5:bitflip:3")
+        assert inj.corrupt("checkpoint_payload", 5) == 3
+
+    def test_fire_leaves_bitflip_rules_armed(self):
+        # fire() raising InjectedFault for a corruption rule would turn
+        # every bitflip plan into a plain injected failure.
+        inj = FaultInjector("block_start=1:bitflip")
+        inj.fire("block_start", 1)  # no raise, rule stays armed
+        assert inj.corrupt("block_start", 1) == 1
+
+    def test_corrupt_leaves_non_bitflip_rules_for_fire(self):
+        inj = FaultInjector("block_start=1")
+        assert inj.corrupt("block_start", 1) is None
+        with pytest.raises(InjectedFault):
+            inj.fire("block_start", 1)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "a=1:bitflip:0",      # nbits must be >= 1
+            "a=1:bitflip:x",      # nbits must be an int
+            "a=1:raise:3",        # only hang/bitflip take an argument
+            "a=1:oom:2",
+        ],
+    )
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ValueError, match="bad fault"):
+            FaultInjector(bad)
+
+    def test_mixed_plan_with_legacy_actions(self):
+        inj = FaultInjector(
+            "checkpoint_payload=5:bitflip,block_start=3:hang:1,oomp=0:oom"
+        )
+        assert inj.corrupt("checkpoint_payload", 5) == 1
+        assert inj.active()
+
+
+class TestTriage:
+    def test_integrity_error_is_retryable_corrupt(self):
+        for point in INTEGRITY_POINTS:
+            kind, reason = classify_error(IntegrityError(point, "boom"))
+            assert (kind, reason) == ("retryable", f"corrupt:{point}")
+
+    def test_integrity_error_carries_forensics(self):
+        e = IntegrityError(
+            "accumulator", "x", block=3,
+            details={"range_bad": 2}, checks_run=4,
+        )
+        assert (e.point, e.block, e.details, e.checks_run) == (
+            "accumulator", 3, {"range_bad": 2}, 4
+        )
+
+    def test_deterministic_errors_stay_fatal(self):
+        # The new triage entry must not soften the ValueError class —
+        # retrying a deterministic bug burns the backoff budget.
+        assert classify_error(ValueError("bad"))[0] == "fatal"
+        assert classify_error(TypeError("bad"))[0] == "fatal"
+        assert classify_error(InjectedFault("f")) == (
+            "retryable", "injected"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Semantic digest + invariant verification on frames
+
+
+def _valid_state(h=3, n=4, nk=1):
+    """A state any valid sweep could produce: every resample sampled
+    (and co-clustered) everything — Mij == Iij == h, diagonals equal,
+    symmetric, bounded by h."""
+    iij = np.full((n, n), h, np.int32)
+    mij = np.broadcast_to(iij, (nk, n, n)).copy()
+    return {"state_mij": mij, "state_iij": iij}
+
+
+def _header(arrays, h=3, block=0, digest=True):
+    header = {"fingerprint": "fp", "block_index": block, "h_done": h}
+    if digest:
+        header["digest"] = frame_digest(arrays)
+    return header
+
+
+class TestDigestAndVerify:
+    def test_clean_frame_verifies_after_json_roundtrip(self):
+        arrays = _valid_state()
+        header = json.loads(json.dumps(_header(arrays), sort_keys=True))
+        assert verify_state_frame(header, arrays) is None
+
+    def test_digest_mismatch_refused(self):
+        arrays = _valid_state()
+        header = _header(arrays)
+        flip_array_bits(arrays["state_mij"], nbits=1, seed=0)
+        reason = verify_state_frame(header, arrays)
+        assert reason is not None and "digest mismatch" in reason
+        assert "state_mij" in reason
+
+    def test_digest_roundtrip_via_encode_decode(self):
+        arrays = _valid_state()
+        header, decoded = decode_frame(
+            encode_frame(_header(arrays), arrays)
+        )
+        assert verify_state_frame(header, decoded) is None
+
+    @pytest.mark.parametrize(
+        "mutate,why",
+        [
+            (lambda a: a["state_mij"].__setitem__((0, 0, 1), 99),
+             "Mij outside"),          # mij > iij
+            (lambda a: a["state_mij"].__setitem__((0, 1, 2), -1),
+             "Mij outside"),          # negative count
+            (lambda a: a["state_iij"].__setitem__((1, 2), 7),
+             "Iij outside"),          # iij > h_done (symmetrically ok)
+            (lambda a: a["state_mij"].__setitem__((0, 2, 2), 2),
+             "diag"),                 # diag(Mij) != diag(Iij)
+        ],
+    )
+    def test_invariant_breaches_refused_without_digest(self, mutate, why):
+        # Frames written from ALREADY-corrupt state digest consistently
+        # — only the invariants can refuse them (and old pre-digest
+        # frames verify on invariants alone).
+        arrays = _valid_state()
+        mutate(arrays)
+        reason = verify_state_frame(
+            _header(arrays, digest=False), arrays
+        )
+        assert reason is not None and why in reason
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_flip_array_bits_never_cancels(self, seed):
+        # Positions are drawn WITHOUT replacement: a duplicate would
+        # XOR-cancel and an armed fault plan would inject nothing —
+        # the chaos harness would then flag a healthy product as a
+        # silent corruption.  On a 4-element array with 3 flips any
+        # with-replacement draw collides for many seeds.
+        a = np.zeros(4, np.int32)
+        flip_array_bits(a, nbits=3, seed=seed)
+        assert int(np.count_nonzero(a)) == 3
+
+    def test_non_state_frames_pass(self):
+        # The verifier is generic over ring frames; one without state
+        # arrays (or digest) has nothing to refuse.
+        assert verify_state_frame({"h_done": 1}, {}) is None
+
+    def test_undecodable_npz_is_a_frame_error(self):
+        # Regression: corruption inside the npz payload used to escape
+        # decode_frame as zipfile.BadZipFile and CRASH the resume scan
+        # instead of falling back a generation.  Build a frame whose
+        # framing (lengths, CRC) is flawless but whose payload bytes
+        # are garbage — corruption that predates the CRC.
+        import struct
+        import zlib
+
+        arrays = _valid_state()
+        blob = encode_frame(_header(arrays), arrays)
+        magic_len = len(b"CCTPUBLK1\n")
+        body = bytearray(blob[magic_len:-4])
+        (hlen,) = struct.unpack("<Q", bytes(body[:8]))
+        for i in range(8 + hlen + 8, len(body)):
+            body[i] = 0xAB
+        frame = (
+            blob[:magic_len] + bytes(body)
+            + struct.pack("<I", zlib.crc32(bytes(body)))
+        )
+        with pytest.raises(CheckpointFrameError, match="undecodable"):
+            decode_frame(frame)
+
+
+class TestVerifiedResume:
+    def test_corrupt_generation_refused_falls_back(self, tmp_path):
+        ck = StreamCheckpointer(str(tmp_path), keep=2)
+        ck.write_async(_header(_valid_state(), digest=False),
+                       _valid_state())
+        faults.configure("checkpoint_payload=1:bitflip")
+        ck.write_async(
+            {"fingerprint": "fp", "block_index": 1, "h_done": 6},
+            _valid_state(h=6),
+        )
+        ck.flush()
+        assert faults.fired  # the corruption actually happened
+
+        # Without the gate the poisoned newest generation is served —
+        # that delta IS the feature under test.
+        header, _ = ck.latest("fp")
+        assert header["block_index"] == 1
+
+        header, arrays = ck.latest("fp", verify=verify_state_frame)
+        assert header["block_index"] == 0
+        assert ck.verify_rejects == 1
+        assert any("digest mismatch" in r for _, r in ck.skipped)
+        np.testing.assert_array_equal(
+            arrays["state_iij"], _valid_state()["state_iij"]
+        )
+        ck.close()
+
+    def test_frame_written_from_corrupt_state_refused(self, tmp_path):
+        # Digest can't catch this one (it faithfully digests the
+        # corrupt values) — the invariant re-check must.
+        ck = StreamCheckpointer(str(tmp_path))
+        good = _valid_state()
+        ck.write_async(_header(good), good)
+        bad = _valid_state(h=6)
+        bad["state_mij"][0, 0, 1] = 99  # > iij: impossible count
+        ck.write_async(
+            {"fingerprint": "fp", "block_index": 1, "h_done": 6}, bad
+        )
+        ck.flush()
+        header, _ = ck.latest("fp", verify=verify_state_frame)
+        assert header["block_index"] == 0
+        assert ck.verify_rejects == 1
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# Input admission
+
+
+class TestCheckInputMatrix:
+    def test_clean_matrix_passes(self, rng):
+        assert check_input_matrix(rng.normal(size=(10, 3))) is None
+
+    def test_constant_column_is_fine(self, rng):
+        x = rng.normal(size=(10, 3))
+        x[:, 1] = 5.0  # zero-variance FEATURE: harmless
+        assert check_input_matrix(x) is None
+
+    @pytest.mark.parametrize("val", [np.nan, np.inf, -np.inf])
+    def test_non_finite_reported_with_indices(self, val, rng):
+        x = rng.normal(size=(6, 4))
+        x[1, 2] = val
+        x[4, 0] = val
+        problem = check_input_matrix(x)
+        assert problem["code"] == "invalid_data"
+        assert problem["reason"] == "non_finite"
+        assert problem["rows"] == [1, 4]
+        assert problem["cols"] == [0, 2]
+        assert "row 1" in problem["error"]
+        assert problem["hint"]
+
+    def test_index_report_is_capped(self):
+        x = np.full((100, 2), np.nan)
+        problem = check_input_matrix(x, max_report=5)
+        assert len(problem["rows"]) == 5
+
+    def test_zero_variance_rejected(self):
+        problem = check_input_matrix(np.ones((8, 3)))
+        assert problem["reason"] == "zero_variance"
+
+    def test_single_row_not_zero_variance(self):
+        # One row has no pairs to disagree; shape gates live elsewhere.
+        assert check_input_matrix(np.ones((1, 3))) is None
+
+
+class TestAdmissionSurfaces:
+    def test_parse_job_spec_structured_400(self):
+        from consensus_clustering_tpu.serve.executor import (
+            InvalidDataError,
+            JobSpecError,
+            parse_job_spec,
+        )
+
+        body = {"data": [[1.0, 2.0], [float("nan"), 4.0], [5.0, 6.0]]}
+        with pytest.raises(InvalidDataError) as info:
+            parse_job_spec(body)
+        payload = info.value.payload
+        # The preflight-413 body shape: error + machine fields + hint.
+        assert payload["code"] == "invalid_data"
+        assert payload["reason"] == "non_finite"
+        assert payload["rows"] == [1] and payload["cols"] == [0]
+        assert payload["hint"]
+        # Still a JobSpecError: every existing 400 path keeps working.
+        assert isinstance(info.value, JobSpecError)
+
+    def test_parse_job_spec_zero_variance(self):
+        from consensus_clustering_tpu.serve.executor import (
+            InvalidDataError,
+            parse_job_spec,
+        )
+
+        with pytest.raises(InvalidDataError) as info:
+            parse_job_spec({"data": [[1.0, 2.0]] * 5})
+        assert info.value.payload["reason"] == "zero_variance"
+
+    def test_api_fit_rejects_poisoned_matrix(self, rng):
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        x = rng.normal(size=(20, 3))
+        x[7, 1] = np.nan
+        cc = ConsensusClustering(K_range=(2,), random_state=0,
+                                 plot_cdf=False)
+        with pytest.raises(ValueError, match="non-finite.*row 7"):
+            cc.fit(x)
+        assert not hasattr(cc, "cdf_at_K_data")  # failed BEFORE a sweep
+
+    def test_api_fit_rejects_zero_variance(self):
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        cc = ConsensusClustering(K_range=(2,), random_state=0,
+                                 plot_cdf=False)
+        with pytest.raises(ValueError, match="zero variance"):
+            cc.fit(np.ones((12, 3)))
+
+
+class _StubExecutor:
+    """Duck-typed executor: scripted results/errors, no JAX."""
+
+    def __init__(self, script=None):
+        self.run_count = 0
+        self.executable_cache_hits = 0
+        self._script = list(script or [])
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, spec, x, progress_cb=None):
+        self.run_count += 1
+        step = self._script.pop(0) if self._script else {"ok": True}
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+
+def _post(base, body):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        base + "/jobs", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestServiceInvalidData:
+    def test_structured_400_and_nothing_persisted(self, tmp_path):
+        from consensus_clustering_tpu.serve import ConsensusService
+
+        store = tmp_path / "store"
+        svc = ConsensusService(
+            store_dir=str(store), port=0, executor=_StubExecutor()
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{svc.port}"
+            code, body = _post(base, {
+                "data": [[1.0, float("inf")], [3.0, 4.0], [5.0, 6.0]],
+                "config": {"k": [2]},
+            })
+            assert code == 400
+            assert body["code"] == "invalid_data"
+            assert body["reason"] == "non_finite"
+            assert body["rows"] == [0] and body["cols"] == [1]
+            assert body["hint"]
+            # Rejected at parse time, BEFORE admission: no payload, no
+            # job record, no queue slot — a poisoned matrix leaves no
+            # trace to reconcile, GC, or resume.
+            assert not list((store / "payloads").iterdir())
+            assert not list((store / "jobs").iterdir())
+
+            code, body = _post(base, {"data": [[2.0, 2.0]] * 4})
+            assert code == 400 and body["reason"] == "zero_variance"
+            assert not list((store / "jobs").iterdir())
+
+            # The same surface still admits clean work.
+            code, rec = _post(base, {
+                "data": [[0.0, 0.1], [1.0, 1.1], [2.0, 1.9], [3.0, 3.2]],
+                "config": {"k": [2]},
+            })
+            assert code == 202 and rec["status"] == "queued"
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler counters + event
+
+
+class _IntegrityStub(_StubExecutor):
+    """First run hits a sentinel breach, the retry succeeds with
+    streaming stats — the executor-shaped script of a caught bitflip."""
+
+    def __init__(self):
+        super().__init__(script=[
+            IntegrityError(
+                "accumulator", "sentinel: block 3 corrupt",
+                block=3, details={"range_bad": 2}, checks_run=4,
+            ),
+            {"ok": True, "streaming": {"integrity_checks": 6}},
+        ])
+
+
+def _wait(sched, job_id, budget=30.0):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        rec = sched.get(job_id)
+        if rec["status"] in ("done", "failed", "timeout"):
+            return rec
+        time.sleep(0.01)
+    raise AssertionError("job never terminal")
+
+
+class TestSchedulerIntegrity:
+    def test_violation_counted_event_emitted_retried(self, tmp_path):
+        from consensus_clustering_tpu.serve import JobStore, Scheduler
+        from consensus_clustering_tpu.serve.events import EventLog
+        from consensus_clustering_tpu.serve.executor import parse_job_spec
+
+        events_path = str(tmp_path / "ev.jsonl")
+        sched = Scheduler(
+            _IntegrityStub(), JobStore(str(tmp_path / "store")),
+            max_retries=2, sleep=lambda _s: None,
+            events=EventLog(events_path),
+        )
+        sched.start()
+        try:
+            spec, x = parse_job_spec({
+                "data": [[0.0, 0.1], [1.0, 1.1], [2.0, 1.9],
+                         [3.0, 3.2]],
+                "config": {"k": [2], "iterations": 8},
+            })
+            rec = sched.submit(spec, x)
+            done = _wait(sched, rec["job_id"])
+            assert done["status"] == "done"
+            m = sched.metrics()
+            assert m["integrity_violations_total"] == {"accumulator": 1}
+            # 4 checks from the violated attempt (via the exception) +
+            # 6 from the successful retry's streaming stats.
+            assert m["integrity_checks_total"] == 10
+            assert m["retry_total"] == {"corrupt:accumulator": 1}
+            with open(events_path) as f:
+                events = [json.loads(line) for line in f]
+            hits = [e for e in events
+                    if e["event"] == "integrity_violation"]
+            assert len(hits) == 1
+            assert hits[0]["point"] == "accumulator"
+            assert hits[0]["block"] == 3
+            assert hits[0]["details"] == {"range_bad": 2}
+            retries = [e for e in events if e["event"] == "job_retry"]
+            assert retries and retries[0]["reason"] == (
+                "corrupt:accumulator"
+            )
+        finally:
+            sched.stop()
+
+    def test_checks_counted_when_attempt_dies_of_something_else(
+        self, tmp_path
+    ):
+        # An attempt that ran sentinel checks and then died of an
+        # UNRELATED retryable error must not lose them: the streaming
+        # driver attaches the count to the exception.
+        from consensus_clustering_tpu.serve import JobStore, Scheduler
+        from consensus_clustering_tpu.serve.executor import parse_job_spec
+
+        boom = RuntimeError("socket closed")  # retryable: device
+        boom.integrity_checks_run = 5
+        sched = Scheduler(
+            _StubExecutor(script=[
+                boom, {"ok": True, "streaming": {"integrity_checks": 2}},
+            ]),
+            JobStore(str(tmp_path)),
+            max_retries=2, sleep=lambda _s: None,
+        )
+        sched.start()
+        try:
+            spec, x = parse_job_spec({
+                "data": [[0.0, 0.1], [1.0, 1.1], [2.0, 1.9],
+                         [3.0, 3.2]],
+                "config": {"k": [2], "iterations": 8},
+            })
+            rec = sched.submit(spec, x)
+            assert _wait(sched, rec["job_id"])["status"] == "done"
+            m = sched.metrics()
+            assert m["integrity_checks_total"] == 7  # 5 failed + 2 ok
+            assert m["integrity_violations_total"] == {"accumulator": 0}
+        finally:
+            sched.stop()
+
+    def test_counters_pre_seeded(self, tmp_path):
+        from consensus_clustering_tpu.serve import JobStore, Scheduler
+
+        m = Scheduler(_StubExecutor(), JobStore(str(tmp_path))).metrics()
+        assert m["integrity_checks_total"] == 0
+        assert m["integrity_violations_total"] == {
+            p: 0 for p in INTEGRITY_POINTS
+        }
+        assert m["checkpoint_verify_rejects_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Config + fingerprint stability
+
+
+class TestConfigKnob:
+    @pytest.mark.parametrize("bad", [-1, True, 1.5])
+    def test_validation(self, bad):
+        from consensus_clustering_tpu.config import SweepConfig
+
+        with pytest.raises(ValueError, match="integrity_check_every"):
+            SweepConfig(n_samples=20, n_features=3,
+                        integrity_check_every=bad)
+
+    def test_executor_validation(self):
+        from consensus_clustering_tpu.serve.executor import SweepExecutor
+
+        with pytest.raises(ValueError, match="integrity_check_every"):
+            SweepExecutor(use_compilation_cache=False,
+                          integrity_check_every=-1)
+
+    def test_ring_keep_outlasts_detection_lag(self):
+        # With a check every C blocks and a checkpoint every W, up to
+        # ceil(C/W) generations can be written from corrupt state
+        # before detection: retention must cover them plus one clean
+        # generation, or a caught corruption restarts from zero.
+        from consensus_clustering_tpu.serve.executor import ring_keep
+
+        assert ring_keep(0, 1) == 2          # sentinel off: historical 2
+        assert ring_keep(1, 1) == 2          # lag <= 1 corrupt gen
+        assert ring_keep(4, 1) == 5          # serve defaults
+        assert ring_keep(4, 2) == 3
+        assert ring_keep(8, 4) == 3
+        assert ring_keep(1, 4) == 2
+        for c in range(1, 12):
+            for w in range(1, 5):
+                lag = -(-c // w)  # max corrupt generations in the ring
+                assert ring_keep(c, w) >= lag + 1
+
+    def test_fingerprints_ignore_the_observer_knob(self):
+        # The sentinel only READS state: a cadence change must not
+        # invalidate per-K checkpoints or block rings.
+        from consensus_clustering_tpu.config import SweepConfig
+        from consensus_clustering_tpu.utils.checkpoint import (
+            _fingerprint,
+            stream_fingerprint,
+        )
+
+        a = SweepConfig(n_samples=20, n_features=3,
+                        stream_h_block=4, integrity_check_every=0)
+        b = SweepConfig(n_samples=20, n_features=3,
+                        stream_h_block=4, integrity_check_every=4)
+        assert _fingerprint(a, 23) == _fingerprint(b, 23)
+        assert stream_fingerprint(a, 23, "d" * 16) == (
+            stream_fingerprint(b, 23, "d" * 16)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Quirk Q9 regression: never-co-sampled pairs
+
+
+class TestQuirkQ9:
+    """Pin the reference's Q9 semantics under strict numerics: a pair
+    that was NEVER co-sampled (Iij == 0) yields a finite consensus of
+    ~0 — not NaN, not Inf — and NaN appears ONLY where Monti's
+    definitions demand it (consensus statistics over empty pair sets).
+    """
+
+    def test_never_cosampled_pair_is_finite_zero(self):
+        from consensus_clustering_tpu.ops.analysis import consensus_matrix
+
+        mij = np.zeros((3, 3), np.int32)
+        iij = np.zeros((3, 3), np.int32)
+        # Points 0 and 1 co-sampled twice and always co-clustered;
+        # point 2 never co-sampled with anyone (a rare-but-real outcome
+        # of subsampling at small H).
+        iij[:2, :2] = 2
+        np.fill_diagonal(iij, 2)
+        mij[:2, :2] = 2
+        np.fill_diagonal(mij, 2)
+        cij = np.asarray(consensus_matrix(mij, iij))
+        assert np.isfinite(cij).all()
+        np.testing.assert_allclose(cij[0, 2], 0.0, atol=1e-9)
+        np.testing.assert_allclose(np.diagonal(cij), 1.0)  # forced
+        np.testing.assert_allclose(cij[0, 1], 1.0, rtol=1e-5)
+
+    def test_nan_only_where_the_definition_demands(self):
+        from consensus_clustering_tpu.ops.analysis import (
+            cluster_consensus,
+            item_consensus,
+        )
+
+        cij = np.eye(4)
+        cij[0, 1] = cij[1, 0] = 0.8
+        labels = np.array([0, 0, 1, 2])  # clusters 1, 2 are singletons
+        per_cluster = cluster_consensus(cij, labels)
+        assert np.isfinite(per_cluster[0])  # a real pair exists
+        assert np.isnan(per_cluster[1]) and np.isnan(per_cluster[2])
+
+        per_item = item_consensus(cij, labels)
+        # m_i(k) is NaN exactly when cluster k has no member != i.
+        assert np.isnan(per_item[2, 1])   # item 2 vs its own singleton
+        assert np.isnan(per_item[3, 2])
+        finite_expected = ~np.array([
+            [False, False, False],
+            [False, False, False],
+            [False, True, False],
+            [False, False, True],
+        ])
+        assert (np.isfinite(per_item) == finite_expected).all()
+
+
+# ---------------------------------------------------------------------------
+# Slow lane: the real engine through both corruption classes
+
+
+@pytest.fixture(scope="module")
+def _engine_and_data():
+    from sklearn.datasets import make_blobs
+
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.streaming import StreamingSweep
+
+    x, _ = make_blobs(n_samples=60, n_features=4, centers=3,
+                      random_state=0)
+    x = x.astype(np.float32)
+    config = SweepConfig(
+        n_samples=60, n_features=4, k_values=(2, 3), n_iterations=24,
+        store_matrices=False, stream_h_block=4,
+    )
+    return StreamingSweep(KMeans(n_init=2), config), x
+
+
+@pytest.mark.slow
+class TestEngineIntegritySlow:
+    def test_sentinel_parity_and_detection_and_recovery(
+        self, _engine_and_data, tmp_path
+    ):
+        engine, x = _engine_and_data
+        base = engine.run(x, seed=5, n_iterations=24)
+
+        # Parity: the sentinel only reads state — bit-identical curves
+        # at the tightest cadence, with every block checked.
+        checked = engine.run(
+            x, seed=5, n_iterations=24, integrity_check_every=1
+        )
+        np.testing.assert_array_equal(base["cdf"], checked["cdf"])
+        np.testing.assert_array_equal(
+            base["pac_area"], checked["pac_area"]
+        )
+        assert checked["streaming"]["integrity_checks"] == 6
+
+        # Detection: an HBM bitflip at block 2 is caught AT block 2 —
+        # before its curves enter the trajectory or its state the ring.
+        ck = StreamCheckpointer(str(tmp_path / "ring"))
+        faults.configure("accumulator=2:bitflip")
+        with pytest.raises(IntegrityError) as info:
+            engine.run(
+                x, seed=5, n_iterations=24, checkpointer=ck,
+                integrity_check_every=1,
+            )
+        assert info.value.point == "accumulator"
+        assert info.value.block == 2
+        assert info.value.details  # which invariants tripped
+
+        # Recovery: the retry resumes from the ring (whose newest
+        # generation predates the corruption) and lands bit-identical.
+        resumed = engine.run(
+            x, seed=5, n_iterations=24, checkpointer=ck,
+            integrity_check_every=1,
+        )
+        assert resumed["streaming"]["resumed_from_block"] == 2
+        np.testing.assert_array_equal(base["cdf"], resumed["cdf"])
+        ck.close()
+
+    def test_coarse_cadence_interim_generations_refused(
+        self, _engine_and_data, tmp_path
+    ):
+        """The two-layer composition at check cadences > 1: a block
+        corrupted between checks IS checkpointed before detection, and
+        only the resume-time verifier keeps the retry off it (the
+        docstring's 'neither alone suffices')."""
+        engine, x = _engine_and_data
+        base = engine.run(x, seed=5, n_iterations=24)
+
+        ck = StreamCheckpointer(str(tmp_path / "ring3"))
+        # Block 2 is NOT check-due at cadence 2 (checks at 1, 3, 5):
+        # gen 2 is written from corrupt state before block 3's check
+        # detects the breach.
+        faults.configure("accumulator=2:bitflip")
+        with pytest.raises(IntegrityError) as info:
+            engine.run(
+                x, seed=5, n_iterations=24, checkpointer=ck,
+                integrity_check_every=2,
+            )
+        assert info.value.block == 3
+
+        resumed = engine.run(
+            x, seed=5, n_iterations=24, checkpointer=ck,
+            integrity_check_every=2,
+        )
+        # The poisoned interim generation was refused (invariant
+        # breach — its digest faithfully matches the corrupt state)
+        # and the retry replayed from the clean gen 1.
+        assert ck.verify_rejects >= 1
+        assert any("invariant" in r for _, r in ck.skipped)
+        assert resumed["streaming"]["resumed_from_block"] == 2
+        np.testing.assert_array_equal(base["cdf"], resumed["cdf"])
+        ck.close()
+
+    def test_adaptive_stop_checks_every_block(self, _engine_and_data):
+        """Adaptive early stop must not bypass the sentinel: the stop
+        can land on ANY block, so a coarse cadence collapses to
+        every-block — an early-stopped run never ships curves the
+        sentinel did not see."""
+        engine, x = _engine_and_data
+        out = engine.run(
+            x, seed=5, n_iterations=24,
+            adaptive_tol=10.0, adaptive_patience=2,
+            integrity_check_every=4,
+        )
+        assert out["streaming"]["stopped_early"] is True
+        # Every evaluated block was checked despite cadence 4.
+        assert out["streaming"]["integrity_checks"] == (
+            out["streaming"]["n_blocks_run"]
+        )
+
+    def test_corrupt_terminal_generation_verified_fallback(
+        self, _engine_and_data, tmp_path
+    ):
+        engine, x = _engine_and_data
+        base = engine.run(x, seed=5, n_iterations=24)
+
+        ring = str(tmp_path / "ring2")
+        ck = StreamCheckpointer(ring)
+        faults.configure("checkpoint_payload=5:bitflip")
+        first = engine.run(x, seed=5, n_iterations=24, checkpointer=ck)
+        ck.close()
+        # The live run is unharmed (its answer came from device state),
+        # but the ring's newest generation now lies under a valid CRC.
+        np.testing.assert_array_equal(base["cdf"], first["cdf"])
+
+        ck2 = StreamCheckpointer(ring)
+        again = engine.run(x, seed=5, n_iterations=24, checkpointer=ck2)
+        assert ck2.verify_rejects == 1
+        assert any("digest mismatch" in r for _, r in ck2.skipped)
+        # Fell back to gen 4 and recomputed the final block — not the
+        # poisoned terminal short-circuit (which would be block 6).
+        assert again["streaming"]["resumed_from_block"] == 5
+        np.testing.assert_array_equal(base["cdf"], again["cdf"])
+        np.testing.assert_array_equal(
+            base["pac_area"], again["pac_area"]
+        )
+        ck2.close()

@@ -168,6 +168,8 @@ def test_metrics_schema(base):
         "checkpoint_writes_total", "checkpoint_resume_total", "retry_total",
         "autotune_provenance_total", "jobs_wedged_total",
         "jobs_quarantined", "jobs_shed_total", "preflight_rejects_total",
+        "integrity_checks_total", "integrity_violations_total",
+        "checkpoint_verify_rejects_total",
     ):
         assert field in m, field
     assert isinstance(m["retry_total"], dict)
@@ -175,6 +177,11 @@ def test_metrics_schema(base):
     # Pre-seeded with every priority at construction (the dict-copy-
     # races-first-insert class): the key set never changes.
     assert set(m["jobs_shed_total"]) == {"high", "normal", "low"}
+    # Same pre-seed rule for the integrity breach points — and ONLY
+    # reachable points: checkpoint-layer refusals are recovery, counted
+    # in checkpoint_verify_rejects_total, never a violation key that
+    # cannot fire.
+    assert set(m["integrity_violations_total"]) == {"accumulator"}
 
 
 def test_events_jsonl_lifecycle(base, service):
