@@ -1,0 +1,426 @@
+"""Latency probe: prove the observability layer against real traffic.
+
+The trace/metrics/drift layer (docs/OBSERVABILITY.md) exists so every
+future perf/robustness claim is observable from a LIVE service — so it
+is itself proven live, not with unit stubs.  This harness launches a
+service subprocess (the chaos_soak launcher) and drives it through
+three phases, asserting the layer's contracts:
+
+- **load** — tens of concurrent jobs; every one completes; the latency
+  histograms (end-to-end job, queue wait, block seconds, checkpoint
+  writes) carry the expected observation counts with bucket key sets
+  that are IDENTICAL before and after the traffic (the pre-seeded
+  /metrics schema never changes at runtime); the Prometheus exposition
+  (``GET /metrics.prom``) passes the strict text-format checker; and a
+  sampled job's span tree (``queue_wait`` → ``attempt`` → ``compile``/
+  ``execute`` → per-block ``h_block``/``host_evaluate``/
+  ``checkpoint_write``) is complete in the JSONL event log, keyed by
+  trace_id == job_id;
+- **drift** — an injected per-block slowdown (``CCTPU_FAULTS``
+  ``slow``) drives the perf-regression watchdog: the service emits
+  ``perf_drift`` with the correct shape bucket and a ratio below the
+  configured band, visible in ``/metrics`` — while the job itself still
+  completes (a regression is not a failure);
+- **profile** — ``serve-admin profile-next`` arms a one-shot
+  ``jax.profiler`` trace; the next executed job captures it
+  (``profile_captured`` event, non-empty trace directory, counter).
+
+Schedules::
+
+    python benchmarks/latency_probe.py --schedule smoke   # CI (12 jobs)
+    python benchmarks/latency_probe.py --schedule load    # 40 jobs, 2 buckets
+
+Prints a JSON report; exits non-zero on any violation.  CPU-pinned like
+every CI harness.
+"""
+
+import argparse
+import concurrent.futures
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.join(BENCH_DIR, os.pardir)
+sys.path.insert(0, BENCH_DIR)
+sys.path.insert(0, REPO_ROOT)
+
+from chaos_soak import ServiceProc, Violation, _events  # noqa: E402
+
+from consensus_clustering_tpu.obs.prom import (  # noqa: E402 — stdlib-only
+    validate_exposition,
+)
+
+#: Span names every completed streamed job must have emitted at least
+#: once (the end-to-end tree of docs/OBSERVABILITY.md).
+EXPECTED_SPANS = frozenset(
+    {
+        "queue_wait", "attempt", "compile", "execute",
+        "h_block", "host_evaluate", "checkpoint_write",
+    }
+)
+
+HIST_NAMES = (
+    "job_seconds", "queue_wait_seconds", "block_seconds",
+    "checkpoint_write_seconds",
+)
+
+
+def _body(seed, n=40, d=3, k=(2, 3), iters=16):
+    """Deterministic two-blob job body (stdlib RNG — the probe process
+    never imports numpy/jax; the service owns the heavy stack)."""
+    import random
+
+    rng = random.Random(seed)
+    half = n // 2
+    data = [
+        [rng.gauss(0.0 if i < half else 3.0, 0.4) for _ in range(d)]
+        for i in range(n)
+    ]
+    return {
+        "data": data,
+        "config": {
+            "k": list(k), "iterations": iters, "seed": seed,
+            "stream_h_block": 4,
+        },
+    }
+
+
+def _get_text(base, path):
+    with urllib.request.urlopen(base + path, timeout=60) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+def _check_exposition(svc, report_slot):
+    code, headers, text = _get_text(svc.base, "/metrics.prom")
+    if code != 200:
+        raise Violation(f"/metrics.prom returned {code}")
+    if not headers.get("Content-Type", "").startswith("text/plain"):
+        raise Violation(
+            f"/metrics.prom Content-Type {headers.get('Content-Type')!r}"
+        )
+    problems = validate_exposition(text)
+    if problems:
+        raise Violation(
+            f"Prometheus exposition failed the strict checker: "
+            f"{problems[:5]}"
+        )
+    for needle in (
+        "cctpu_jobs_completed", "cctpu_job_seconds_bucket{le=",
+        'le="+Inf"', "cctpu_perf_drift_enabled",
+        "cctpu_backend_info{backend=",
+    ):
+        if needle not in text:
+            raise Violation(f"exposition missing {needle!r}")
+    # The alias route serves the identical families.
+    code_q, _, text_q = _get_text(svc.base, "/metrics?format=prom")
+    if code_q != 200 or "cctpu_jobs_completed" not in text_q:
+        raise Violation("/metrics?format=prom alias broken")
+    report_slot["prom_lines"] = len(text.splitlines())
+
+
+def phase_load(root, report, n_jobs, buckets):
+    """Concurrent traffic; histograms/spans/exposition/key-stability."""
+    store = os.path.join(root, "load_store")
+    events_path = os.path.join(root, "load_events.jsonl")
+    svc = ServiceProc(
+        store,
+        extra_args=["--queue-size", "64", "--no-shed"],
+        events_path=events_path,
+    )
+    try:
+        m0 = svc.get("/metrics")
+        hist0 = m0["latency_histograms"]
+        for name in HIST_NAMES:
+            if name not in hist0:
+                raise Violation(f"latency_histograms missing {name}")
+            if hist0[name]["count"] != 0:
+                raise Violation(f"{name} not born at zero")
+        bodies = []
+        for i in range(n_jobs):
+            n = 40 + 16 * (i % buckets)  # 1 or 2 shape buckets
+            bodies.append(_body(1000 + i, n=n))
+
+        def submit(body):
+            code, rec, _ = svc.post("/jobs", body)
+            if code != 202:
+                raise Violation(f"submission got {code}, expected 202")
+            return rec["job_id"]
+
+        t0 = time.time()
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            job_ids = list(pool.map(submit, bodies))
+        for job_id in job_ids:
+            record = svc.poll_job(job_id, budget=600)
+            if record["status"] != "done":
+                raise Violation(
+                    f"job {job_id} ended {record['status']}: "
+                    f"{record.get('error')}"
+                )
+        wall = time.time() - t0
+
+        m1 = svc.get("/metrics")
+        if set(m1) != set(m0):
+            raise Violation(
+                "/metrics top-level key set changed under traffic: "
+                f"{sorted(set(m1) ^ set(m0))}"
+            )
+        hist1 = m1["latency_histograms"]
+        for name in HIST_NAMES:
+            if set(hist1[name]["buckets"]) != set(hist0[name]["buckets"]):
+                raise Violation(
+                    f"{name} bucket key set changed under traffic"
+                )
+            # Numeric le order (the HTTP JSON is sort_keys, which is
+            # lexicographic — "10" sorts before "2").
+            ordered = sorted(
+                hist1[name]["buckets"].items(),
+                key=lambda kv: (
+                    float("inf") if kv[0] == "+Inf" else float(kv[0])
+                ),
+            )
+            cum = [v for _, v in ordered]
+            if any(b > a for b, a in zip(cum, cum[1:])):
+                raise Violation(f"{name} buckets not cumulative")
+            if cum[-1] != hist1[name]["count"]:
+                raise Violation(f"{name} +Inf bucket != count")
+        blocks_per_job = 4  # iters=16 / stream_h_block=4
+        if hist1["job_seconds"]["count"] != n_jobs:
+            raise Violation(
+                f"job_seconds count {hist1['job_seconds']['count']} "
+                f"!= {n_jobs} executed jobs"
+            )
+        if hist1["queue_wait_seconds"]["count"] != n_jobs:
+            raise Violation("queue_wait_seconds count != executed jobs")
+        if hist1["block_seconds"]["count"] < n_jobs * blocks_per_job:
+            raise Violation(
+                f"block_seconds count {hist1['block_seconds']['count']} "
+                f"< {n_jobs * blocks_per_job} evaluated blocks"
+            )
+        if hist1["checkpoint_write_seconds"]["count"] < n_jobs:
+            raise Violation("checkpoint_write_seconds count < jobs")
+        if hist1["job_seconds"]["sum"] <= 0:
+            raise Violation("job_seconds sum not positive")
+
+        _check_exposition(svc, report)
+
+        # Span tree for one executed job (trace_id == job_id).
+        sample = job_ids[0]
+        spans = [
+            e for e in _events(events_path)
+            if e.get("event") == "span" and e.get("trace_id") == sample
+        ]
+        names = {s["name"] for s in spans}
+        missing = EXPECTED_SPANS - names
+        if missing:
+            raise Violation(f"job {sample} missing spans: {sorted(missing)}")
+        h_blocks = [s for s in spans if s["name"] == "h_block"]
+        if len(h_blocks) != blocks_per_job:
+            raise Violation(
+                f"{len(h_blocks)} h_block spans, expected "
+                f"{blocks_per_job}"
+            )
+        by_id = {s["span_id"]: s for s in spans}
+        for s in h_blocks:
+            parent = by_id.get(s.get("parent_span_id"))
+            if parent is None or parent["name"] != "execute":
+                raise Violation("h_block span not parented under execute")
+        report["load"] = {
+            "jobs": n_jobs,
+            "buckets": buckets,
+            "wall_seconds": round(wall, 1),
+            "job_seconds_count": hist1["job_seconds"]["count"],
+            "block_seconds_count": hist1["block_seconds"]["count"],
+            "span_names": sorted(names),
+            "metrics_keys_stable": True,
+        }
+    finally:
+        svc.stop()
+
+
+def phase_drift(root, report):
+    """Injected per-block slowdown ⇒ perf_drift with the right bucket
+    and ratio, in the event log AND /metrics, with the job completing."""
+    store = os.path.join(root, "drift_store")
+    events_path = os.path.join(root, "drift_events.jsonl")
+    iters, block = 32, 4  # 8 blocks; anchor forms at 4, fault at 5
+    band_low = 0.55
+    svc = ServiceProc(
+        store,
+        env_faults="block_start=5:slow:3",
+        extra_args=[
+            "--drift-anchor-blocks", "4",
+            "--drift-band", f"{band_low}:3.0",
+            # The slow block must read as DRIFT, not as a wedge: keep
+            # the hang watchdog's floor above the injected sleep.
+            "--wedge-floor", "30",
+        ],
+        events_path=events_path,
+    )
+    try:
+        body = _body(2000, n=40, k=(2,), iters=iters)
+        _, rec, _ = svc.post("/jobs", body)
+        record = svc.poll_job(rec["job_id"], budget=600)
+        if record["status"] != "done":
+            raise Violation(
+                f"slowed job ended {record['status']} — a throughput "
+                "regression must not fail the job"
+            )
+        expected_bucket = f"n40_d3_h{iters}_k2-2"
+        drifts = [
+            e for e in _events(events_path) if e["event"] == "perf_drift"
+        ]
+        if not drifts:
+            raise Violation(
+                "no perf_drift event — the injected slowdown went "
+                "undetected"
+            )
+        hit = drifts[0]
+        if hit["bucket"] != expected_bucket:
+            raise Violation(
+                f"perf_drift bucket {hit['bucket']!r}, expected "
+                f"{expected_bucket!r}"
+            )
+        if not hit["ratio"] < band_low:
+            raise Violation(
+                f"perf_drift ratio {hit['ratio']} not below the "
+                f"{band_low} band edge"
+            )
+        if hit["anchor_provenance"] not in ("observed", "calibrated"):
+            raise Violation(
+                f"bad anchor provenance {hit['anchor_provenance']!r}"
+            )
+        m = svc.get("/metrics")
+        drift = m["perf_drift"]
+        if drift["flagged_total"].get(expected_bucket, 0) < 1:
+            raise Violation("perf_drift.flagged_total not counted")
+        if drift["ratio"].get(expected_bucket) is None:
+            raise Violation("perf_drift.ratio missing the bucket")
+        if m["perf_drift_events_total"] < 1:
+            raise Violation("perf_drift_events_total not counted")
+        _check_exposition(svc, {})
+        report["drift"] = {
+            "bucket": hit["bucket"],
+            "ratio": hit["ratio"],
+            "anchor_rate": hit["anchor_rate"],
+            "anchor_provenance": hit["anchor_provenance"],
+            "flagged_total": drift["flagged_total"],
+            "job_completed": True,
+        }
+    finally:
+        svc.stop()
+
+
+def phase_profile(root, report):
+    """serve-admin profile-next ⇒ the next executed job runs under a
+    jax.profiler trace (event + non-empty dir + counter)."""
+    store = os.path.join(root, "profile_store")
+    events_path = os.path.join(root, "profile_events.jsonl")
+    trace_dir = os.path.join(root, "profile_trace")
+    # Profiler startup lengthens the engine_ready→first-block window;
+    # under the launcher's tight 3 s wedge floor that reads as a wedge
+    # and the profiled attempt is abandoned (documented in
+    # docs/OBSERVABILITY.md "profile-next").  Keep the floor realistic.
+    svc = ServiceProc(
+        store, extra_args=["--wedge-floor", "30"],
+        events_path=events_path,
+    )
+    try:
+        admin = subprocess.run(
+            [sys.executable, "-m", "consensus_clustering_tpu",
+             "serve-admin", "--store-dir", store,
+             "profile-next", trace_dir],
+            cwd=REPO_ROOT, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120,
+        )
+        if admin.returncode != 0:
+            raise Violation(
+                f"serve-admin profile-next failed: {admin.stderr}"
+            )
+        _, rec, _ = svc.post("/jobs", _body(3000, k=(2,), iters=12))
+        record = svc.poll_job(rec["job_id"], budget=600)
+        if record["status"] != "done":
+            raise Violation(f"profiled job ended {record['status']}")
+        captured = [
+            e for e in _events(events_path)
+            if e["event"] == "profile_captured"
+        ]
+        if not captured:
+            raise Violation("no profile_captured event")
+        if captured[0]["job_id"] != rec["job_id"]:
+            raise Violation("profile_captured names the wrong job")
+        found = [
+            os.path.join(dirpath, f)
+            for dirpath, _, files in os.walk(trace_dir)
+            for f in files
+        ]
+        if not found:
+            raise Violation(
+                f"profiler trace dir {trace_dir} is empty — no trace "
+                "was captured"
+            )
+        m = svc.get("/metrics")
+        if m["profile_requests_total"] != 1:
+            raise Violation(
+                f"profile_requests_total={m['profile_requests_total']}, "
+                "expected 1 (the arm is one-shot)"
+            )
+        # One-shot: a second job must NOT be traced.
+        _, rec2, _ = svc.post("/jobs", _body(3001, k=(2,), iters=12))
+        svc.poll_job(rec2["job_id"], budget=600)
+        if svc.get("/metrics")["profile_requests_total"] != 1:
+            raise Violation("profile arm was consumed more than once")
+        report["profile"] = {
+            "trace_files": len(found),
+            "profile_requests_total": 1,
+            "one_shot": True,
+        }
+    finally:
+        svc.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--schedule", choices=["smoke", "load"], default="smoke")
+    p.add_argument("--out", default=None, help="write the JSON report here")
+    p.add_argument("--root", default=None,
+                   help="work directory (default: a fresh temp dir)")
+    args = p.parse_args(argv)
+
+    root = args.root or tempfile.mkdtemp(prefix="latency_probe_")
+    os.makedirs(root, exist_ok=True)
+    report = {"schedule": args.schedule, "root": root}
+    violations = []
+    n_jobs, buckets = (12, 1) if args.schedule == "smoke" else (40, 2)
+
+    phases = [
+        ("load", lambda: phase_load(root, report, n_jobs, buckets)),
+        ("drift", lambda: phase_drift(root, report)),
+        ("profile", lambda: phase_profile(root, report)),
+    ]
+    for name, fn in phases:
+        t0 = time.time()
+        try:
+            fn()
+            print(f"phase {name}: ok ({time.time() - t0:.1f}s)",
+                  file=sys.stderr)
+        except Violation as e:
+            violations.append({"phase": name, "violation": str(e)})
+            print(f"phase {name}: VIOLATION: {e}", file=sys.stderr)
+
+    report["violations"] = violations
+    report["passed"] = not violations
+    blob = json.dumps(report, indent=1, sort_keys=True)
+    print(blob)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

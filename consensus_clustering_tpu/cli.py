@@ -265,6 +265,20 @@ def cmd_serve(args):
         from consensus_clustering_tpu.autotune.store import CalibrationStore
 
         calibration = CalibrationStore(args.calibration_dir)
+    from consensus_clustering_tpu.obs.drift import DriftWatchdog
+
+    try:
+        lo_s, _, hi_s = args.drift_band.partition(":")
+        drift = DriftWatchdog(
+            band=(float(lo_s), float(hi_s)),
+            anchor_blocks=args.drift_anchor_blocks,
+            enabled=not args.no_drift_watchdog,
+        )
+    except ValueError as e:
+        raise SystemExit(
+            f"serve: --drift-band {args.drift_band!r} / "
+            f"--drift-anchor-blocks {args.drift_anchor_blocks}: {e}"
+        )
     executor = SweepExecutor(
         # 0 = resolve per job through the autotune policy: a calibrated
         # block size for this (environment, shape bucket) when the
@@ -276,6 +290,7 @@ def cmd_serve(args):
         checkpoint_every=args.checkpoint_every,
         calibration_store=calibration,
         integrity_check_every=args.integrity_every,
+        drift_watchdog=drift,
     )
     # Bounded backend init BEFORE binding the port or reconciling jobs:
     # a wedged device plugin (the r02-r05 `backend init hung` failure)
@@ -577,6 +592,22 @@ def main(argv=None):
                          "from CCTPU_MEMORY_BUDGET, the device's "
                          "bytes_limit, or host RAM; 'off' disables the "
                          "413 gate; an integer pins bytes")
+    # Observability (docs/OBSERVABILITY.md): the perf-regression
+    # watchdog over live per-bucket resamples/s.
+    serve_p.add_argument("--no-drift-watchdog", action="store_true",
+                         help="disable the perf-drift watchdog (live "
+                         "per-bucket throughput vs its calibrated/"
+                         "observed anchor; perf_drift events + "
+                         "/metrics ratios)")
+    serve_p.add_argument("--drift-band", default="0.6:1.8",
+                         metavar="LOW:HIGH",
+                         help="acceptable live/anchor throughput ratio "
+                         "band; outside it the bucket flags perf_drift "
+                         "(default 0.6:1.8)")
+    serve_p.add_argument("--drift-anchor-blocks", type=int, default=12,
+                         help="evaluated blocks before a bucket with "
+                         "no calibration record self-anchors on its "
+                         "own block-time EWMA (default 12)")
     serve_p.add_argument("--no-shed", action="store_true",
                          help="disable priority-aware overload shedding "
                          "(admission then only bounds at --queue-size)")

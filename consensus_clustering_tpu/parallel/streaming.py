@@ -472,6 +472,7 @@ class StreamingSweep:
         adaptive_min_h: Optional[int] = None,
         checkpointer: Optional["StreamCheckpointer"] = None,
         integrity_check_every: Optional[int] = None,
+        tracer=None,
     ) -> Dict[str, Any]:
         """Stream the sweep; returns host-side results + streaming stats.
 
@@ -525,6 +526,19 @@ class StreamingSweep:
         those interim generations are refused, and the retry replays
         from the last *verified* generation either way.  The two
         layers compose; neither alone suffices.
+
+        ``tracer`` (duck-typed: the :class:`~consensus_clustering_tpu.
+        obs.tracing.Tracer` ``record(name, seconds, **fields)``
+        surface; the serve executor passes a generation-guarded child
+        of its ``execute`` span) emits the driver's timed spans —
+        ``resume_restore`` when a checkpoint generation is restored,
+        and per evaluated block ``h_block`` (wall-clock between
+        consecutive block evaluations: the honest streamed cost under
+        the double-buffered pipeline, NOT isolated device time),
+        ``host_evaluate`` (the device→host curves pull, which is also
+        the completion barrier) and ``integrity_check`` (judging the
+        sentinel's scalars).  ``None`` (the default, and every batch
+        path) costs nothing.
 
         Overlap caveat: with state donation OFF (the CPU default —
         see the ``CCTPU_STREAM_DONATE`` note in the class docstring)
@@ -601,6 +615,7 @@ class StreamingSweep:
             # trusted — the ring falls back past CRC-valid frames whose
             # content lies (resilience.integrity, docs/SERVING.md
             # "Integrity runbook").
+            t_resume = time.perf_counter()
             resume = checkpointer.latest(ckpt_fp, verify=verify_state_frame)
             if resume is not None:
                 header, arrays = resume
@@ -647,11 +662,23 @@ class StreamingSweep:
                     start_block - 1, h_effective, n_iterations,
                     ", terminal" if resume_terminal else "",
                 )
+                if tracer is not None:
+                    # Scan + verify + device_put of the restored state.
+                    tracer.record(
+                        "resume_restore",
+                        time.perf_counter() - t_resume,
+                        resumed_from_block=start_block,
+                        h_done=h_effective,
+                        terminal=resume_terminal,
+                    )
         if state is None:
             state = self.init_state()
         integrity_checks = 0
         # (block, device curves, state snapshot, sentinel scalars)
         pending = None
+        # Span clock: per-block wall is evaluate-to-evaluate (the
+        # honest streamed cost under the pipeline — see the docstring).
+        last_eval_done = [time.perf_counter()]
 
         def h_done(b: int) -> int:
             return min((b + 1) * self._hb_pad, n_iterations)
@@ -684,13 +711,24 @@ class StreamingSweep:
             trajectory and its state must never enter the ring."""
             nonlocal prev_pac, quiet, result_curves, h_effective
             nonlocal integrity_checks
+            block_wall = time.perf_counter() - last_eval_done[0]
             if check is not None:
+                t_check = time.perf_counter()
                 integrity_checks += 1
                 bad = {
                     name: int(v)
                     for name, v in check.items()
                     if int(v)
                 }
+                if tracer is not None:
+                    # The host-side judge (the int() pulls sync the
+                    # sentinel's device scalars); emitted before a
+                    # breach raises — the check ran either way.
+                    tracer.record(
+                        "integrity_check",
+                        time.perf_counter() - t_check,
+                        block=b, violations=len(bad),
+                    )
                 if bad:
                     raise IntegrityError(
                         "accumulator",
@@ -702,9 +740,19 @@ class StreamingSweep:
                         details=bad,
                         checks_run=integrity_checks,
                     )
+            t_eval = time.perf_counter()
             host = {
                 name: np.asarray(v) for name, v in curves.items()
             }
+            if tracer is not None:
+                # The device→host curves pull doubles as the block's
+                # completion barrier, so this span is barrier-honest
+                # (jaxlint JL007's rule) by construction.
+                tracer.record(
+                    "host_evaluate",
+                    time.perf_counter() - t_eval,
+                    block=b,
+                )
             result_curves = host
             h_effective = h_done(b)
             pac = host["pac_area"]
@@ -746,6 +794,11 @@ class StreamingSweep:
                     },
                     arrays,
                 )
+            if tracer is not None:
+                tracer.record(
+                    "h_block", block_wall, block=b, h_done=h_effective,
+                )
+            last_eval_done[0] = time.perf_counter()
             return stop
 
         try:

@@ -155,10 +155,19 @@ class StreamCheckpointer:
     ``every`` sets the cadence (checkpoint each ``every``-th evaluated
     block; the final block of a run is always written so a completed
     run's terminal state is durable).  ``keep`` sizes the generation
-    ring.
+    ring.  ``on_write(seconds, block_index)``, if given, is invoked on
+    the WRITER thread after each completed write — the observability
+    layer's per-write latency feed (histogram + ``checkpoint_write``
+    span); a callback failure is logged and never fails durability.
     """
 
-    def __init__(self, directory: str, every: int = 1, keep: int = 2):
+    def __init__(
+        self,
+        directory: str,
+        every: int = 1,
+        keep: int = 2,
+        on_write: Optional[Callable[[float, int], None]] = None,
+    ):
         if every < 1:
             raise ValueError(f"every must be >= 1, got {every}")
         if keep < 1:
@@ -166,6 +175,7 @@ class StreamCheckpointer:
         self.directory = directory
         self.every = every
         self.keep = keep
+        self.on_write = on_write
         self.writes_total = 0
         self.write_seconds_total = 0.0
         #: Incremented by the streaming driver when a run actually
@@ -321,8 +331,15 @@ class StreamCheckpointer:
         os.replace(tmp, final)  # atomic: no torn gen-*.ckpt, ever
         faults.fire("checkpoint_post_write", index=block)
         self._prune(keep_latest=block)
+        seconds = time.perf_counter() - t0
         self.writes_total += 1
-        self.write_seconds_total += time.perf_counter() - t0
+        self.write_seconds_total += seconds
+        if self.on_write is not None:
+            try:
+                self.on_write(seconds, block)
+            except Exception as e:  # noqa: BLE001 — telemetry must
+                # never fail durability (the write already landed).
+                logger.warning("checkpoint on_write observer failed: %s", e)
 
     # A temp file younger than this is treated as a LIVE write, not
     # crash garbage: a second checkpointer can share the directory (an

@@ -27,6 +27,10 @@ test instead of trusted:
       block_start=1:oom        raise a RESOURCE_EXHAUSTED-worded
                                RuntimeError (classify_error triages it
                                "retryable"/"oom", like a real device OOM)
+      block_start=5:slow:4     sleep 4 s there and CONTINUE (no error):
+                               a throughput regression, not a failure —
+                               what the perf-drift watchdog exists to
+                               catch (default 1 s when unspecified)
       checkpoint_mid_write=1   raise with a torn temp file half-written
       checkpoint_post_write=0:kill   die after the atomic rename
       accumulator=2:bitflip    flip 1 bit in the block-2 device
@@ -69,7 +73,7 @@ from typing import Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _ENV = "CCTPU_FAULTS"
-_ACTIONS = ("raise", "kill", "hang", "oom", "bitflip")
+_ACTIONS = ("raise", "kill", "hang", "oom", "bitflip", "slow")
 _KILL_EXIT_CODE = 137  # what a SIGKILL'd process reports (128 + 9)
 # A 'hang' with no duration: long enough that nothing short of the hang
 # watchdog (or the end of the test process) notices the thread again —
@@ -133,12 +137,18 @@ class IntegrityError(RuntimeError):
         super().__init__(message)
 
 
+#: A 'slow' with no duration: one second is enough to move a block-time
+#: EWMA far outside any sane drift band at test shapes without holding
+#: a CI job hostage.
+_DEFAULT_SLOW_SECONDS = 1.0
+
+
 @dataclasses.dataclass
 class _Rule:
     point: str
     index: int
     action: str
-    seconds: float = _DEFAULT_HANG_SECONDS  # hang duration (hang only)
+    seconds: float = _DEFAULT_HANG_SECONDS  # duration (hang/slow only)
     nbits: int = 1  # bits to flip (bitflip only)
 
 
@@ -151,14 +161,17 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
         try:
             point, rest = entry.split("=", 1)
             index_s, _, action = rest.partition(":")
-            # hang takes an optional duration ("hang" or "hang:30"),
+            # hang/slow take an optional duration ("hang" or "hang:30"),
             # bitflip an optional bit count ("bitflip" or "bitflip:3").
             action = action or "raise"
             base, _, arg = action.partition(":")
-            seconds = _DEFAULT_HANG_SECONDS
+            seconds = (
+                _DEFAULT_SLOW_SECONDS if base == "slow"
+                else _DEFAULT_HANG_SECONDS
+            )
             nbits = 1
             if arg:
-                if base == "hang":
+                if base in ("hang", "slow"):
                     seconds = float(arg)
                     if seconds < 0:
                         raise ValueError(arg)
@@ -167,7 +180,7 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
                     if nbits < 1:
                         raise ValueError(arg)
                 else:
-                    raise ValueError(arg)  # only hang/bitflip take args
+                    raise ValueError(arg)  # only timed/bitflip take args
             rule = _Rule(
                 point.strip(), int(index_s), base, seconds, nbits
             )
@@ -175,7 +188,7 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
             raise ValueError(
                 f"bad fault spec entry {entry!r}: expected "
                 "point=index[:action] with action raise | kill | "
-                "hang[:seconds] | oom | bitflip[:nbits]"
+                "hang[:seconds] | oom | bitflip[:nbits] | slow[:seconds]"
             )
         if rule.action not in _ACTIONS:
             raise ValueError(
@@ -250,6 +263,17 @@ class FaultInjector:
                 f"injected hang at {point}[{index}] "
                 f"(slept {rule.seconds:.1f}s)"
             )
+        if rule.action == "slow":
+            # A pure throughput regression: the work completes, only
+            # slower — the drift-watchdog driver.  Unlike hang, nothing
+            # is raised: the run must SUCCEED with degraded timing, or
+            # the perf_drift signal would be confounded with a retry.
+            logger.warning(
+                "fault injection: slowing %.1fs at %s[%d]",
+                rule.seconds, point, index,
+            )
+            time.sleep(rule.seconds)
+            return
         if rule.action == "oom":
             logger.warning(
                 "fault injection: raising OOM at %s[%d]", point, index

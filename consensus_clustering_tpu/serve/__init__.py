@@ -20,7 +20,11 @@ Durability rides on :mod:`consensus_clustering_tpu.resilience`: job
 payloads and per-fingerprint block-checkpoint rings persist in the
 jobstore, retries and restarts resume from the last completed block
 (docs/SERVING.md "Crash recovery"); the hostile-path layer on top is
-docs/SERVING.md "Overload & wedge runbook".
+docs/SERVING.md "Overload & wedge runbook".  Observability rides on
+:mod:`consensus_clustering_tpu.obs` (docs/OBSERVABILITY.md): trace
+spans over the event log, latency histograms + a perf-drift snapshot
+in ``/metrics``, a Prometheus exposition at ``/metrics.prom``, and the
+``serve-admin profile-next`` one-shot profiler.
 """
 
 import importlib

@@ -10,6 +10,17 @@ the service.
     python -m consensus_clustering_tpu serve-admin --store-dir DIR list
     python -m consensus_clustering_tpu serve-admin --store-dir DIR show JOB_ID
     python -m consensus_clustering_tpu serve-admin --store-dir DIR release JOB_ID
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR \
+        profile-next TRACE_DIR
+
+``profile-next`` arms a ONE-SHOT ``jax.profiler`` trace: the live
+service claims the arm before its next executed job and runs that job's
+first attempt under the profiler, writing the trace into ``TRACE_DIR``
+and emitting a ``profile_captured`` event (docs/OBSERVABILITY.md).
+Unlike ``release`` it takes effect on a RUNNING service — the scheduler
+polls the control file per job — which is the point: bench.py's
+``--profile-dir`` machinery, reachable without restarting a loaded
+service.
 
 ``release`` resets the payload's restart counter and flips the record
 back to ``queued``; the NEXT service start over the store re-queues it
@@ -154,6 +165,24 @@ def release_job(store_dir: str, job_id: str) -> Dict[str, Any]:
     return record
 
 
+def arm_profile_next(store_dir: str, profile_dir: str) -> str:
+    """Write the one-shot profile-next control file (stdlib mirror of
+    ``JobStore.arm_profile`` — same path, same atomic-rename rule, so
+    the two implementations cannot drift without a test catching it).
+    Returns the control-file path."""
+    control_dir = os.path.join(store_dir, "control")
+    os.makedirs(control_dir, exist_ok=True)
+    path = os.path.join(control_dir, "profile_next.json")
+    _atomic_write_json(
+        path,
+        {
+            "profile_dir": os.path.abspath(profile_dir),
+            "armed_at": round(time.time(), 3),
+        },
+    )
+    return path
+
+
 def add_arguments(parser) -> None:
     parser.add_argument(
         "--store-dir", required=True,
@@ -171,6 +200,13 @@ def add_arguments(parser) -> None:
         "effect at the next service start over this store)",
     )
     release.add_argument("job_id")
+    profile = sub.add_parser(
+        "profile-next",
+        help="arm a one-shot jax.profiler trace of the NEXT job the "
+        "live service executes, written into PROFILE_DIR (the service "
+        "claims the arm per job — no restart needed)",
+    )
+    profile.add_argument("profile_dir", metavar="PROFILE_DIR")
 
 
 def cmd_serve_admin(args) -> int:
@@ -207,5 +243,15 @@ def cmd_serve_admin(args) -> int:
             "startup)."
         )
         print(json.dumps(record, indent=1, sort_keys=True, default=float))
+        return 0
+    if args.admin_cmd == "profile-next":
+        path = arm_profile_next(args.store_dir, args.profile_dir)
+        print(
+            f"armed: the NEXT job the live service executes will run "
+            f"its first attempt under a jax.profiler trace into "
+            f"{os.path.abspath(args.profile_dir)} (control file "
+            f"{path}; one-shot — re-arm for another capture). Watch "
+            "for the profile_captured event."
+        )
         return 0
     return 2

@@ -46,6 +46,25 @@ Data-integrity events (docs/SERVING.md "Integrity runbook"):
   violation counts); followed by ``job_retry`` with reason
   ``corrupt:<point>`` — the retry resumes from the last VERIFIED
   checkpoint generation
+
+Observability events (docs/OBSERVABILITY.md):
+
+- ``span``            — one timed operation in a job's execution tree
+  (name, trace_id — the job_id for serve jobs — span_id,
+  parent_span_id, seconds, status, per-span fields); emitted at span
+  END by the scheduler (``queue_wait``, per-``attempt``), the executor
+  (``compile``, ``execute``, ``checkpoint_write``) and the streaming
+  driver (``resume_restore``, ``h_block``, ``host_evaluate``,
+  ``integrity_check``)
+- ``perf_drift``      — a shape bucket's live throughput left the
+  configured band around its anchor (bucket, ratio, live_rate,
+  anchor_rate, anchor_provenance: calibrated | observed, band_low,
+  band_high, observations); one event per excursion, re-armed when the
+  ratio returns in band — the perf-regression watchdog's operator
+  signal
+- ``profile_captured``— a one-shot ``serve-admin profile-next`` arm was
+  consumed: the named job's first attempt ran under a ``jax.profiler``
+  trace (job_id, profile_dir)
 """
 
 from __future__ import annotations
@@ -64,10 +83,22 @@ class EventLog:
 
     ``path=None`` logs via :mod:`logging` only — the service always has an
     event stream, a file just makes it durable.
+
+    ``log_level`` sets the level the logging mirror uses.  Default:
+    ``DEBUG`` when a file sink is configured, ``INFO`` otherwise — with
+    a file the JSONL stream IS the record, and mirroring every event
+    (per-block spans included) to stderr at INFO under load duplicates
+    the whole stream into the process log.
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(
+        self, path: Optional[str] = None, log_level: Optional[int] = None
+    ):
         self.path = path
+        self.log_level = (
+            log_level if log_level is not None
+            else (logging.DEBUG if path else logging.INFO)
+        )
         self._lock = threading.Lock()
 
     def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
@@ -79,5 +110,5 @@ class EventLog:
             with self._lock:
                 with open(self.path, "a") as f:
                     f.write(line + "\n")
-        logger.info("serve event: %s", line)
+        logger.log(self.log_level, "serve event: %s", line)
         return record

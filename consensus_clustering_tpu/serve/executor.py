@@ -32,6 +32,7 @@ and are dropped.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import hashlib
 import json
@@ -42,6 +43,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.obs.drift import DriftWatchdog
+from consensus_clustering_tpu.obs.histograms import LatencyHistogram
+from consensus_clustering_tpu.obs.tracing import Tracer
 
 _CLUSTERERS = ("kmeans", "gmm", "agglomerative", "spectral")
 
@@ -413,6 +417,7 @@ class SweepExecutor:
         checkpoint_every: int = 1,
         calibration_store=None,
         integrity_check_every: int = 0,
+        drift_watchdog: Optional[DriftWatchdog] = None,
     ):
         if default_h_block is not None and default_h_block < 1:
             raise ValueError(
@@ -481,6 +486,21 @@ class SweepExecutor:
         # or invariant breach — resilience.integrity): each one is a
         # corrupt frame that recovery correctly fell back past.
         self.checkpoint_verify_rejects_total = 0
+        # Observability layer (docs/OBSERVABILITY.md): fixed-bucket
+        # latency histograms for the two distributions this class
+        # observes first-hand — evaluated H-block wall-clock (fed by
+        # the same callback as the wedge EWMA) and checkpoint-write
+        # seconds (fed from the writer thread) — plus the per-bucket
+        # perf-drift watchdog over live resamples/s vs the calibrated
+        # (or self-observed) anchor.  The scheduler surfaces all three
+        # in /metrics; tests/test_serve.py pins the attribute names so
+        # a rename cannot silently report zeros forever.
+        self.hist_block_seconds = LatencyHistogram()
+        self.hist_checkpoint_write_seconds = LatencyHistogram()
+        self.drift = (
+            drift_watchdog if drift_watchdog is not None
+            else DriftWatchdog()
+        )
         self._engines: Dict[str, Any] = {}
         self._lock = threading.Lock()
         # Serialises build+compile per process, separate from _lock: a
@@ -707,6 +727,8 @@ class SweepExecutor:
         block_cb: Optional[Callable[[int, int, list], None]] = None,
         checkpoint_dir: Optional[str] = None,
         heartbeat=None,
+        tracer: Optional[Tracer] = None,
+        profile_dir: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Execute one streamed sweep; returns the JSON-able result.
 
@@ -730,7 +752,19 @@ class SweepExecutor:
         watchdog reads.  Block completions also feed the per-bucket
         block-time EWMA (:meth:`expected_block_seconds`) regardless of
         callbacks, so the watchdog's deadline tightens as the bucket
-        warms.
+        warms — plus, via the observability layer, the block-seconds
+        latency histogram and the perf-drift watchdog's per-bucket
+        resamples/s ledger (docs/OBSERVABILITY.md).
+
+        ``tracer`` (an :class:`~consensus_clustering_tpu.obs.tracing.
+        Tracer` the scheduler binds to its event log, trace_id=job_id)
+        makes the execution emit timed spans — ``compile``,
+        ``execute``, ``checkpoint_write``, and the streaming driver's
+        per-block tree under them.  Spans from an abandoned
+        (timed-out/wedged) attempt are generation-guarded like every
+        other late emission.  ``profile_dir`` wraps THIS execution in a
+        ``jax.profiler`` trace (the ``serve-admin profile-next``
+        one-shot).
         """
         from consensus_clustering_tpu.ops.analysis import (
             area_under_cdf,
@@ -749,11 +783,49 @@ class SweepExecutor:
         if heartbeat is not None:
             heartbeat.beat(PHASE_ENGINE_READY)
 
+        with self._lock:
+            self._cb_gen += 1
+            gen = self._cb_gen
+
+        def _live() -> bool:
+            with self._lock:
+                return self._cb_gen == gen
+
+        # Spans from an abandoned attempt must drop exactly like its
+        # block/K events: the executor-side tracer re-checks the
+        # generation at every emission (the scheduler's tracer itself
+        # cannot — it outlives attempts).
+        span_tracer = None
+        if tracer is not None:
+            parent_sink = tracer.sink
+
+            def _guarded_sink(payload):
+                if _live():
+                    parent_sink(payload)
+
+            span_tracer = Tracer(
+                _guarded_sink, tracer.trace_id, tracer.parent_span_id
+            )
+            span_tracer.record(
+                "compile", compile_seconds, cached=cached,
+                stream_h_block=resolution.value,
+            )
+
         checkpointer = None
         if checkpoint_dir is not None:
             from consensus_clustering_tpu.resilience.blocks import (
                 StreamCheckpointer,
             )
+
+            def on_ckpt_write(seconds, block):
+                # Writer-thread feed: real disk-write latency whatever
+                # the attempt's fate (the write happened), but the span
+                # is generation-guarded via the tracer's sink.
+                self.hist_checkpoint_write_seconds.observe(seconds)
+                if span_tracer is not None:
+                    span_tracer.record(
+                        "checkpoint_write", seconds, block=block
+                    )
 
             checkpointer = StreamCheckpointer(
                 checkpoint_dir,
@@ -764,21 +836,36 @@ class SweepExecutor:
                 keep=ring_keep(
                     self.integrity_check_every, self.checkpoint_every
                 ),
+                on_write=on_ckpt_write,
             )
 
-        with self._lock:
-            self._cb_gen += 1
-            gen = self._cb_gen
+        # The drift watchdog keys on the CALIBRATION bucket string
+        # (exact-match with any stream_h_block record for this shape),
+        # and its anchor comes from the resolution's record when one
+        # steered this bucket — the calibration-anchored half; buckets
+        # with no record self-anchor on their own warmed-up EWMA.
+        from consensus_clustering_tpu.autotune.policy import (
+            PROVENANCE_CALIBRATED,
+        )
+        from consensus_clustering_tpu.autotune.store import shape_bucket
 
-        def _live() -> bool:
-            with self._lock:
-                return self._cb_gen == gen
+        drift_bucket = shape_bucket(n, d, spec.n_iterations, spec.k_values)
+        calibrated_rate = None
+        if resolution.provenance == PROVENANCE_CALIBRATED and (
+            resolution.record or {}
+        ).get("rate"):
+            try:
+                calibrated_rate = float(resolution.record["rate"])
+            except (TypeError, ValueError):
+                calibrated_rate = None
+        n_k = len(spec.k_values)
 
         # One internal per-block hook, always installed: the EWMA and
         # the heartbeat must advance even for callers that didn't ask
         # for block events (a wedge is a wedge whether or not anyone
         # subscribed to progress).
         last_block_at = [time.monotonic()]
+        last_h_done = [None]
 
         def guarded_block_cb(block, h_done, pac_list):
             if not _live():
@@ -787,34 +874,89 @@ class SweepExecutor:
                 # 0.3-weighted sample of hours would inflate the wedge
                 # deadline for this bucket — blinding the watchdog the
                 # stall proved necessary.  Nothing from a dead
-                # generation may feed the EWMA, the heartbeat, or the
-                # event stream.
+                # generation may feed the EWMA, the heartbeat, the
+                # histograms, the drift ledger, or the event stream.
                 return
             now = time.monotonic()
-            self._observe_block_seconds(
-                bucket_key, now - last_block_at[0]
+            dt = now - last_block_at[0]
+            self._observe_block_seconds(bucket_key, dt)
+            self.hist_block_seconds.observe(dt)
+            # Credit the drift ledger with the block's ACTUAL resamples
+            # (its h_done advance): H values that don't divide the
+            # block size truncate the final block, and crediting it a
+            # full block would read as a phantom speedup every job.
+            # First observed block of a resumed run: h_done includes
+            # the restored prefix, so fall back to one full block.
+            prev_h = last_h_done[0]
+            # First callback of a RESUMED run: h_done already includes
+            # the restored prefix, and dt includes the checkpoint
+            # scan/verify/restore — neither a block's work nor a
+            # block's time, so it must not feed the drift ledger (a
+            # restore stall is recovery, not a regression).
+            resumed_first = (
+                prev_h is None and h_done > int(resolution.value)
             )
+            delta_h = (
+                h_done - prev_h if prev_h is not None
+                else min(int(resolution.value), int(h_done))
+            )
+            last_h_done[0] = h_done
+            if delta_h > 0 and not resumed_first:
+                self.drift.observe(
+                    drift_bucket, dt, float(delta_h) * n_k,
+                    calibrated_rate=calibrated_rate,
+                )
             last_block_at[0] = now
             if heartbeat is not None:
                 heartbeat.beat(f"block:{block}")
             if block_cb is not None:
                 block_cb(block, h_done, pac_list)
 
+        execute_span = None
+        stream_tracer = None
+        if span_tracer is not None:
+            execute_span = span_tracer.span(
+                "execute", h_requested=int(spec.n_iterations),
+            )
+            stream_tracer = span_tracer.child(execute_span.span_id)
+        if profile_dir is not None:
+            import jax
+
+            profile_ctx = jax.profiler.trace(profile_dir)
+        else:
+            profile_ctx = contextlib.nullcontext()
         try:
             t0 = time.perf_counter()
-            host = engine.run(
-                x, spec.seed, spec.n_iterations,
-                block_callback=guarded_block_cb,
-                adaptive_tol=spec.adaptive_tol,
-                adaptive_patience=spec.adaptive_patience,
-                adaptive_min_h=spec.adaptive_min_h,
-                checkpointer=checkpointer,
-                integrity_check_every=self.integrity_check_every,
-            )
+            with profile_ctx:
+                # Clock from AFTER profiler startup (seconds of stall
+                # on first use): it would otherwise land in the first
+                # block's dt and fire a false perf_drift on a warm
+                # bucket every profiled job.
+                last_block_at[0] = time.monotonic()
+                host = engine.run(
+                    x, spec.seed, spec.n_iterations,
+                    block_callback=guarded_block_cb,
+                    adaptive_tol=spec.adaptive_tol,
+                    adaptive_patience=spec.adaptive_patience,
+                    adaptive_min_h=spec.adaptive_min_h,
+                    checkpointer=checkpointer,
+                    integrity_check_every=self.integrity_check_every,
+                    tracer=stream_tracer,
+                )
             # engine.run's curves copies are the completion barrier
             # (run_sweep's rule: block_until_ready can return early on
             # some platforms).
             run_seconds = time.perf_counter() - t0
+            if execute_span is not None:
+                execute_span.end(
+                    h_effective=int(host["streaming"]["h_effective"]),
+                )
+        except BaseException as e:
+            if execute_span is not None:
+                execute_span.end(
+                    status="error", error_type=type(e).__name__
+                )
+            raise
         finally:
             with self._lock:
                 self.run_count += 1

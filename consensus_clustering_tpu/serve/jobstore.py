@@ -62,10 +62,15 @@ class JobStore:
         self.jobs_dir = os.path.join(directory, "jobs")
         self.payloads_dir = os.path.join(directory, "payloads")
         self.checkpoints_dir = os.path.join(directory, "checkpoints")
+        # Operator control surface (serve-admin writes here with the
+        # same atomic-rename discipline; the scheduler polls/claims):
+        # today one file, profile_next.json.
+        self.control_dir = os.path.join(directory, "control")
         os.makedirs(self.results_dir, exist_ok=True)
         os.makedirs(self.jobs_dir, exist_ok=True)
         os.makedirs(self.payloads_dir, exist_ok=True)
         os.makedirs(self.checkpoints_dir, exist_ok=True)
+        os.makedirs(self.control_dir, exist_ok=True)
         self._sweep_stale_tmps()
         self._sweep_stale_checkpoints()
         self._sweep_orphan_payloads()
@@ -137,6 +142,7 @@ class JobStore:
         now = time.time()
         for directory in (
             self.results_dir, self.jobs_dir, self.payloads_dir,
+            self.control_dir,
         ):
             for name in os.listdir(directory):
                 # Canonical names are <hex>.json / <hex>.npy; every
@@ -343,6 +349,61 @@ class JobStore:
             shutil.rmtree(self.checkpoint_dir(fingerprint))
         except (OSError, ValueError):
             pass
+
+    # -- profiling control (serve-admin profile-next) --------------------
+
+    def _profile_request_path(self) -> str:
+        return os.path.join(self.control_dir, "profile_next.json")
+
+    def arm_profile(self, profile_dir: str) -> str:
+        """Arm a one-shot ``jax.profiler`` trace of the next executed
+        job into ``profile_dir`` (docs/OBSERVABILITY.md).  Atomic write
+        — arming again before a claim just replaces the target dir.
+        ``serve-admin profile-next`` writes the SAME file stdlib-only;
+        this method is the in-process spelling (tests, embedders)."""
+        path = self._profile_request_path()
+        tmp = f"{path}.{uuid.uuid4().hex}.tmp"
+        os.makedirs(self.control_dir, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    # abspath, matching serve-admin's spelling: the
+                    # trace must land where the ARMER meant, not
+                    # relative to the service process's cwd.
+                    "profile_dir": os.path.abspath(str(profile_dir)),
+                    "armed_at": round(time.time(), 3),
+                },
+                f, sort_keys=True,
+            )
+        os.replace(tmp, path)
+        return path
+
+    def claim_profile(self) -> Optional[str]:
+        """Consume an armed profile request; returns its target dir or
+        None.  The claim is the ``os.replace`` to a unique name — two
+        racing workers cannot both win, and a crash mid-claim leaves at
+        most a stale ``.claimed`` temp (swept by the tmp GC)."""
+        path = self._profile_request_path()
+        if not os.path.exists(path):  # cheap fast path, checked per job
+            return None
+        claimed = f"{path}.{uuid.uuid4().hex}.tmp"
+        try:
+            os.replace(path, claimed)
+        except FileNotFoundError:
+            return None  # another worker won the claim
+        try:
+            with open(claimed) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = None
+        finally:
+            try:
+                os.remove(claimed)
+            except OSError:
+                pass
+        if not isinstance(payload, dict) or not payload.get("profile_dir"):
+            return None  # malformed arm: consumed, logged by caller
+        return str(payload["profile_dir"])
 
     def iter_jobs(self):
         """Yield every stored (job_id, record) pair — the scheduler's

@@ -73,6 +73,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from consensus_clustering_tpu.obs.drift import DriftWatchdog
+from consensus_clustering_tpu.obs.histograms import LatencyHistogram
+from consensus_clustering_tpu.obs.tracing import Tracer
 from consensus_clustering_tpu.resilience.faults import (
     IntegrityError,
     classify_error,
@@ -173,6 +176,36 @@ class ShedPolicy:
             return f"queue at {depth}/{capacity} (normal watermark)"
         return None
 
+
+# Duck-typed executor counters surfaced by metrics(): /metrics key ->
+# SweepExecutor attribute name.  getattr keeps stub executors valid,
+# but a getattr default also means a RENAMED executor attribute would
+# silently report 0 forever — so tests/test_serve.py asserts every
+# attribute here exists on the real SweepExecutor class.
+_EXECUTOR_COUNTER_ATTRS = {
+    "executable_cache_hits": "executable_cache_hits",
+    "executable_cache_misses": "executable_cache_misses",
+    "h_requested_total": "h_requested_total",
+    "h_effective_total": "h_effective_total",
+    "checkpoint_writes_total": "checkpoint_writes_total",
+    "checkpoint_resume_total": "checkpoint_resume_total",
+    "checkpoint_verify_rejects_total": "checkpoint_verify_rejects_total",
+}
+
+# Executor-owned observability OBJECTS metrics() snapshots (same
+# rename-risk contract as the counter map above): the two histograms
+# the executor feeds first-hand, and the drift watchdog.
+_EXECUTOR_OBJECT_ATTRS = (
+    "hist_block_seconds",
+    "hist_checkpoint_write_seconds",
+    "drift",
+)
+
+# Stub-safe zero sources: a duck-typed executor without the obs layer
+# still yields the full, fixed /metrics key set (never observed into —
+# snapshot-only).
+_ZERO_HISTOGRAM = LatencyHistogram()
+_ZERO_DRIFT = DriftWatchdog(enabled=False)
 
 # Statuses that never transition again: once mirrored to the jobstore,
 # records in these states are served from disk and evicted from memory.
@@ -275,6 +308,33 @@ class Scheduler:
         # Wedge verdict timestamps inside the shed policy's window —
         # the wedge-rate pressure signal.  Guarded by _lock.
         self._recent_wedges: List[float] = []
+        # Observability layer (docs/OBSERVABILITY.md), all pre-seeded:
+        # the two latency distributions this class observes first-hand
+        # (end-to-end job seconds over executed jobs, admission-to-
+        # pickup queue wait), the perf_drift event counter, and the
+        # profile-next one-shots consumed.  The executor owns the
+        # block/checkpoint-write histograms and the drift ledger;
+        # metrics() composes all of it into one snapshot.
+        self.hist_job_seconds = LatencyHistogram()
+        self.hist_queue_wait_seconds = LatencyHistogram()
+        self.perf_drift_events_total = 0
+        self.profile_requests_total = 0
+        # Wire the executor's drift watchdog (when it has one) to this
+        # scheduler's event log + counter: the watchdog computes the
+        # verdicts, the scheduler owns the operator surfaces.
+        drift = getattr(self.executor, "drift", None)
+        if drift is not None and hasattr(drift, "set_emitter"):
+            drift.set_emitter(self._on_perf_drift)
+
+    def _on_perf_drift(self, **payload) -> None:
+        """Drift-watchdog emitter: one JSONL event + counter per
+        excursion (docs/OBSERVABILITY.md "Drift watchdog")."""
+        with self._lock:
+            self.perf_drift_events_total += 1
+        self.events.emit("perf_drift", **payload)
+
+    def _span_sink(self, payload: Dict[str, Any]) -> None:
+        self.events.emit("span", **payload)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -620,6 +680,22 @@ class Scheduler:
         return self._queue.qsize()
 
     def metrics(self) -> Dict[str, Any]:
+        # Executor-side reads go through _EXECUTOR_COUNTER_ATTRS /
+        # _EXECUTOR_OBJECT_ATTRS (one table, schema-tested against the
+        # real SweepExecutor) so a renamed attribute fails a test
+        # instead of silently reporting 0 forever.
+        executor_counters = {
+            key: getattr(self.executor, attr, 0)
+            for key, attr in _EXECUTOR_COUNTER_ATTRS.items()
+        }
+        hist_block = getattr(
+            self.executor, "hist_block_seconds", _ZERO_HISTOGRAM
+        )
+        hist_ckpt = getattr(
+            self.executor, "hist_checkpoint_write_seconds",
+            _ZERO_HISTOGRAM,
+        )
+        drift = getattr(self.executor, "drift", _ZERO_DRIFT)
         with self._lock:
             return {
                 "queue_depth": self._queue.qsize(),
@@ -629,31 +705,12 @@ class Scheduler:
                 "jobs_retried": self.jobs_retried,
                 "jobs_timed_out": self.jobs_timed_out,
                 "cache_hits": self.cache_hits,
-                "executable_cache_hits": self.executor.executable_cache_hits,
-                # The H-agnostic bucket win, observable: misses count
-                # block-program compiles, and hits/misses together show
-                # jobs differing only in H sharing one warm executable.
-                # getattr keeps duck-typed stub executors valid.
-                "executable_cache_misses": getattr(
-                    self.executor, "executable_cache_misses", 0
-                ),
-                # Adaptive early stop, aggregated: resamples requested
-                # vs actually run across every executed job.
-                "h_requested_total": getattr(
-                    self.executor, "h_requested_total", 0
-                ),
-                "h_effective_total": getattr(
-                    self.executor, "h_effective_total", 0
-                ),
-                # Resilience counters: blocks checkpointed, runs that
-                # actually restored state, retries by triage reason,
-                # and orphans re-queued at startup.
-                "checkpoint_writes_total": getattr(
-                    self.executor, "checkpoint_writes_total", 0
-                ),
-                "checkpoint_resume_total": getattr(
-                    self.executor, "checkpoint_resume_total", 0
-                ),
+                # The H-agnostic bucket win (hits/misses: jobs
+                # differing only in H sharing one warm executable),
+                # adaptive savings (h_requested vs h_effective), and
+                # the resilience counters — all duck-typed reads via
+                # the schema-tested attribute table above.
+                **executor_counters,
                 "retry_total": dict(self.retry_total),
                 "jobs_requeued": self.jobs_requeued,
                 # Hostile-path counters (docs/SERVING.md "Overload &
@@ -666,16 +723,12 @@ class Scheduler:
                 "preflight_rejects_total": self.preflight_rejects_total,
                 "memory_budget_bytes": self.memory_budget_bytes,
                 # Silent-corruption defense (docs/SERVING.md "Integrity
-                # runbook"): sentinel evaluations, breaches by
-                # detection point (retried as corrupt:<point>), and
-                # checkpoint generations the verified-resume gate
-                # refused.  All pre-seeded.
+                # runbook"): sentinel evaluations and breaches by
+                # detection point (retried as corrupt:<point>).  All
+                # pre-seeded.
                 "integrity_checks_total": self.integrity_checks_total,
                 "integrity_violations_total": dict(
                     self.integrity_violations_total
-                ),
-                "checkpoint_verify_rejects_total": getattr(
-                    self.executor, "checkpoint_verify_rejects_total", 0
                 ),
                 # Block-size resolution tiers over executed jobs
                 # (docs/AUTOTUNE.md "Provenance"): whether calibration
@@ -684,6 +737,23 @@ class Scheduler:
                 "autotune_provenance_total": dict(getattr(
                     self.executor, "autotune_provenance", {}
                 ) or {}),
+                # Observability layer (docs/OBSERVABILITY.md): fixed-
+                # bucket latency histograms (key set and bucket bounds
+                # never change at runtime — every bucket pre-seeded),
+                # the per-bucket perf-drift snapshot, and the two
+                # scalar obs counters.  Histogram snapshots copy under
+                # each histogram's own lock; the drift snapshot under
+                # the watchdog's.
+                "latency_histograms": {
+                    "job_seconds": self.hist_job_seconds.snapshot(),
+                    "queue_wait_seconds":
+                        self.hist_queue_wait_seconds.snapshot(),
+                    "block_seconds": hist_block.snapshot(),
+                    "checkpoint_write_seconds": hist_ckpt.snapshot(),
+                },
+                "perf_drift": drift.snapshot(),
+                "perf_drift_events_total": self.perf_drift_events_total,
+                "profile_requests_total": self.profile_requests_total,
                 "sweeps_executed": self.executor.run_count,
                 "backend": self.executor.backend(),
             }
@@ -837,6 +907,16 @@ class Scheduler:
             spec = self._specs.pop(job_id)
             x = self._data.pop(job_id)
             fp = record["fingerprint"]
+            submitted_at = float(record.get("submitted_at") or time.time())
+
+        # Observability (docs/OBSERVABILITY.md): one trace per job,
+        # trace_id = job_id, spans ride the JSONL event stream.  The
+        # queue wait — admission to worker pickup — is the span whose
+        # start predates this method, so it is recorded retroactively.
+        tracer = Tracer(self._span_sink, trace_id=job_id)
+        queue_wait = max(0.0, time.time() - submitted_at)
+        self.hist_queue_wait_seconds.observe(queue_wait)
+        tracer.record("queue_wait", queue_wait)
 
         # Late dedup: submission-time dedup misses a twin that was
         # still RUNNING (its result not yet stored), and a restart can
@@ -876,9 +956,23 @@ class Scheduler:
         # Duck-typed executors (test stubs) may not stream; only a real
         # streaming executor gets the per-block callback, the
         # checkpoint ring (the resume surface), and the hang watchdog's
-        # heartbeat/expectation plumbing.
+        # heartbeat/expectation plumbing.  The observability kwargs
+        # (tracer, profile_dir) gate on the obs layer specifically —
+        # pre-obs streaming-shaped stubs keep their narrower run()
+        # signatures.
         run_kwargs: Dict[str, Any] = {}
         streaming_executor = hasattr(self.executor, "default_h_block")
+        obs_executor = hasattr(self.executor, "hist_block_seconds")
+        profile_dir = None
+        if obs_executor:
+            # serve-admin profile-next: a one-shot arm traces the next
+            # executed job.  Claimed (consumed) here, attached to the
+            # FIRST attempt only — a retry under the profiler would
+            # overwrite the trace the operator asked for.
+            profile_dir = self.store.claim_profile()
+            if profile_dir is not None:
+                with self._lock:
+                    self.profile_requests_total += 1
         expected_block_fn = None
         if streaming_executor:
             run_kwargs["block_cb"] = block_cb
@@ -910,14 +1004,38 @@ class Scheduler:
                 started_at=round(time.time(), 3),
             )
             self.events.emit("job_started", job_id=job_id, attempt=attempt)
+            attempt_kwargs = dict(run_kwargs)
+            attempt_span = tracer.span("attempt", attempt=attempt)
+            if obs_executor:
+                # Executor/driver spans parent under this attempt, so
+                # a retried job's two execution trees stay separable.
+                attempt_kwargs["tracer"] = tracer.child(
+                    attempt_span.span_id
+                )
+                if profile_dir is not None and attempt == 0:
+                    attempt_kwargs["profile_dir"] = profile_dir
             t0 = time.perf_counter()
             try:
-                result = self._run_with_timeout(
-                    spec, x, progress_cb,
-                    heartbeat=heartbeat,
-                    expected_block_fn=expected_block_fn,
-                    **run_kwargs,
-                )
+                try:
+                    with attempt_span:
+                        result = self._run_with_timeout(
+                            spec, x, progress_cb,
+                            heartbeat=heartbeat,
+                            expected_block_fn=expected_block_fn,
+                            **attempt_kwargs,
+                        )
+                finally:
+                    if profile_dir is not None and attempt == 0:
+                        # The arm was consumed by this attempt; point
+                        # the operator at the directory whatever the
+                        # outcome.  (On a wedge/timeout the abandoned
+                        # thread still owns the profiler context and
+                        # flushes the trace whenever it finally
+                        # returns — docs/OBSERVABILITY.md caveat.)
+                        self.events.emit(
+                            "profile_captured", job_id=job_id,
+                            profile_dir=profile_dir,
+                        )
             except JobTimeout as e:
                 with self._lock:
                     self.jobs_timed_out += 1
@@ -1044,6 +1162,13 @@ class Scheduler:
             stored = self.store.get_result(fp)
             with self._lock:
                 self.jobs_completed += 1
+            # End-to-end latency over EXECUTED jobs (admission to done,
+            # queue wait and retries included; dedup hits excluded —
+            # they are disk reads, and folding their ~0s in would make
+            # the execution distribution look bimodally fast).
+            self.hist_job_seconds.observe(
+                max(0.0, time.time() - submitted_at)
+            )
             self._update(
                 job_id, status="done", result=stored,
                 finished_at=round(time.time(), 3), seconds=seconds,

@@ -23,9 +23,14 @@ framework, nothing the container doesn't already have.  Endpoints:
   ``executable_cache_hits``, ``sweeps_executed``, the resilience
   counters (``checkpoint_writes_total``, ``checkpoint_resume_total``,
   ``retry_total`` by triage reason), the block-size resolution tiers
-  (``autotune_provenance_total`` — docs/AUTOTUNE.md), and ``backend``
-  (``tpu`` | ``cpu-fallback``, bench.py's ``measurement_backend``
-  convention).
+  (``autotune_provenance_total`` — docs/AUTOTUNE.md), the latency
+  histograms + perf-drift snapshot (docs/OBSERVABILITY.md), and
+  ``backend`` (``tpu`` | ``cpu-fallback``, bench.py's
+  ``measurement_backend`` convention).
+- ``GET /metrics.prom`` (alias ``GET /metrics?format=prom``) — the SAME
+  scheduler snapshot in Prometheus text format 0.0.4
+  (:mod:`consensus_clustering_tpu.obs.prom`), so standard scrapers work
+  with zero glue.
 
 Durability (docs/SERVING.md "Crash recovery"): submitted jobs persist
 their (config, data) payload, streamed executions checkpoint block
@@ -158,10 +163,36 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200 if record["status"] == "done" else 202, record)
 
+    def _send_text(self, code: int, text: str) -> None:
+        blob = text.encode()
+        self.send_response(code)
+        # The Prometheus text-format content type (0.0.4 is the text
+        # exposition version scrapers negotiate, not this package's).
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
     def do_GET(self) -> None:  # noqa: N802
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
         if path == "/healthz":
             self._send_json(200, self.service.health())
+            return
+        if path == "/metrics.prom" or (
+            path == "/metrics"
+            and "format=prom" in query.split("&")
+        ):
+            from consensus_clustering_tpu.obs.prom import (
+                render_prometheus,
+            )
+
+            self._send_text(
+                200,
+                render_prometheus(self.service.scheduler.metrics()),
+            )
             return
         if path == "/metrics":
             self._send_json(200, self.service.scheduler.metrics())

@@ -45,10 +45,21 @@ class MetricsLogger:
 
     Each event is one line: ``{"ts": <unix>, "event": <name>, ...fields}``.
     ``path=None`` logs via :mod:`logging` only.
+
+    ``log_level`` follows the serve EventLog's rule: with a file sink
+    the JSONL stream is the record, so the logging mirror drops to
+    DEBUG (a per-block event stream duplicated to stderr at INFO is
+    noise, not telemetry); without a file it stays INFO.
     """
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(
+        self, path: Optional[str] = None, log_level: Optional[int] = None
+    ):
         self.path = path
+        self.log_level = (
+            log_level if log_level is not None
+            else (logging.DEBUG if path else logging.INFO)
+        )
 
     def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
         record = {"ts": round(time.time(), 3), "event": event, **fields}
@@ -56,5 +67,5 @@ class MetricsLogger:
         if self.path:
             with open(self.path, "a") as f:
                 f.write(line + "\n")
-        logger.info("metrics: %s", line)
+        logger.log(self.log_level, "metrics: %s", line)
         return record

@@ -36,6 +36,7 @@ EXPECTED_SUBPACKAGES = (
     "consensus_clustering_tpu.autotune",
     "consensus_clustering_tpu.lint",
     "consensus_clustering_tpu.models",
+    "consensus_clustering_tpu.obs",
     "consensus_clustering_tpu.ops",
     "consensus_clustering_tpu.parallel",
     "consensus_clustering_tpu.resilience",

@@ -1,0 +1,837 @@
+"""Unit tests for the observability subsystem (docs/OBSERVABILITY.md).
+
+Everything here is fast (stub/unit/host-only — no compiles): the
+histogram/tracing/drift/exposition primitives, the events-catalogue
+contract, the EventLog/MetricsLogger quiet-mirror satellite, the
+profile-next arm/claim surfaces, and the scheduler wiring driven by a
+duck-typed obs-aware stub executor.  The live end-to-end proof is
+``benchmarks/latency_probe.py`` (CI job ``obs-smoke``); the live-HTTP
+exposition/span checks ride the warm service fixture in test_serve.py.
+"""
+
+import ast
+import glob
+import json
+import logging
+import os
+import threading
+import time
+
+import pytest
+
+import consensus_clustering_tpu.serve.events as events_mod
+from consensus_clustering_tpu.obs.drift import (
+    ANCHOR_CALIBRATED,
+    ANCHOR_OBSERVED,
+    DriftWatchdog,
+)
+from consensus_clustering_tpu.obs.histograms import (
+    DEFAULT_TIME_BUCKETS,
+    LatencyHistogram,
+    bucket_label,
+)
+from consensus_clustering_tpu.obs.prom import (
+    render_prometheus,
+    validate_exposition,
+)
+from consensus_clustering_tpu.obs.tracing import Tracer
+from consensus_clustering_tpu.resilience.faults import (
+    FaultInjector,
+    _parse_plan,
+)
+from consensus_clustering_tpu.serve.events import EventLog
+from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.scheduler import Scheduler
+from consensus_clustering_tpu.utils.metrics import MetricsLogger
+
+SERVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "consensus_clustering_tpu", "serve",
+)
+
+
+# ---------------------------------------------------------------------------
+# Histograms
+
+
+class TestLatencyHistogram:
+    def test_cumulative_snapshot(self):
+        h = LatencyHistogram(buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["buckets"] == {
+            "0.1": 1, "1": 3, "10": 4, "+Inf": 5,
+        }
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(56.05)
+
+    def test_pre_seeded_key_set_never_changes(self):
+        h = LatencyHistogram()
+        before = set(h.snapshot()["buckets"])
+        assert all(v == 0 for v in h.snapshot()["buckets"].values())
+        h.observe(0.2)
+        h.observe(1e9)  # far past the last bound -> +Inf only
+        assert set(h.snapshot()["buckets"]) == before
+        assert h.snapshot()["buckets"]["+Inf"] == 2
+
+    def test_boundary_lands_in_its_bucket(self):
+        # Prometheus le is <=: an observation exactly on a bound counts
+        # in that bound's bucket.
+        h = LatencyHistogram(buckets=(1.0, 2.0))
+        h.observe(1.0)
+        assert h.snapshot()["buckets"]["1"] == 1
+
+    def test_nan_ignored(self):
+        h = LatencyHistogram()
+        h.observe(float("nan"))
+        assert h.snapshot()["count"] == 0
+
+    @pytest.mark.parametrize(
+        "bad", [(), (1.0, 1.0), (2.0, 1.0), (0.0, 1.0), (-1.0, 1.0)]
+    )
+    def test_invalid_bounds_rejected(self, bad):
+        with pytest.raises(ValueError):
+            LatencyHistogram(buckets=bad)
+
+    def test_thread_safety_count(self):
+        h = LatencyHistogram()
+
+        def worker():
+            for _ in range(500):
+                h.observe(0.01)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.snapshot()["count"] == 2000
+        assert h.snapshot()["buckets"]["+Inf"] == 2000
+
+    def test_bucket_label_spelling(self):
+        # One spelling for JSON keys and Prometheus le values.
+        assert bucket_label(0.0025) == "0.0025"
+        assert bucket_label(1.0) == "1"
+        assert bucket_label(1800.0) == "1800"
+
+
+# ---------------------------------------------------------------------------
+# Tracing
+
+
+class TestTracer:
+    def test_span_context_manager(self):
+        sink = []
+        t = Tracer(sink.append, trace_id="job42")
+        with t.span("execute", h=5) as s:
+            time.sleep(0.01)
+            s.add(cached=True)
+        assert len(sink) == 1
+        p = sink[0]
+        assert p["name"] == "execute" and p["trace_id"] == "job42"
+        assert p["status"] == "ok" and p["h"] == 5 and p["cached"] is True
+        assert p["parent_span_id"] is None
+        assert p["seconds"] >= 0.01
+
+    def test_error_status_and_reraise(self):
+        sink = []
+        t = Tracer(sink.append)
+        with pytest.raises(RuntimeError):
+            with t.span("execute"):
+                raise RuntimeError("boom")
+        assert sink[0]["status"] == "error"
+        assert sink[0]["error_type"] == "RuntimeError"
+
+    def test_child_parents_and_shares_trace(self):
+        sink = []
+        t = Tracer(sink.append, trace_id="job1")
+        with t.span("execute") as s:
+            child = t.child(s.span_id)
+            child.record("h_block", 0.1, block=0)
+        by_name = {p["name"]: p for p in sink}
+        assert by_name["h_block"]["parent_span_id"] == (
+            by_name["execute"]["span_id"]
+        )
+        assert by_name["h_block"]["trace_id"] == "job1"
+
+    def test_end_is_idempotent(self):
+        sink = []
+        t = Tracer(sink.append)
+        s = t.span("x")
+        s.end()
+        s.end()
+        with s:  # the CM exit after an explicit end must not re-emit
+            pass
+        assert len(sink) == 1
+
+    def test_sink_failure_swallowed(self):
+        def broken(_p):
+            raise OSError("disk full")
+
+        t = Tracer(broken)
+        t.record("queue_wait", 0.1)  # must not raise
+        with t.span("execute"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Drift watchdog
+
+
+class TestDriftWatchdog:
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {"band": (0.0, 2.0)},
+            {"band": (1.5, 2.0)},
+            {"band": (0.5, 0.9)},
+            {"anchor_blocks": 0},
+            {"ewma_alpha": 0.0},
+        ],
+    )
+    def test_validation(self, kw):
+        with pytest.raises(ValueError):
+            DriftWatchdog(**kw)
+
+    def test_calibrated_anchor_flags_slowdown(self):
+        d = DriftWatchdog(band=(0.6, 1.8), min_observations=3)
+        events = []
+        d.set_emitter(lambda **p: events.append(p))
+        # Calibrated rate 100 r/s; blocks of 10 resamples at 0.1 s hold
+        # exactly that rate — in band.
+        for _ in range(5):
+            assert d.observe("b1", 0.1, 10.0, calibrated_rate=100.0) is None
+        # A 10x slowdown drags the EWMA well below 0.6x the anchor.
+        for _ in range(8):
+            d.observe("b1", 1.0, 10.0, calibrated_rate=100.0)
+        assert len(events) == 1  # one event per excursion, not per block
+        p = events[0]
+        assert p["bucket"] == "b1"
+        assert p["anchor_provenance"] == ANCHOR_CALIBRATED
+        assert p["anchor_rate"] == 100.0
+        assert p["ratio"] < 0.6
+        snap = d.snapshot()
+        assert snap["flagged_total"] == {"b1": 1}
+        assert snap["active"]["b1"] is True
+        assert snap["anchor_provenance"]["b1"] == ANCHOR_CALIBRATED
+
+    def test_rearms_after_recovery(self):
+        d = DriftWatchdog(min_observations=1)
+        events = []
+        d.set_emitter(lambda **p: events.append(p))
+        for _ in range(6):
+            d.observe("b", 1.0, 10.0, calibrated_rate=10.0)  # in band
+        for _ in range(10):
+            d.observe("b", 10.0, 10.0, calibrated_rate=10.0)  # drift
+        assert len(events) == 1
+        for _ in range(30):
+            d.observe("b", 1.0, 10.0, calibrated_rate=10.0)  # recover
+        assert d.snapshot()["active"]["b"] is False
+        for _ in range(10):
+            d.observe("b", 10.0, 10.0, calibrated_rate=10.0)  # again
+        assert len(events) == 2
+        assert d.snapshot()["flagged_total"] == {"b": 2}
+
+    def test_observed_self_anchor(self):
+        d = DriftWatchdog(anchor_blocks=4, min_observations=3)
+        events = []
+        d.set_emitter(lambda **p: events.append(p))
+        for _ in range(4):
+            assert d.observe("b", 0.05, 16.0) is None
+        snap = d.snapshot()
+        assert snap["anchor_provenance"]["b"] == ANCHOR_OBSERVED
+        anchor = snap["anchor_rate"]["b"]
+        # The anchor is set ONCE: later slowdowns must not drag it.
+        for _ in range(6):
+            d.observe("b", 4.0, 16.0)
+        assert d.snapshot()["anchor_rate"]["b"] == anchor
+        assert len(events) == 1 and events[0]["ratio"] < 0.6
+
+    def test_speedup_outside_band_flags_too(self):
+        d = DriftWatchdog(band=(0.6, 1.8), min_observations=1)
+        events = []
+        d.set_emitter(lambda **p: events.append(p))
+        for _ in range(4):
+            d.observe("b", 1.0, 10.0, calibrated_rate=10.0)
+        for _ in range(20):
+            d.observe("b", 0.1, 10.0, calibrated_rate=10.0)
+        assert events and events[0]["ratio"] > 1.8
+
+    def test_disabled_is_inert(self):
+        d = DriftWatchdog(enabled=False)
+        events = []
+        d.set_emitter(lambda **p: events.append(p))
+        for _ in range(20):
+            d.observe("b", 10.0, 10.0, calibrated_rate=1000.0)
+        assert events == []
+        assert d.snapshot()["ratio"] == {}
+
+    def test_snapshot_schema_fixed(self):
+        keys = {
+            "enabled", "band", "ratio", "anchor_rate",
+            "anchor_provenance", "flagged_total", "active",
+        }
+        d = DriftWatchdog()
+        assert set(d.snapshot()) == keys
+        for _ in range(20):
+            d.observe("b", 1.0, 10.0, calibrated_rate=10.0)
+        assert set(d.snapshot()) == keys
+
+    def test_partial_block_is_rate_honest(self):
+        """A truncated final block (H not dividing the block size) at
+        the SAME per-resample cost must not move the ratio: the EWMA is
+        seconds-per-resample, so an eighth of the work in an eighth of
+        the time is not a speedup (and crediting it a full block's
+        resamples was the review-caught false-perf_drift bug)."""
+        d = DriftWatchdog(band=(0.6, 1.8), min_observations=1)
+        events = []
+        d.set_emitter(lambda **p: events.append(p))
+        for _ in range(200):  # many jobs: 7 full blocks + 1/8 block
+            for _ in range(7):
+                d.observe("b", 0.8, 64.0, calibrated_rate=80.0)
+            d.observe("b", 0.1, 8.0, calibrated_rate=80.0)
+        assert events == []
+        assert d.snapshot()["ratio"]["b"] == pytest.approx(1.0, abs=0.01)
+
+    def test_emitter_failure_swallowed(self):
+        d = DriftWatchdog(min_observations=1)
+
+        def broken(**_p):
+            raise OSError("down")
+
+        d.set_emitter(broken)
+        for _ in range(10):
+            d.observe("b", 10.0, 10.0, calibrated_rate=10.0)
+        assert d.snapshot()["flagged_total"] == {"b": 1}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+def _fake_metrics():
+    h = LatencyHistogram(buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    d = DriftWatchdog(min_observations=1)
+    for _ in range(6):
+        d.observe("n40_d3_h16_k2-3", 10.0, 10.0, calibrated_rate=10.0)
+    return {
+        "queue_depth": 1,
+        "jobs_completed": 3,
+        "retry_total": {"oom": 2, "wedged:block:0": 1},
+        "jobs_shed_total": {"high": 0, "normal": 0, "low": 4},
+        "memory_budget_bytes": None,
+        "latency_histograms": {"job_seconds": h.snapshot()},
+        "perf_drift": d.snapshot(),
+        "perf_drift_events_total": 1,
+        "backend": "cpu-fallback",
+    }
+
+
+class TestPromExposition:
+    def test_render_passes_strict_checker(self):
+        text = render_prometheus(_fake_metrics())
+        assert validate_exposition(text) == []
+
+    def test_histogram_lines(self):
+        text = render_prometheus(_fake_metrics())
+        assert '# TYPE cctpu_job_seconds histogram' in text
+        assert 'cctpu_job_seconds_bucket{le="0.1"} 1' in text
+        assert 'cctpu_job_seconds_bucket{le="+Inf"} 2' in text
+        assert "cctpu_job_seconds_count 2" in text
+        assert "cctpu_job_seconds_sum" in text
+
+    def test_labels_and_types(self):
+        text = render_prometheus(_fake_metrics())
+        assert '# TYPE cctpu_retry_total counter' in text
+        assert 'cctpu_retry_total{reason="wedged:block:0"} 1' in text
+        assert 'cctpu_jobs_shed_total{priority="low"} 4' in text
+        assert '# TYPE cctpu_jobs_completed counter' in text
+        assert '# TYPE cctpu_queue_depth gauge' in text
+        assert 'cctpu_backend_info{backend="cpu-fallback"} 1' in text
+        assert (
+            'cctpu_perf_drift_anchor_info{bucket="n40_d3_h16_k2-3",'
+            'provenance="calibrated"} 1' in text
+        )
+
+    def test_none_values_omitted(self):
+        text = render_prometheus(_fake_metrics())
+        assert "memory_budget_bytes" not in text
+
+    def test_label_escaping(self):
+        text = render_prometheus(
+            {"retry_total": {'we"ird\\label\n': 1}}
+        )
+        assert validate_exposition(text) == []
+        assert '\\"' in text and "\\\\" in text and "\\n" in text
+
+    @pytest.mark.parametrize(
+        "broken, why",
+        [
+            ("cctpu_x 1\n", "sample without TYPE"),
+            (
+                "# HELP cctpu_x x\n# TYPE cctpu_x counter\ncctpu_x -1\n",
+                "negative counter",
+            ),
+            (
+                "# HELP cctpu_x x\n# TYPE cctpu_x gauge\n"
+                "cctpu_x 1\ncctpu_x 2\n",
+                "duplicate sample",
+            ),
+            (
+                "# HELP cctpu_h h\n# TYPE cctpu_h histogram\n"
+                'cctpu_h_bucket{le="1"} 1\ncctpu_h_sum 1\n'
+                "cctpu_h_count 1\n",
+                "missing +Inf bucket",
+            ),
+            (
+                "# HELP cctpu_h h\n# TYPE cctpu_h histogram\n"
+                'cctpu_h_bucket{le="1"} 5\n'
+                'cctpu_h_bucket{le="+Inf"} 3\n'
+                "cctpu_h_sum 1\ncctpu_h_count 3\n",
+                "non-monotone buckets",
+            ),
+            (
+                "# HELP cctpu_h h\n# TYPE cctpu_h histogram\n"
+                'cctpu_h_bucket{le="+Inf"} 3\ncctpu_h_sum 1\n'
+                "cctpu_h_count 4\n",
+                "+Inf != count",
+            ),
+            (
+                "# HELP cctpu_h h\n# TYPE cctpu_h histogram\n"
+                'cctpu_h_bucket{le="+Inf"} 3\ncctpu_h_count 3\n',
+                "missing _sum",
+            ),
+            (
+                "# HELP cctpu_x x\n# TYPE cctpu_x gauge\n"
+                "cctpu_x{bad-label=\"v\"} 1\n",
+                "malformed label name",
+            ),
+            (
+                "# HELP cctpu_x x\n# TYPE cctpu_x bogus\ncctpu_x 1\n",
+                "bad TYPE",
+            ),
+            ("# HELP cctpu_x x\n# TYPE cctpu_x gauge\ncctpu_x 1", "no final newline"),
+        ],
+    )
+    def test_checker_catches(self, broken, why):
+        assert validate_exposition(broken), why
+
+
+# ---------------------------------------------------------------------------
+# slow fault action (the drift driver)
+
+
+class TestSlowFault:
+    def test_parse_defaults_and_arg(self):
+        rules = _parse_plan("block_start=5:slow,block_start=7:slow:2.5")
+        assert rules[0].action == "slow" and rules[0].seconds == 1.0
+        assert rules[1].seconds == 2.5
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError):
+            _parse_plan("block_start=5:slow:fast")
+        with pytest.raises(ValueError):
+            _parse_plan("block_start=5:slow:-1")
+
+    def test_fire_sleeps_and_continues(self):
+        inj = FaultInjector("p=1:slow:0.05")
+        t0 = time.perf_counter()
+        inj.fire("p", index=1)  # must NOT raise
+        assert time.perf_counter() - t0 >= 0.05
+        assert inj.fired == [("p", 1, "slow")]
+        inj.fire("p", index=1)  # disarmed: no second sleep
+        assert len(inj.fired) == 1
+
+
+# ---------------------------------------------------------------------------
+# EventLog / MetricsLogger quiet mirror (satellite: no stderr double-write)
+
+
+class TestQuietLogMirror:
+    def test_eventlog_file_sink_demotes_mirror_to_debug(
+        self, tmp_path, caplog
+    ):
+        log = EventLog(str(tmp_path / "ev.jsonl"))
+        with caplog.at_level(logging.INFO, logger=events_mod.__name__):
+            log.emit("job_submitted", job_id="j1")
+        assert caplog.records == []  # nothing at INFO: the file is the
+        with caplog.at_level(logging.DEBUG, logger=events_mod.__name__):
+            log.emit("job_done", job_id="j1")
+        assert any(
+            r.levelno == logging.DEBUG for r in caplog.records
+        )
+        lines = open(log.path).read().splitlines()
+        assert len(lines) == 2  # the JSONL stream carries everything
+
+    def test_eventlog_without_file_stays_info(self, caplog):
+        log = EventLog(None)
+        with caplog.at_level(logging.INFO, logger=events_mod.__name__):
+            log.emit("job_submitted", job_id="j1")
+        assert any(r.levelno == logging.INFO for r in caplog.records)
+
+    def test_explicit_level_override(self, tmp_path, caplog):
+        log = EventLog(
+            str(tmp_path / "ev.jsonl"), log_level=logging.WARNING
+        )
+        with caplog.at_level(
+            logging.WARNING, logger=events_mod.__name__
+        ):
+            log.emit("job_failed", job_id="j1")
+        assert any(
+            r.levelno == logging.WARNING for r in caplog.records
+        )
+
+    def test_metrics_logger_same_rule(self, tmp_path, caplog):
+        import consensus_clustering_tpu.utils.metrics as metrics_mod
+
+        m = MetricsLogger(str(tmp_path / "m.jsonl"))
+        with caplog.at_level(logging.INFO, logger=metrics_mod.__name__):
+            m.emit("sweep_complete", rate=1.0)
+        assert caplog.records == []
+        assert MetricsLogger(None).log_level == logging.INFO
+
+
+# ---------------------------------------------------------------------------
+# Events contract: every emitted name is catalogued, and vice versa
+
+
+def _emitted_event_names():
+    names = set()
+    for path in glob.glob(os.path.join(SERVE_DIR, "*.py")):
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                names.add(node.args[0].value)
+    return names
+
+
+def _catalogued_event_names():
+    import re
+
+    return set(
+        re.findall(r"(?m)^- ``([a-z_]+)``", events_mod.__doc__)
+    )
+
+
+def test_event_catalogue_matches_emissions():
+    """Satellite: the events.py docstring catalogue and the event names
+    actually emitted anywhere in serve/ must be the SAME set — operator
+    docs cannot silently drift from the code in either direction."""
+    emitted = _emitted_event_names()
+    catalogued = _catalogued_event_names()
+    assert emitted, "AST scan found no emissions — scanner broken"
+    assert emitted - catalogued == set(), (
+        "events emitted but not documented in serve/events.py"
+    )
+    assert catalogued - emitted == set(), (
+        "events documented but never emitted"
+    )
+
+
+# ---------------------------------------------------------------------------
+# profile-next: arm/claim surfaces
+
+
+class TestProfileNext:
+    def test_arm_claim_roundtrip_one_shot(self, tmp_path):
+        store = JobStore(str(tmp_path))
+        assert store.claim_profile() is None
+        store.arm_profile("/tmp/trace_here")
+        assert store.claim_profile() == "/tmp/trace_here"
+        assert store.claim_profile() is None  # one-shot
+
+    def test_rearm_replaces_target(self, tmp_path):
+        store = JobStore(str(tmp_path))
+        store.arm_profile("/a")
+        store.arm_profile("/b")
+        assert store.claim_profile() == "/b"
+        assert store.claim_profile() is None
+
+    def test_malformed_arm_consumed_not_crashing(self, tmp_path):
+        store = JobStore(str(tmp_path))
+        with open(store._profile_request_path(), "w") as f:
+            f.write("not json{")
+        assert store.claim_profile() is None
+        assert not os.path.exists(store._profile_request_path())
+
+    def test_admin_stdlib_arm_claimable_by_jobstore(self, tmp_path):
+        # The serve-admin spelling writes the SAME file the JobStore
+        # claims — the two implementations must not drift.
+        from consensus_clustering_tpu.serve.admin import arm_profile_next
+
+        store = JobStore(str(tmp_path))
+        arm_profile_next(str(tmp_path), str(tmp_path / "trace"))
+        assert store.claim_profile() == str(tmp_path / "trace")
+
+    def test_both_arm_spellings_abspath_relative_dirs(
+        self, tmp_path, monkeypatch
+    ):
+        # Both writers normalise a RELATIVE target at arm time: the
+        # trace must land where the armer meant, not relative to the
+        # service process's cwd at claim time.
+        from consensus_clustering_tpu.serve.admin import arm_profile_next
+
+        monkeypatch.chdir(tmp_path)
+        store = JobStore(str(tmp_path / "s1"))
+        store.arm_profile("rel_trace")
+        assert store.claim_profile() == str(tmp_path / "rel_trace")
+        arm_profile_next(str(tmp_path / "s1"), "rel_trace2")
+        assert store.claim_profile() == str(tmp_path / "rel_trace2")
+
+    def test_stale_claim_tmp_swept(self, tmp_path):
+        # A crash mid-claim leaves a .tmp in control/; the store's
+        # startup GC must sweep it like every other stale temp.
+        store = JobStore(str(tmp_path))
+        stale = os.path.join(
+            store.control_dir, "profile_next.json.deadbeef.tmp"
+        )
+        with open(stale, "w") as f:
+            f.write("{}")
+        old = time.time() - 2 * JobStore._TMP_GRACE_SECONDS
+        os.utime(stale, (old, old))
+        JobStore(str(tmp_path))  # restart: the sweep runs
+        assert not os.path.exists(stale)
+
+    def test_admin_cli_wiring(self, tmp_path, capsys):
+        from consensus_clustering_tpu.serve.admin import cmd_serve_admin
+
+        class Args:
+            store_dir = str(tmp_path)
+            admin_cmd = "profile-next"
+            profile_dir = str(tmp_path / "trace")
+
+        assert cmd_serve_admin(Args()) == 0
+        out = capsys.readouterr().out
+        assert "one-shot" in out and "profile_captured" in out
+        assert JobStore(str(tmp_path)).claim_profile() == str(
+            tmp_path / "trace"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Scheduler wiring against a duck-typed obs-aware stub
+
+
+class _ObsStubExecutor:
+    """Streaming- and obs-shaped stub: records the kwargs each run
+    received, no JAX."""
+
+    default_h_block = 4
+
+    def __init__(self, script=None):
+        self.run_count = 0
+        self.executable_cache_hits = 0
+        self.hist_block_seconds = LatencyHistogram()
+        self.hist_checkpoint_write_seconds = LatencyHistogram()
+        self.drift = DriftWatchdog(min_observations=1)
+        self.run_calls = []
+        self._script = list(script or [])
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, spec, x, progress_cb=None, block_cb=None,
+            checkpoint_dir=None, heartbeat=None, tracer=None,
+            profile_dir=None):
+        self.run_count += 1
+        self.run_calls.append(
+            {"tracer": tracer, "profile_dir": profile_dir}
+        )
+        step = self._script.pop(0) if self._script else {"ok": True}
+        if isinstance(step, Exception):
+            raise step
+        return {"result": step}
+
+
+def _spec():
+    from consensus_clustering_tpu.serve import parse_job_spec
+
+    return parse_job_spec(
+        {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0], [3.0, 3.0]],
+         "config": {"k": [2], "iterations": 5}}
+    )
+
+
+def _wait_done(sched, job_id, budget=10.0):
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        cur = sched.get(job_id)
+        if cur["status"] in ("done", "failed", "timeout"):
+            return cur
+        time.sleep(0.02)
+    raise AssertionError("job never finished")
+
+
+class TestSchedulerObsWiring:
+    def test_spans_histograms_and_trace_id(self, tmp_path):
+        events_path = str(tmp_path / "ev.jsonl")
+        ex = _ObsStubExecutor()
+        sched = Scheduler(
+            ex, JobStore(str(tmp_path / "store")),
+            events=EventLog(events_path),
+        )
+        sched.start()
+        try:
+            spec, x = _spec()
+            rec = sched.submit(spec, x)
+            assert _wait_done(sched, rec["job_id"])["status"] == "done"
+            m = sched.metrics()
+            assert m["latency_histograms"]["job_seconds"]["count"] == 1
+            assert (
+                m["latency_histograms"]["queue_wait_seconds"]["count"]
+                == 1
+            )
+            spans = [
+                json.loads(line) for line in open(events_path)
+                if '"span"' in line
+            ]
+            spans = [e for e in spans if e["event"] == "span"]
+            names = {e["name"] for e in spans}
+            assert {"queue_wait", "attempt"} <= names
+            assert all(
+                e["trace_id"] == rec["job_id"] for e in spans
+            )
+            # The executor received the attempt-scoped child tracer.
+            assert ex.run_calls[0]["tracer"] is not None
+            attempt = next(e for e in spans if e["name"] == "attempt")
+            assert (
+                ex.run_calls[0]["tracer"].parent_span_id
+                == attempt["span_id"]
+            )
+        finally:
+            sched.stop()
+
+    def test_drift_emitter_wired_to_events_and_counter(self, tmp_path):
+        events_path = str(tmp_path / "ev.jsonl")
+        ex = _ObsStubExecutor()
+        sched = Scheduler(
+            ex, JobStore(str(tmp_path / "store")),
+            events=EventLog(events_path),
+        )
+        # Scheduler construction must have installed its emitter.
+        for _ in range(6):
+            ex.drift.observe("bX", 10.0, 10.0, calibrated_rate=10.0)
+        assert sched.metrics()["perf_drift_events_total"] == 1
+        drifted = [
+            json.loads(line) for line in open(events_path)
+            if '"perf_drift"' in line
+        ]
+        assert drifted and drifted[0]["bucket"] == "bX"
+        assert sched.metrics()["perf_drift"]["flagged_total"] == {
+            "bX": 1
+        }
+
+    def test_profile_claim_first_attempt_only(self, tmp_path):
+        store = JobStore(str(tmp_path / "store"))
+        store.arm_profile(str(tmp_path / "trace"))
+        ex = _ObsStubExecutor(
+            script=[RuntimeError("transient"), {"ok": True}]
+        )
+        events_path = str(tmp_path / "ev.jsonl")
+        sched = Scheduler(
+            ex, store, max_retries=2, sleep=lambda _s: None,
+            events=EventLog(events_path),
+        )
+        sched.start()
+        try:
+            spec, x = _spec()
+            rec = sched.submit(spec, x)
+            assert _wait_done(sched, rec["job_id"])["status"] == "done"
+            # Attempt 0 carried the profile dir; the retry must not.
+            assert ex.run_calls[0]["profile_dir"] == str(
+                tmp_path / "trace"
+            )
+            assert ex.run_calls[1]["profile_dir"] is None
+            assert sched.metrics()["profile_requests_total"] == 1
+            captured = [
+                json.loads(line) for line in open(events_path)
+                if '"profile_captured"' in line
+            ]
+            assert len(captured) == 1
+            assert captured[0]["job_id"] == rec["job_id"]
+            # One-shot: the next job finds nothing to claim.
+            rec2 = sched.submit(*_spec())
+            _wait_done(sched, rec2["job_id"])
+            assert sched.metrics()["profile_requests_total"] == 1
+        finally:
+            sched.stop()
+
+    def test_non_obs_stub_gets_no_obs_kwargs(self, tmp_path):
+        """Pre-obs duck-typed executors (narrow run() signatures) keep
+        working: the scheduler only passes tracer/profile_dir to
+        executors that carry the obs layer."""
+
+        calls = []
+
+        class _Narrow:
+            run_count = 0
+            executable_cache_hits = 0
+
+            def backend(self):
+                return "cpu-fallback"
+
+            def cancel_events(self):
+                pass
+
+            def run(self, spec, x, progress_cb=None):
+                calls.append("ran")
+                return {"ok": True}
+
+        store = JobStore(str(tmp_path))
+        store.arm_profile("/never/claimed")
+        sched = Scheduler(_Narrow(), store)
+        sched.start()
+        try:
+            rec = sched.submit(*_spec())
+            assert _wait_done(sched, rec["job_id"])["status"] == "done"
+            assert calls == ["ran"]
+            # Not obs-aware: the arm stays for a future obs executor.
+            assert sched.metrics()["profile_requests_total"] == 0
+            assert store.claim_profile() == "/never/claimed"
+        finally:
+            sched.stop()
+
+    def test_metrics_prom_of_stub_scheduler_validates(self, tmp_path):
+        sched = Scheduler(_ObsStubExecutor(), JobStore(str(tmp_path)))
+        text = render_prometheus(sched.metrics())
+        assert validate_exposition(text) == []
+
+
+# ---------------------------------------------------------------------------
+# numpy import guard (this module deliberately stays light)
+
+
+def test_obs_package_is_stdlib_only():
+    """The obs package must keep importing without numpy/jax: the
+    stdlib-only latency probe and serve-admin paths depend on it."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys;"
+        "sys.modules['numpy'] = None; sys.modules['jax'] = None;"
+        "import consensus_clustering_tpu.obs as o;"
+        "o.LatencyHistogram().observe(0.1);"
+        "o.Tracer(lambda p: None).record('x', 0.1);"
+        "o.DriftWatchdog().observe('b', 0.1, 1.0);"
+        "print('ok')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(SERVE_DIR), os.pardir),
+    )
+    assert out.returncode == 0 and "ok" in out.stdout, out.stderr

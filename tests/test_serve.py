@@ -158,20 +158,31 @@ def test_healthz_schema(base):
     assert isinstance(health["queue_depth"], int)
 
 
+# The COMPLETE /metrics top-level key set.  Exhaustive equality, not
+# subset: a key silently disappearing (e.g. a renamed executor
+# attribute no longer surfacing) is exactly the regression this pin
+# exists to catch — extend it when extending metrics().
+EXPECTED_METRICS_KEYS = frozenset(
+    {
+        "queue_depth", "queue_capacity", "jobs_completed", "jobs_failed",
+        "jobs_retried", "jobs_timed_out", "jobs_requeued", "cache_hits",
+        "executable_cache_hits", "executable_cache_misses",
+        "h_requested_total", "h_effective_total", "sweeps_executed",
+        "backend", "checkpoint_writes_total", "checkpoint_resume_total",
+        "checkpoint_verify_rejects_total", "retry_total",
+        "autotune_provenance_total", "jobs_wedged_total",
+        "jobs_quarantined", "jobs_shed_total", "preflight_rejects_total",
+        "memory_budget_bytes", "integrity_checks_total",
+        "integrity_violations_total", "latency_histograms", "perf_drift",
+        "perf_drift_events_total", "profile_requests_total",
+    }
+)
+
+
 def test_metrics_schema(base):
     code, m, _ = _req(base, "/metrics")
     assert code == 200
-    for field in (
-        "queue_depth", "queue_capacity", "jobs_completed", "jobs_failed",
-        "jobs_retried", "jobs_timed_out", "jobs_requeued", "cache_hits",
-        "executable_cache_hits", "sweeps_executed", "backend",
-        "checkpoint_writes_total", "checkpoint_resume_total", "retry_total",
-        "autotune_provenance_total", "jobs_wedged_total",
-        "jobs_quarantined", "jobs_shed_total", "preflight_rejects_total",
-        "integrity_checks_total", "integrity_violations_total",
-        "checkpoint_verify_rejects_total",
-    ):
-        assert field in m, field
+    assert set(m) == EXPECTED_METRICS_KEYS
     assert isinstance(m["retry_total"], dict)
     assert isinstance(m["autotune_provenance_total"], dict)
     # Pre-seeded with every priority at construction (the dict-copy-
@@ -182,6 +193,101 @@ def test_metrics_schema(base):
     # in checkpoint_verify_rejects_total, never a violation key that
     # cannot fire.
     assert set(m["integrity_violations_total"]) == {"accumulator"}
+    # Observability layer (docs/OBSERVABILITY.md): all four latency
+    # histograms pre-seeded with the full fixed bucket ladder, and the
+    # drift snapshot's fixed section keys.
+    assert set(m["latency_histograms"]) == {
+        "job_seconds", "queue_wait_seconds", "block_seconds",
+        "checkpoint_write_seconds",
+    }
+    for name, snap in m["latency_histograms"].items():
+        assert set(snap) == {"buckets", "count", "sum"}, name
+        assert snap["buckets"]["+Inf"] == snap["count"], name
+    assert set(m["perf_drift"]) == {
+        "enabled", "band", "ratio", "anchor_rate", "anchor_provenance",
+        "flagged_total", "active",
+    }
+
+
+def test_metrics_executor_attr_map_matches_real_executor():
+    """Satellite: every duck-typed getattr read in scheduler.metrics()
+    must name a REAL SweepExecutor attribute — a renamed attribute
+    would otherwise silently report 0 (or a zero histogram) forever."""
+    from consensus_clustering_tpu.serve.scheduler import (
+        _EXECUTOR_COUNTER_ATTRS,
+        _EXECUTOR_OBJECT_ATTRS,
+    )
+
+    ex = SweepExecutor(use_compilation_cache=False)
+    for key, attr in _EXECUTOR_COUNTER_ATTRS.items():
+        assert hasattr(ex, attr), f"metrics key {key} reads missing {attr}"
+    for attr in _EXECUTOR_OBJECT_ATTRS:
+        assert hasattr(ex, attr), f"metrics() reads missing {attr}"
+    # And the two non-mapped direct reads.
+    assert hasattr(ex, "autotune_provenance")
+    assert hasattr(ex, "run_count")
+
+
+def _req_text(base, path):
+    """(status, content-type, body text) for a non-JSON GET."""
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, r.headers.get("Content-Type", ""), (
+            r.read().decode()
+        )
+
+
+def test_metrics_prom_exposition(base):
+    """GET /metrics.prom parses under the strict text-format checker
+    and carries the histogram/drift/counter families; the query-string
+    alias serves the same thing."""
+    from consensus_clustering_tpu.obs.prom import validate_exposition
+
+    code, ctype, text = _req_text(base, "/metrics.prom")
+    assert code == 200
+    assert ctype.startswith("text/plain")
+    assert validate_exposition(text) == []
+    for needle in (
+        "# TYPE cctpu_jobs_completed counter",
+        "# TYPE cctpu_job_seconds histogram",
+        'cctpu_job_seconds_bucket{le="+Inf"}',
+        "cctpu_perf_drift_enabled 1",
+        'cctpu_backend_info{backend="cpu-fallback"} 1',
+    ):
+        assert needle in text, needle
+    code_q, _, text_q = _req_text(base, "/metrics?format=prom")
+    assert code_q == 200 and "cctpu_jobs_completed" in text_q
+    # The JSON route is untouched by the alias parsing.
+    assert _req(base, "/metrics")[0] == 200
+
+
+def test_span_tree_in_events_log(base, service):
+    """A completed job's span tree lands in the JSONL event log with
+    trace_id == job_id: queue_wait and attempt from the scheduler,
+    compile/execute from the executor, the per-block tree from the
+    streaming driver (docs/OBSERVABILITY.md)."""
+    body = _job_body(np.random.default_rng(17), seed=171)
+    _, rec, _ = _req(base, "/jobs", body)
+    _poll(base, rec["job_id"])
+    with open(service.events.path) as f:
+        events = [json.loads(line) for line in f]
+    spans = [
+        e for e in events
+        if e["event"] == "span" and e.get("trace_id") == rec["job_id"]
+    ]
+    names = {e["name"] for e in spans}
+    assert {
+        "queue_wait", "attempt", "compile", "execute", "h_block",
+        "host_evaluate",
+    } <= names, names
+    by_id = {e["span_id"]: e for e in spans}
+    execute = next(e for e in spans if e["name"] == "execute")
+    attempt = next(e for e in spans if e["name"] == "attempt")
+    assert execute["parent_span_id"] == attempt["span_id"]
+    for e in spans:
+        if e["name"] in ("h_block", "host_evaluate"):
+            assert by_id[e["parent_span_id"]]["name"] == "execute"
+    assert all(e["seconds"] >= 0 for e in spans)
+    assert all(e["status"] == "ok" for e in spans)
 
 
 def test_events_jsonl_lifecycle(base, service):
