@@ -23,7 +23,17 @@ three phases, asserting the layer's contracts:
   completes (a regression is not a failure);
 - **profile** — ``serve-admin profile-next`` arms a one-shot
   ``jax.profiler`` trace; the next executed job captures it
-  (``profile_captured`` event, non-empty trace directory, counter).
+  (``profile_captured`` event, non-empty trace directory, counter);
+- **memory_slo** — the resource-accounting + SLO + forensic layer
+  (docs/OBSERVABILITY.md): every executed job's result carries a
+  ``memory`` block with a finite ``preflight_accuracy`` inside the
+  service's disclosed band on healthy runs; an injected per-block
+  ``slow`` fault pushes one job over its bucket's p95 ``job_seconds``
+  objective ⇒ ``slo_breach`` with the exact bucket (while the job
+  still completes — missing an SLO is not failing); and ``serve-admin
+  trace``/``report``/``bundle`` reproduce that job's story from the
+  JSONL log alone, each run under the ``-X importtime`` no-jax/no-numpy
+  pin (the tools must work while a backend is wedged).
 
 Schedules::
 
@@ -113,6 +123,7 @@ def _check_exposition(svc, report_slot):
         "cctpu_jobs_completed", "cctpu_job_seconds_bucket{le=",
         'le="+Inf"', "cctpu_perf_drift_enabled",
         "cctpu_backend_info{backend=",
+        "cctpu_slo_enabled", "cctpu_memory_accounting_enabled",
     ):
         if needle not in text:
             raise Violation(f"exposition missing {needle!r}")
@@ -161,6 +172,26 @@ def phase_load(root, report, n_jobs, buckets):
                     f"job {job_id} ended {record['status']}: "
                     f"{record.get('error')}"
                 )
+            # Memory accounting (docs/OBSERVABILITY.md): EVERY executed
+            # job's result reports its memory story, with a finite
+            # positive preflight_accuracy (on CPU the compiled plan is
+            # the measured truth — the allocator reports nothing).
+            mem = (record.get("result") or {}).get("memory")
+            if not mem:
+                raise Violation(
+                    f"job {job_id} result has no memory block"
+                )
+            acc = mem.get("preflight_accuracy")
+            if not (isinstance(acc, (int, float)) and acc > 0):
+                raise Violation(
+                    f"job {job_id} preflight_accuracy {acc!r} is not "
+                    "finite and positive"
+                )
+            if not mem.get("measurement_source"):
+                raise Violation(
+                    f"job {job_id} memory block has no measurement "
+                    "source"
+                )
         wall = time.time() - t0
 
         m1 = svc.get("/metrics")
@@ -205,6 +236,27 @@ def phase_load(root, report, n_jobs, buckets):
             raise Violation("checkpoint_write_seconds count < jobs")
         if hist1["job_seconds"]["sum"] <= 0:
             raise Violation("job_seconds sum not positive")
+
+        # Healthy traffic must sit INSIDE the disclosed accuracy band
+        # (outside would have fired preflight_inaccurate — the probe is
+        # the proof that the shipped default band fits real shapes).
+        macct = m1["memory_accounting"]
+        band_lo, band_hi = macct["band"]
+        if not macct["accuracy"]:
+            raise Violation("memory_accounting.accuracy has no buckets")
+        for bucket, acc in macct["accuracy"].items():
+            if not band_lo <= acc <= band_hi:
+                raise Violation(
+                    f"preflight accuracy {acc} at {bucket} outside the "
+                    f"disclosed band [{band_lo}, {band_hi}]"
+                )
+        if macct["flagged_total"]:
+            raise Violation(
+                "preflight_inaccurate flagged on a healthy run: "
+                f"{macct['flagged_total']}"
+            )
+        if m1["preflight_inaccurate_events_total"] != 0:
+            raise Violation("preflight_inaccurate_events_total != 0")
 
         _check_exposition(svc, report)
 
@@ -383,6 +435,169 @@ def phase_profile(root, report):
         svc.stop()
 
 
+def _run_admin(args, importtime=True):
+    """Run serve-admin under the ``-X importtime`` pin; returns stdout.
+    Raises Violation on a non-zero exit OR on any jax/numpy import —
+    the forensic tools exist for wedged-backend moments and must never
+    touch the accelerator stack."""
+    argv = [sys.executable]
+    if importtime:
+        argv.append("-X")
+        argv.append("importtime")
+    argv += ["-m", "consensus_clustering_tpu", "serve-admin", *args]
+    proc = subprocess.run(
+        argv, cwd=REPO_ROOT, env=dict(os.environ),
+        capture_output=True, text=True, timeout=120,
+    )
+    if proc.returncode != 0:
+        raise Violation(
+            f"serve-admin {args[2] if len(args) > 2 else args} failed "
+            f"rc={proc.returncode}: {proc.stderr[-500:]}"
+        )
+    if importtime:
+        imported = {
+            line.split("|")[-1].strip()
+            for line in proc.stderr.splitlines()
+            if line.startswith("import time:")
+        }
+        for forbidden in ("jax", "numpy"):
+            if forbidden in imported:
+                raise Violation(
+                    f"serve-admin {args} imported {forbidden} — the "
+                    "stdlib-only contract is broken"
+                )
+    return proc.stdout
+
+
+def phase_memory_slo(root, report):
+    """Resource accounting + SLO + forensic query, end to end: healthy
+    job in-band, slow-faulted job ⇒ slo_breach at the exact bucket, and
+    serve-admin trace/report/bundle retell it from the log alone."""
+    store = os.path.join(root, "memslo_store")
+    events_path = os.path.join(root, "memslo_events.jsonl")
+    threshold = 8.0  # healthy warmed job ~1-3s; slowed job >= +12s
+    svc = ServiceProc(
+        store,
+        # Four slow:3 blocks only an 8-block (iters=32) job reaches:
+        # the 4-block healthy job never fires them.
+        env_faults=(
+            "block_start=4:slow:3,block_start=5:slow:3,"
+            "block_start=6:slow:3,block_start=7:slow:3"
+        ),
+        extra_args=[
+            "--warmup", "40,3,2;3,32",
+            "--slo-objective", f"job_seconds:{threshold}:0.9",
+            "--slo-min-count", "1",
+            "--slo-windows", "60:600",
+            "--slo-burn", "1",
+            # The injected sleeps must read as an SLO miss, not a wedge.
+            "--wedge-floor", "30",
+        ],
+        events_path=events_path,
+    )
+    try:
+        # Healthy job: 16 iterations = 4 blocks, bucket warmed, well
+        # under the objective.
+        _, rec, _ = svc.post("/jobs", _body(4000, n=40, iters=16))
+        record = svc.poll_job(rec["job_id"], budget=600)
+        if record["status"] != "done":
+            raise Violation(f"healthy job ended {record['status']}")
+        mem = (record.get("result") or {}).get("memory")
+        if not mem or not mem.get("preflight_accuracy"):
+            raise Violation("healthy job has no memory accounting")
+        if [
+            e for e in _events(events_path) if e["event"] == "slo_breach"
+        ]:
+            raise Violation("slo_breach before any slow traffic")
+
+        # Slowed job: 32 iterations = 8 blocks, four of them +3s ⇒ over
+        # the 8s objective; one bad job at min_count 1 burns the whole
+        # budget in both windows.
+        slow_bucket = "n40_d3_h32_k2-3"
+        _, rec2, _ = svc.post("/jobs", _body(4001, n=40, iters=32))
+        slow_id = rec2["job_id"]
+        record = svc.poll_job(slow_id, budget=600)
+        if record["status"] != "done":
+            raise Violation(
+                f"slowed job ended {record['status']} — missing an SLO "
+                "is not failing"
+            )
+        breaches = [
+            e for e in _events(events_path) if e["event"] == "slo_breach"
+        ]
+        if not breaches:
+            raise Violation(
+                "no slo_breach event — the injected slowdown went "
+                "unjudged"
+            )
+        hit = breaches[0]
+        if hit["objective"] != "job_seconds":
+            raise Violation(
+                f"slo_breach objective {hit['objective']!r}, expected "
+                "job_seconds"
+            )
+        if hit["bucket"] != slow_bucket:
+            raise Violation(
+                f"slo_breach bucket {hit['bucket']!r}, expected "
+                f"{slow_bucket!r}"
+            )
+        m = svc.get("/metrics")
+        slo = m["slo"]
+        if slo["breaches_total"]["job_seconds"].get(slow_bucket, 0) < 1:
+            raise Violation("slo.breaches_total not counted")
+        if not slo["active"]["job_seconds"].get(slow_bucket):
+            raise Violation("slo.active not set inside the excursion")
+        if m["slo_breach_events_total"] < 1:
+            raise Violation("slo_breach_events_total not counted")
+        _check_exposition(svc, {})
+
+        # Forensics: the three query tools retell the story from the
+        # JSONL log alone, stdlib-only (importtime-pinned).
+        trace_out = _run_admin([
+            "--store-dir", store, "trace", slow_id,
+            "--events", events_path,
+        ])
+        for needle in (slow_id, "execute", "h_block", "job_done"):
+            if needle not in trace_out:
+                raise Violation(f"trace output missing {needle!r}")
+        report_out = _run_admin([
+            "--store-dir", store, "report", "--events", events_path,
+        ])
+        for needle in (slow_bucket, "p95", "slo_breach[job_seconds]"):
+            if needle not in report_out:
+                raise Violation(f"report output missing {needle!r}")
+        bundle_path = os.path.join(root, "memslo_bundle.tar.gz")
+        bundle_out = _run_admin([
+            "--store-dir", store, "bundle", slow_id,
+            "--events", events_path, "--out", bundle_path,
+            "--metrics-url", svc.base + "/metrics",
+        ])
+        if "metrics.json" not in bundle_out:
+            raise Violation("bundle skipped the live metrics snapshot")
+        import tarfile
+
+        with tarfile.open(bundle_path) as tar:
+            names = tar.getnames()
+        for member in (
+            "record.json", "events.jsonl", "spans.jsonl", "trace.txt",
+            "report.json", "metrics.json", "env.json",
+        ):
+            if f"{slow_id}/{member}" not in names:
+                raise Violation(f"bundle missing {member}")
+        if any(n.endswith(".npy") for n in names):
+            raise Violation("bundle contains a data matrix")
+        report["memory_slo"] = {
+            "healthy_accuracy": mem["preflight_accuracy"],
+            "slo_bucket": hit["bucket"],
+            "burn_long": hit["burn_long"],
+            "threshold_seconds": threshold,
+            "bundle_members": len(names),
+            "admin_stdlib_pinned": True,
+        }
+    finally:
+        svc.stop()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--schedule", choices=["smoke", "load"], default="smoke")
@@ -401,6 +616,7 @@ def main(argv=None):
         ("load", lambda: phase_load(root, report, n_jobs, buckets)),
         ("drift", lambda: phase_drift(root, report)),
         ("profile", lambda: phase_profile(root, report)),
+        ("memory_slo", lambda: phase_memory_slo(root, report)),
     ]
     for name, fn in phases:
         t0 = time.time()

@@ -75,7 +75,7 @@ def plan_for(row_shards, n, h, k_values, clusterer=None, cluster_batch=None,
     from consensus_clustering_tpu.models.kmeans import KMeans
     from consensus_clustering_tpu.parallel.mesh import resample_mesh
     from consensus_clustering_tpu.parallel.sweep import (
-        _compiled_memory_stats,
+        compiled_memory_stats,
         build_sweep,
     )
 
@@ -92,7 +92,7 @@ def plan_for(row_shards, n, h, k_values, clusterer=None, cluster_batch=None,
     # Times trace+compile only; .compile() blocks on the host and the
     # only device op in the region is the asarray staging of zeros.
     compile_s = time.perf_counter() - t0  # jaxlint: disable=JL007
-    stats = _compiled_memory_stats(compiled)
+    stats = compiled_memory_stats(compiled)
     stats["compile_seconds"] = round(compile_s, 2)
     return stats
 

@@ -266,6 +266,8 @@ def cmd_serve(args):
 
         calibration = CalibrationStore(args.calibration_dir)
     from consensus_clustering_tpu.obs.drift import DriftWatchdog
+    from consensus_clustering_tpu.obs.memory import MemoryAccountant
+    from consensus_clustering_tpu.obs.slo import SLOMonitor
 
     try:
         lo_s, _, hi_s = args.drift_band.partition(":")
@@ -279,6 +281,30 @@ def cmd_serve(args):
             f"serve: --drift-band {args.drift_band!r} / "
             f"--drift-anchor-blocks {args.drift_anchor_blocks}: {e}"
         )
+    try:
+        lo_s, _, hi_s = args.preflight_band.partition(":")
+        memory_accountant = MemoryAccountant(
+            band=(float(lo_s), float(hi_s)),
+            enabled=not args.no_memory_accounting,
+        )
+    except ValueError as e:
+        raise SystemExit(
+            f"serve: --preflight-band {args.preflight_band!r}: {e}"
+        )
+    try:
+        short_s, _, long_s = args.slo_windows.partition(":")
+        slo_monitor = SLOMonitor(
+            objectives=args.slo_objective or None,
+            windows=(float(short_s), float(long_s)),
+            burn_threshold=args.slo_burn,
+            min_count=args.slo_min_count,
+            enabled=not args.no_slo,
+        )
+    except ValueError as e:
+        raise SystemExit(
+            f"serve: --slo-objective/--slo-windows/--slo-burn/"
+            f"--slo-min-count: {e}"
+        )
     executor = SweepExecutor(
         # 0 = resolve per job through the autotune policy: a calibrated
         # block size for this (environment, shape bucket) when the
@@ -291,6 +317,7 @@ def cmd_serve(args):
         calibration_store=calibration,
         integrity_check_every=args.integrity_every,
         drift_watchdog=drift,
+        memory_accountant=memory_accountant,
     )
     # Bounded backend init BEFORE binding the port or reconciling jobs:
     # a wedged device plugin (the r02-r05 `backend init hung` failure)
@@ -346,6 +373,7 @@ def cmd_serve(args):
             retry_after=args.shed_retry_after,
         ),
         memory_budget_bytes=memory_budget,
+        slo_monitor=slo_monitor,
     )
     if args.port_file:
         # The orchestration handshake for --port 0 (ephemeral): whoever
@@ -608,6 +636,52 @@ def main(argv=None):
                          help="evaluated blocks before a bucket with "
                          "no calibration record self-anchors on its "
                          "own block-time EWMA (default 12)")
+    # Resource accounting + SLO layer (docs/OBSERVABILITY.md).
+    serve_p.add_argument("--no-memory-accounting", action="store_true",
+                         help="disable per-bucket memory accounting "
+                         "(preflight estimate vs measured reality; "
+                         "preflight_inaccurate events; the admission "
+                         "gate then trusts the model uncorrected). "
+                         "Skips the measurement cost too: no allocator "
+                         "reads, no per-bucket compiled-plan analysis "
+                         "— results carry the model estimate with "
+                         "measured fields null")
+    serve_p.add_argument("--preflight-band", default="0.2:10",
+                         metavar="LOW:HIGH",
+                         help="acceptable preflight accuracy band "
+                         "(estimated / measured bytes); outside it the "
+                         "bucket flags preflight_inaccurate (default "
+                         "0.2:10 — the model over-counts by design "
+                         "once N^2 dominates, and XLA lane temps it "
+                         "ignores dominate at tiny N)")
+    serve_p.add_argument("--no-slo", action="store_true",
+                         help="disable the SLO monitor (no slo_breach "
+                         "events; /metrics slo section reports "
+                         "enabled=false)")
+    serve_p.add_argument("--slo-objective", action="append",
+                         default=None,
+                         metavar="SIGNAL:THRESHOLD[:TARGET]",
+                         help="SLO objective, repeatable: signal "
+                         "(job_seconds | queue_wait_seconds | "
+                         "error_rate), latency threshold in seconds "
+                         "(empty for error_rate), target good "
+                         "fraction (default 0.95). E.g. "
+                         "job_seconds:30:0.95 means 'p95 of end-to-end "
+                         "job latency <= 30s per bucket'. Default: "
+                         "job_seconds:600:0.95 "
+                         "queue_wait_seconds:120:0.95 error_rate::0.9")
+    serve_p.add_argument("--slo-windows", default="300:3600",
+                         metavar="SHORT:LONG",
+                         help="rolling burn-rate windows in seconds "
+                         "(default 300:3600); a breach needs the burn "
+                         "over BOTH")
+    serve_p.add_argument("--slo-burn", type=float, default=2.0,
+                         help="error-budget burn multiple that "
+                         "breaches (default 2.0 = spending budget at "
+                         "twice the sustainable rate)")
+    serve_p.add_argument("--slo-min-count", type=int, default=3,
+                         help="long-window samples required before an "
+                         "(objective, bucket) may breach (default 3)")
     serve_p.add_argument("--no-shed", action="store_true",
                          help="disable priority-aware overload shedding "
                          "(admission then only bounds at --queue-size)")

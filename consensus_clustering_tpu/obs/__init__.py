@@ -13,7 +13,15 @@ service (docs/OBSERVABILITY.md) instead of only from offline benchmarks:
   (``GET /metrics.prom``) plus the strict format checker that gates it;
 - :mod:`.drift`      — the calibration-anchored perf-regression
   watchdog: live per-bucket resamples/s vs the autotune record (or a
-  self-observed anchor), ``perf_drift`` events on band excursions.
+  self-observed anchor), ``perf_drift`` events on band excursions;
+- :mod:`.memory`     — per-bucket memory accounting: the preflight
+  admission model vs measured reality (allocator high-water or XLA's
+  compiled plan), ``preflight_inaccurate`` events + the correction
+  factor the 413 gate feeds back;
+- :mod:`.slo`        — latency/error objectives per bucket over rolling
+  windows with multi-window burn rate, ``slo_breach`` events;
+- :mod:`.query`      — the forensic query engine over the JSONL log
+  (``serve-admin trace``/``report``/``bundle``).
 
 Deliberately STDLIB-ONLY (no numpy, no jax): the scheduler, the
 checkpoint writer thread, the latency probe harness, and tests all
@@ -31,22 +39,40 @@ from consensus_clustering_tpu.obs.histograms import (
     DEFAULT_TIME_BUCKETS,
     LatencyHistogram,
 )
+from consensus_clustering_tpu.obs.memory import (
+    DEFAULT_ACCURACY_BAND,
+    MemoryAccountant,
+)
 from consensus_clustering_tpu.obs.prom import (
     render_prometheus,
     validate_exposition,
+)
+from consensus_clustering_tpu.obs.slo import (
+    DEFAULT_OBJECTIVES,
+    DEFAULT_WINDOWS,
+    Objective,
+    SLOMonitor,
+    parse_objective,
 )
 from consensus_clustering_tpu.obs.tracing import Span, Tracer, new_trace_id
 
 __all__ = [
     "ANCHOR_CALIBRATED",
     "ANCHOR_OBSERVED",
+    "DEFAULT_ACCURACY_BAND",
     "DEFAULT_BAND",
+    "DEFAULT_OBJECTIVES",
     "DEFAULT_TIME_BUCKETS",
+    "DEFAULT_WINDOWS",
     "DriftWatchdog",
     "LatencyHistogram",
+    "MemoryAccountant",
+    "Objective",
+    "SLOMonitor",
     "Span",
     "Tracer",
     "new_trace_id",
+    "parse_objective",
     "render_prometheus",
     "validate_exposition",
 ]

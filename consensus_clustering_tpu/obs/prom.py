@@ -18,6 +18,12 @@ Rendering rules (``cctpu_`` prefix throughout):
 - ``perf_drift`` → per-bucket ``ratio``/``anchor_rate``/``flagged_total``
   /``active`` samples plus an ``anchor_info`` info-style metric carrying
   the provenance label;
+- ``slo`` → per-(objective, bucket) ``burn_rate``/``good_fraction``/
+  ``active``/``breaches_total``/``samples`` plus the objective config
+  gauges (docs/OBSERVABILITY.md "SLO layer");
+- ``memory_accounting`` → per-bucket estimated/measured/compiled/peak
+  byte gauges, ``preflight_accuracy``/``_correction`` and the accuracy
+  band (docs/OBSERVABILITY.md "Memory accounting");
 - ``backend`` (a string) → ``cctpu_backend_info{backend="…"} 1``;
 - ``None`` values (an unset ``memory_budget_bytes``) are OMITTED — the
   text format has no null, and a fake 0 would read as "budget: zero
@@ -173,6 +179,144 @@ def _render_perf_drift(
         lines.append(_sample(f"{base}_active", {"bucket": bucket}, v))
 
 
+def _render_slo(lines: List[str], slo: Mapping[str, Any]) -> None:
+    base = f"{PREFIX}_slo"
+    _family(
+        lines, f"{base}_enabled", "gauge",
+        "1 when the SLO monitor is on",
+    )
+    lines.append(_sample(f"{base}_enabled", None, slo.get("enabled")))
+    windows = slo.get("windows") or (0, 0)
+    _family(
+        lines, f"{base}_window_short_seconds", "gauge",
+        "short burn-rate evaluation window",
+    )
+    lines.append(
+        _sample(f"{base}_window_short_seconds", None, windows[0])
+    )
+    _family(
+        lines, f"{base}_window_long_seconds", "gauge",
+        "long burn-rate evaluation window",
+    )
+    lines.append(
+        _sample(f"{base}_window_long_seconds", None, windows[1])
+    )
+    _family(
+        lines, f"{base}_burn_threshold", "gauge",
+        "burn rate (error-budget spend multiple) that breaches",
+    )
+    lines.append(
+        _sample(f"{base}_burn_threshold", None, slo.get("burn_threshold"))
+    )
+    _family(
+        lines, f"{base}_objective_target", "gauge",
+        "good-fraction target per objective",
+    )
+    for objective, desc in (slo.get("objectives") or {}).items():
+        lines.append(
+            _sample(
+                f"{base}_objective_target",
+                {"objective": objective}, desc.get("target"),
+            )
+        )
+    _family(
+        lines, f"{base}_objective_threshold_seconds", "gauge",
+        "latency threshold per objective (absent for error_rate)",
+    )
+    for objective, desc in (slo.get("objectives") or {}).items():
+        if desc.get("threshold_seconds") is not None:
+            lines.append(
+                _sample(
+                    f"{base}_objective_threshold_seconds",
+                    {"objective": objective},
+                    desc["threshold_seconds"],
+                )
+            )
+    per_bucket = (
+        ("burn_rate", "gauge",
+         "short-window error-budget burn multiple"),
+        ("good_fraction", "gauge",
+         "long-window good fraction vs the objective target"),
+        ("active", "gauge", "1 while the (objective, bucket) breaches"),
+        ("breaches_total", "counter",
+         "breach-state transitions per (objective, bucket)"),
+        ("samples", "gauge", "long-window sample count"),
+    )
+    for section, kind, help_text in per_bucket:
+        _family(lines, f"{base}_{section}", kind, help_text)
+        for objective, buckets in (slo.get(section) or {}).items():
+            for bucket, v in buckets.items():
+                lines.append(
+                    _sample(
+                        f"{base}_{section}",
+                        {"objective": objective, "bucket": bucket}, v,
+                    )
+                )
+
+
+def _render_memory_accounting(
+    lines: List[str], mem: Mapping[str, Any]
+) -> None:
+    base = f"{PREFIX}_memory"
+    _family(
+        lines, f"{base}_accounting_enabled", "gauge",
+        "1 when memory accounting is on",
+    )
+    lines.append(
+        _sample(f"{base}_accounting_enabled", None, mem.get("enabled"))
+    )
+    band = mem.get("band") or (0, 0)
+    _family(
+        lines, f"{base}_accuracy_band_low", "gauge",
+        "lower edge of the acceptable estimated/measured ratio",
+    )
+    lines.append(_sample(f"{base}_accuracy_band_low", None, band[0]))
+    _family(
+        lines, f"{base}_accuracy_band_high", "gauge",
+        "upper edge of the acceptable estimated/measured ratio",
+    )
+    lines.append(_sample(f"{base}_accuracy_band_high", None, band[1]))
+    per_bucket = (
+        ("estimated_bytes", "gauge",
+         "preflight model estimate for the bucket's last executed job"),
+        ("measured_bytes", "gauge",
+         "measured footprint (allocator delta, else compiled plan)"),
+        ("compiled_bytes", "gauge",
+         "XLA compiled-plan bytes (arguments + outputs + temps)"),
+        ("peak_delta_bytes", "gauge",
+         "device allocator high-water delta around the attempt"),
+        ("accuracy", "gauge",
+         "preflight accuracy: estimated over measured (1.0 = exact)"),
+        ("correction", "gauge",
+         "admission-gate scale factor fed back from measurements"),
+        ("flagged_total", "counter",
+         "accuracy-band excursions per bucket"),
+        ("active", "gauge",
+         "1 while the bucket's accuracy sits outside the band"),
+    )
+    for section, kind, help_text in per_bucket:
+        name = (
+            f"{PREFIX}_preflight_{section}"
+            if section in ("accuracy", "correction", "flagged_total",
+                           "active")
+            else f"{base}_{section}"
+        )
+        _family(lines, name, kind, help_text)
+        for bucket, v in (mem.get(section) or {}).items():
+            lines.append(_sample(name, {"bucket": bucket}, v))
+    _family(
+        lines, f"{base}_measurement_info", "gauge",
+        "measurement source per bucket (device | compiled)",
+    )
+    for bucket, src in (mem.get("source") or {}).items():
+        lines.append(
+            _sample(
+                f"{base}_measurement_info",
+                {"bucket": bucket, "source": src}, 1,
+            )
+        )
+
+
 def render_prometheus(metrics: Dict[str, Any]) -> str:
     """The scheduler metrics dict as Prometheus text format 0.0.4."""
     lines: List[str] = []
@@ -188,6 +332,12 @@ def render_prometheus(metrics: Dict[str, Any]) -> str:
             continue
         if key == "perf_drift":
             _render_perf_drift(lines, value)
+            continue
+        if key == "slo":
+            _render_slo(lines, value)
+            continue
+        if key == "memory_accounting":
+            _render_memory_accounting(lines, value)
             continue
         if key == "backend":
             _family(

@@ -1,0 +1,497 @@
+"""Forensic query engine over the serve JSONL event log.
+
+The serving subsystem's one durable telemetry stream is the EventLog
+JSONL file: lifecycle events, trace spans, drift/SLO/integrity verdicts
+all ride it (docs/OBSERVABILITY.md).  This module turns that file back
+into answers, offline, with nothing but the stdlib — it is the engine
+behind ``serve-admin trace``/``report``/``bundle``, tools that exist for
+exactly the moments the device stack is wedged (the serve-admin
+contract: no jax, no numpy, pinned by a ``-X importtime`` test).
+
+- :func:`render_trace`   — one job's whole story: its lifecycle events
+  in order plus the span tree (``queue_wait`` → ``attempt`` →
+  ``compile``/``execute`` → per-block children), reconstructed purely
+  from ``span`` events (trace_id == job_id);
+- :func:`summarize` / :func:`render_report` — per-bucket p50/p95/p99
+  latency, retry/wedge/drift/SLO/integrity breakdowns over a time
+  range (the post-incident "what happened while I slept" view);
+- :func:`build_bundle`   — a tar.gz forensic capsule for one job: its
+  jobstore record, its events slice, its spans, an optional live
+  ``/metrics`` snapshot, and an environment fingerprint — explicitly
+  WITHOUT the data matrix (bundles travel to people who should not
+  receive the data).
+
+Every reader is tolerant of torn/garbage lines (a crash mid-append is
+exactly the situation this tooling serves) — bad lines are counted, not
+fatal.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import os
+import platform
+import socket
+import sys
+import tarfile
+import time
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+#: Lifecycle event names rendered in a job's story (everything keyed by
+#: job_id that is not a span).
+_LIFECYCLE_SKIP_FIELDS = ("ts", "event", "job_id")
+
+
+def iter_events(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield parsed events from a JSONL log, skipping unparseable lines
+    (a torn tail from a crash mid-append must not kill the forensic
+    tool that exists to investigate that crash).  ``errors="replace"``
+    for the same reason: a torn line can hold invalid UTF-8 bytes, and
+    a decode crash here is the one failure mode this reader exists to
+    survive — the mangled line then just fails the JSON parse."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(event, dict):
+                yield event
+
+
+def load_events(
+    path: str,
+    since: Optional[float] = None,
+    until: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Events in [since, until] (unix seconds; None = unbounded)."""
+    out = []
+    for event in iter_events(path):
+        ts = event.get("ts")
+        if since is not None and (ts is None or ts < since):
+            continue
+        if until is not None and (ts is None or ts > until):
+            continue
+        out.append(event)
+    return out
+
+
+def job_events(
+    events: Iterable[Dict[str, Any]], job_id: str
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+    """(lifecycle events, spans) for one job, log order preserved.
+    Spans are matched on ``trace_id`` (== job_id for serve jobs),
+    lifecycle events on ``job_id``."""
+    lifecycle: List[Dict[str, Any]] = []
+    spans: List[Dict[str, Any]] = []
+    for event in events:
+        if event.get("event") == "span":
+            if event.get("trace_id") == job_id:
+                spans.append(event)
+        elif event.get("job_id") == job_id:
+            lifecycle.append(event)
+    return lifecycle, spans
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in (0, 1]) of an unsorted list.  The
+    epsilon guards float artefacts like ``0.95 * 20 == 19.000000000004``
+    rounding the rank up a slot."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    rank = max(1, math.ceil(q * len(ordered) - 1e-9))
+    return ordered[min(len(ordered), rank) - 1]
+
+
+# ---------------------------------------------------------------------------
+# trace: one job's span tree
+
+
+def build_span_tree(
+    spans: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Span events → forest of ``{"span": ..., "children": [...]}``
+    nodes.  Spans are emitted at END with ``seconds``, so a child's
+    START (ts - seconds) orders siblings; orphans (parent id never
+    emitted — e.g. an abandoned attempt whose parent span was dropped
+    by the generation guard) surface as extra roots rather than being
+    hidden."""
+    nodes = {
+        s.get("span_id"): {"span": s, "children": []} for s in spans
+    }
+
+    def start(node):
+        s = node["span"]
+        return (s.get("ts") or 0.0) - (s.get("seconds") or 0.0)
+
+    roots = []
+    for node in nodes.values():
+        parent = nodes.get(node["span"].get("parent_span_id"))
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in nodes.values():
+        node["children"].sort(key=start)
+    roots.sort(key=start)
+    return roots
+
+
+def _span_label(span: Dict[str, Any]) -> str:
+    skip = {
+        "name", "trace_id", "span_id", "parent_span_id", "seconds",
+        "status", "ts", "event",
+    }
+    detail = " ".join(
+        f"{k}={span[k]}" for k in sorted(span) if k not in skip
+    )
+    status = span.get("status", "ok")
+    line = f"{span.get('name', '?')}  {span.get('seconds', 0):.3f}s"
+    if status != "ok":
+        line += f"  [{status}]"
+    if detail:
+        line += f"  ({detail})"
+    return line
+
+
+def render_trace(
+    events: Iterable[Dict[str, Any]], job_id: str
+) -> str:
+    """One job's story as text: lifecycle lines, then the span tree."""
+    lifecycle, spans = job_events(events, job_id)
+    lines = [f"trace {job_id}"]
+    if not lifecycle and not spans:
+        lines.append("  (no events for this job in the log)")
+        return "\n".join(lines)
+    lines.append("")
+    lines.append("lifecycle:")
+    for event in lifecycle:
+        detail = " ".join(
+            f"{k}={event[k]}"
+            for k in sorted(event) if k not in _LIFECYCLE_SKIP_FIELDS
+        )
+        ts = event.get("ts")
+        stamp = (
+            time.strftime("%H:%M:%S", time.localtime(ts))
+            if isinstance(ts, (int, float)) else "?"
+        )
+        lines.append(f"  {stamp}  {event.get('event')}  {detail}")
+    lines.append("")
+    lines.append(f"spans ({len(spans)}):")
+
+    def walk(node, prefix, last):
+        branch = "└─ " if last else "├─ "
+        lines.append(prefix + branch + _span_label(node["span"]))
+        child_prefix = prefix + ("   " if last else "│  ")
+        kids = node["children"]
+        for i, child in enumerate(kids):
+            walk(child, child_prefix, i == len(kids) - 1)
+
+    roots = build_span_tree(spans)
+    for i, root in enumerate(roots):
+        walk(root, "  ", i == len(roots) - 1)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# report: per-bucket percentiles + incident breakdowns
+
+
+def summarize(
+    events: Iterable[Dict[str, Any]],
+    since: Optional[float] = None,
+    until: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Aggregate a (time-sliced) event stream into the operator report.
+
+    Latency percentiles are per shape bucket (``job_done`` events carry
+    ``bucket``; ``queue_wait`` spans join to their job's bucket via
+    trace_id) because the sweep's long-tail jobs make a global
+    percentile dishonest — one big-N job is not a regression."""
+    events = [
+        e for e in events
+        if (since is None or (e.get("ts") or 0) >= since)
+        and (until is None or (e.get("ts") or 0) <= until)
+    ]
+    statuses: Dict[str, int] = {}
+    job_seconds: Dict[str, List[float]] = {}
+    bucket_of: Dict[str, str] = {}
+    queue_wait_raw: List[Tuple[str, float]] = []  # (trace_id, seconds)
+    retries: Dict[str, int] = {}
+    wedges = 0
+    drift: Dict[str, int] = {}
+    slo: Dict[str, Dict[str, int]] = {}
+    integrity = 0
+    preflight_inaccurate: Dict[str, int] = {}
+    ts_lo = ts_hi = None
+    for e in events:
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            ts_lo = ts if ts_lo is None else min(ts_lo, ts)
+            ts_hi = ts if ts_hi is None else max(ts_hi, ts)
+        name = e.get("event")
+        if name == "span":
+            if e.get("name") == "queue_wait":
+                queue_wait_raw.append(
+                    (e.get("trace_id"), float(e.get("seconds") or 0.0))
+                )
+            continue
+        if name in (
+            "job_submitted", "job_done", "job_failed", "job_retry",
+            "job_wedged", "job_requeued", "job_quarantined", "job_shed",
+            "job_preflight_reject",
+        ):
+            statuses[name] = statuses.get(name, 0) + 1
+        if name == "job_done":
+            bucket = e.get("bucket") or "unknown"
+            if e.get("job_id"):
+                bucket_of[e["job_id"]] = bucket
+            if e.get("seconds") is not None:
+                job_seconds.setdefault(bucket, []).append(
+                    float(e["seconds"])
+                )
+        elif name == "job_failed":
+            # Failed jobs join their queue waits through the bucket
+            # too (carried since the job reached worker pickup): an
+            # overload whose jobs all fail must still show its backlog
+            # per bucket, not vanish from the report.
+            if e.get("job_id") and e.get("bucket"):
+                bucket_of[e["job_id"]] = e["bucket"]
+        elif name == "job_retry":
+            reason = e.get("reason", "unknown")
+            retries[reason] = retries.get(reason, 0) + 1
+        elif name == "job_wedged":
+            wedges += 1
+        elif name == "perf_drift":
+            bucket = e.get("bucket", "unknown")
+            drift[bucket] = drift.get(bucket, 0) + 1
+        elif name == "slo_breach":
+            objective = e.get("objective", "unknown")
+            bucket = e.get("bucket", "unknown")
+            slo.setdefault(objective, {})
+            slo[objective][bucket] = slo[objective].get(bucket, 0) + 1
+        elif name == "integrity_violation":
+            integrity += 1
+        elif name == "preflight_inaccurate":
+            bucket = e.get("bucket", "unknown")
+            preflight_inaccurate[bucket] = (
+                preflight_inaccurate.get(bucket, 0) + 1
+            )
+    queue_wait: Dict[str, List[float]] = {}
+    for trace_id, seconds in queue_wait_raw:
+        # Never drop a wait for lack of a terminal event: a job still
+        # running (or killed with the service) at the log's edge is
+        # part of the backlog story, filed under "unknown".
+        bucket = bucket_of.get(trace_id) or "unknown"
+        queue_wait.setdefault(bucket, []).append(seconds)
+
+    def stats(values: List[float]) -> Dict[str, Any]:
+        return {
+            "count": len(values),
+            "p50": percentile(values, 0.50),
+            "p95": percentile(values, 0.95),
+            "p99": percentile(values, 0.99),
+            "max": max(values) if values else None,
+        }
+
+    # Union of both keys: a bucket with queue waits but zero completed
+    # jobs (the wedged-backend overload) still gets a row — its
+    # job_seconds render as "-", its queue p95 tells the story.
+    per_bucket = {
+        bucket: {
+            "job_seconds": stats(job_seconds.get(bucket, [])),
+            "queue_wait_seconds": stats(queue_wait.get(bucket, [])),
+        }
+        for bucket in sorted(set(job_seconds) | set(queue_wait))
+    }
+    return {
+        "events": len(events),
+        "first_ts": ts_lo,
+        "last_ts": ts_hi,
+        "jobs": statuses,
+        "per_bucket": per_bucket,
+        "retries": retries,
+        "wedges": wedges,
+        "perf_drift": drift,
+        "slo_breaches": slo,
+        "integrity_violations": integrity,
+        "preflight_inaccurate": preflight_inaccurate,
+    }
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    """The :func:`summarize` dict as operator-readable text."""
+    lines = [
+        f"events: {report['events']}"
+        + (
+            f"  ({time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(report['first_ts']))}"
+            f" .. {time.strftime('%H:%M:%S', time.localtime(report['last_ts']))})"
+            if report.get("first_ts") is not None else ""
+        ),
+        "jobs: " + (
+            " ".join(
+                f"{k.replace('job_', '')}={v}"
+                for k, v in sorted(report["jobs"].items())
+            ) or "(none)"
+        ),
+        "",
+        "per-bucket latency (seconds):",
+    ]
+    if not report["per_bucket"]:
+        lines.append("  (no completed jobs in range)")
+    for bucket, section in report["per_bucket"].items():
+        js = section["job_seconds"]
+        qs = section["queue_wait_seconds"]
+
+        def fmt(v):
+            return "-" if v is None else f"{v:.3f}"
+
+        lines.append(
+            f"  {bucket}  n={js['count']}"
+            f"  job p50={fmt(js['p50'])} p95={fmt(js['p95'])}"
+            f" p99={fmt(js['p99'])} max={fmt(js['max'])}"
+            f"  queue p95={fmt(qs['p95'])}"
+        )
+    lines.append("")
+    lines.append(
+        "retries: " + (
+            " ".join(
+                f"{k}={v}" for k, v in sorted(report["retries"].items())
+            ) or "(none)"
+        )
+    )
+    lines.append(f"wedges: {report['wedges']}")
+    lines.append(
+        "perf_drift: " + (
+            " ".join(
+                f"{k}={v}"
+                for k, v in sorted(report["perf_drift"].items())
+            ) or "(none)"
+        )
+    )
+    if report["slo_breaches"]:
+        for objective, buckets in sorted(report["slo_breaches"].items()):
+            lines.append(
+                f"slo_breach[{objective}]: " + " ".join(
+                    f"{k}={v}" for k, v in sorted(buckets.items())
+                )
+            )
+    else:
+        lines.append("slo_breach: (none)")
+    lines.append(
+        f"integrity_violations: {report['integrity_violations']}"
+    )
+    lines.append(
+        "preflight_inaccurate: " + (
+            " ".join(
+                f"{k}={v}"
+                for k, v in sorted(report["preflight_inaccurate"].items())
+            ) or "(none)"
+        )
+    )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# bundle: a forensic capsule for one job
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """Where this bundle was cut: host/python/platform — stdlib only (a
+    wedged backend cannot be asked for its device_kind, and this tool
+    runs exactly then).  The job record's own ``result.backend`` carries
+    the backend label when the job completed."""
+    return {
+        "hostname": socket.gethostname(),
+        "platform": platform.platform(),
+        "python": sys.version.split()[0],
+        "created_at": round(time.time(), 3),
+        "tool": "consensus_clustering_tpu serve-admin bundle",
+    }
+
+
+def build_bundle(
+    store_dir: str,
+    events_path: Optional[str],
+    job_id: str,
+    out_path: str,
+    metrics_text: Optional[str] = None,
+) -> List[str]:
+    """Write ``out_path`` (tar.gz) with one job's forensic capsule;
+    returns the member names written.
+
+    Members: ``record.json`` (the jobstore record, result included),
+    ``events.jsonl`` (the job's lifecycle slice), ``spans.jsonl`` (its
+    trace), ``trace.txt`` (the rendered tree), ``report.json`` (the
+    whole-log summary for context), ``metrics.json`` (only when the
+    caller fetched a live snapshot), ``env.json``.  The data matrix is
+    DELIBERATELY absent — a bundle is for sharing, and the payload
+    ``.npy`` is the part that must not travel.
+    """
+    members: List[Tuple[str, bytes]] = []
+
+    record_path = os.path.join(store_dir, "jobs", f"{job_id}.json")
+    try:
+        with open(record_path, "rb") as f:
+            members.append(("record.json", f.read()))
+    except OSError:
+        members.append((
+            "record.json",
+            json.dumps(
+                {"job_id": job_id, "error": "no record in store"}
+            ).encode(),
+        ))
+    if events_path and os.path.exists(events_path):
+        events = load_events(events_path)
+        lifecycle, spans = job_events(events, job_id)
+        members.append((
+            "events.jsonl",
+            "".join(
+                json.dumps(e, sort_keys=True) + "\n" for e in lifecycle
+            ).encode(),
+        ))
+        members.append((
+            "spans.jsonl",
+            "".join(
+                json.dumps(s, sort_keys=True) + "\n" for s in spans
+            ).encode(),
+        ))
+        members.append((
+            "trace.txt", (render_trace(events, job_id) + "\n").encode()
+        ))
+        members.append((
+            "report.json",
+            json.dumps(summarize(events), indent=1, sort_keys=True)
+            .encode(),
+        ))
+    if metrics_text is not None:
+        members.append(("metrics.json", metrics_text.encode()))
+    members.append((
+        "env.json",
+        json.dumps(env_fingerprint(), indent=1, sort_keys=True).encode(),
+    ))
+
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    try:
+        with tarfile.open(tmp, "w:gz") as tar:
+            for name, blob in members:
+                info = tarfile.TarInfo(name=f"{job_id}/{name}")
+                info.size = len(blob)
+                info.mtime = int(time.time())
+                tar.addfile(info, io.BytesIO(blob))
+        os.replace(tmp, out_path)
+    except BaseException:
+        # Disk-full mid-write: the half-tar lives wherever --out
+        # pointed, outside any store GC's reach — clean it here.
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return [f"{job_id}/{name}" for name, _ in members]

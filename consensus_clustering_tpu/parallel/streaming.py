@@ -95,6 +95,7 @@ from consensus_clustering_tpu.parallel.mesh import (
     resample_mesh,
 )
 from consensus_clustering_tpu.parallel.sweep import (
+    compiled_memory_stats,
     fit_resample_lanes,
     resample_lane_keys,
     shard_map,
@@ -399,6 +400,62 @@ class StreamingSweep:
         # compiled lazily on the first checked block so runs with
         # integrity_check_every=0 never pay its trace/compile.
         self._sentinel = None
+        # XLA's static memory plan for the block executable, memoized by
+        # compiled_memory_stats() — None until a caller asks (the AOT
+        # lowering it needs is not free, so plain run() never pays it).
+        self._compiled_memory: Optional[Dict[str, int]] = None
+
+    # -- memory accounting -----------------------------------------------
+
+    def compiled_memory_stats(self) -> Dict[str, int]:
+        """XLA's static memory plan for the warm block executable
+        (arguments + outputs + peak temporaries — the HBM commitment of
+        the program), via the helper shared with ``run_sweep`` and
+        ``benchmarks/memory_scaling.py``.  {} when the backend exposes
+        no plan.
+
+        Computed once per engine through an AOT ``lower().compile()`` at
+        the exact call signature :meth:`run` uses; with the persistent
+        XLA compilation cache on (the serving default) the compile is a
+        disk hit of the program :meth:`warmup` already populated, so the
+        marginal cost is one retrace.  NOT computed by :meth:`run`
+        itself — batch parity paths must not pay a second trace — the
+        serve executor and :func:`run_streaming_sweep` ask explicitly,
+        once per bucket/build (docs/OBSERVABILITY.md "Memory
+        accounting").  The compiled object is never executed, only
+        analysed, so the jaxlib-CPU deserialize-then-donate crash gated
+        by ``CCTPU_STREAM_DONATE`` is not in play here.
+        """
+        if self._compiled_memory is not None:
+            return dict(self._compiled_memory)
+        try:
+            state_struct = {
+                "mij": jax.ShapeDtypeStruct(
+                    (self._nk_pad, self._n_pad, self._n_pad),
+                    jnp.int32,
+                    sharding=self._state_shardings["mij"],
+                ),
+                "iij": jax.ShapeDtypeStruct(
+                    (self._n_pad, self._n_pad),
+                    jnp.int32,
+                    sharding=self._state_shardings["iij"],
+                ),
+            }
+            x_struct = jax.ShapeDtypeStruct(
+                (self.config.n_samples, self.config.n_features),
+                jnp.dtype(self.config.dtype),
+            )
+            lowered = self._step.lower(
+                state_struct, x_struct, jax.random.PRNGKey(0),
+                jnp.int32(0), jnp.int32(0),
+            )
+            self._compiled_memory = compiled_memory_stats(
+                lowered.compile()
+            )
+        except Exception as e:  # noqa: BLE001 — accounting is telemetry
+            logger.debug("compiled memory plan unavailable: %s", e)
+            self._compiled_memory = {}
+        return dict(self._compiled_memory)
 
     # -- integrity -------------------------------------------------------
 
@@ -922,6 +979,11 @@ class StreamingSweep:
                 run_seconds, 1e-9
             ),
             "device_memory": device_memory_stats(),
+            # The block executable's static memory plan, when a caller
+            # asked for it (run_streaming_sweep and the serve executor
+            # do, once per engine); {} until then — run() itself never
+            # pays the AOT retrace (see compiled_memory_stats).
+            "compiled_memory": dict(self._compiled_memory or {}),
         }
         return out
 
@@ -958,6 +1020,10 @@ def run_streaming_sweep(
         )
     engine = StreamingSweep(clusterer, config, mesh)
     compile_seconds = engine.warmup(x)
+    # Populate the static memory plan once, right after the warmup
+    # compile (a persistent-cache disk hit at worst): every repeat's
+    # timing block then reports compiled_memory like run_sweep's does.
+    engine.compiled_memory_stats()
     best = None
     run_times = []
     for rep in range(max(1, repeats)):

@@ -608,12 +608,22 @@ def run_sweep(
         # some plugin backends (the axon tunnel returns None), but the
         # compile-time plan — arguments + outputs + peak temporaries — is
         # the HBM commitment of the program and is always available.
-        "compiled_memory": _compiled_memory_stats(compiled),
+        "compiled_memory": compiled_memory_stats(compiled),
     }
     return host
 
 
-def _compiled_memory_stats(compiled) -> Dict[str, int]:
+def compiled_memory_stats(compiled) -> Dict[str, int]:
+    """XLA's static memory plan for a compiled executable, as a JSON-able
+    dict ({} when the backend exposes none).  ``total_bytes`` sums the
+    argument/output/temp terms — the HBM commitment of the program,
+    available on every backend including CPU (unlike the runtime
+    allocator high-water some plugins withhold).  Shared by the batch
+    sweep's timing block, the streaming engine
+    (:meth:`~consensus_clustering_tpu.parallel.streaming.StreamingSweep.
+    compiled_memory_stats`), benchmarks/memory_scaling.py, and the serve
+    executor's per-bucket memory accounting — one implementation, so the
+    numbers cannot drift between surfaces."""
     try:
         ma = compiled.memory_analysis()
     except Exception:  # pragma: no cover - backend-dependent

@@ -12,6 +12,24 @@ the service.
     python -m consensus_clustering_tpu serve-admin --store-dir DIR release JOB_ID
     python -m consensus_clustering_tpu serve-admin --store-dir DIR \
         profile-next TRACE_DIR
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR \
+        trace JOB_ID --events EVENTS.jsonl
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR \
+        report --events EVENTS.jsonl [--since TS] [--until TS]
+    python -m consensus_clustering_tpu serve-admin --store-dir DIR \
+        bundle JOB_ID --events EVENTS.jsonl [--out X.tar.gz] \
+        [--metrics-url http://HOST:PORT/metrics]
+
+``trace``/``report``/``bundle`` are the forensic query engine
+(:mod:`consensus_clustering_tpu.obs.query`, docs/OBSERVABILITY.md
+"Query engine") over the service's JSONL event log: ``trace`` renders
+one job's lifecycle + span tree, ``report`` aggregates per-bucket
+p50/p95/p99 latency and retry/wedge/drift/SLO breakdowns over a time
+range, and ``bundle`` cuts a shareable tar.gz capsule for one job
+(record, events slice, spans, rendered trace, optional live /metrics
+snapshot, environment fingerprint — NEVER the data matrix).  All three
+honour the serve-admin stdlib contract below: they must work while a
+backend is wedged.
 
 ``profile-next`` arms a ONE-SHOT ``jax.profiler`` trace: the live
 service claims the arm before its next executed job and runs that job's
@@ -207,6 +225,59 @@ def add_arguments(parser) -> None:
         "claims the arm per job — no restart needed)",
     )
     profile.add_argument("profile_dir", metavar="PROFILE_DIR")
+    trace = sub.add_parser(
+        "trace",
+        help="render one job's lifecycle + span tree from the JSONL "
+        "event log (trace_id == job_id; offline, stdlib-only)",
+    )
+    trace.add_argument("job_id")
+    trace.add_argument(
+        "--events", required=True, metavar="EVENTS.jsonl",
+        help="the service's --events-path file",
+    )
+    report = sub.add_parser(
+        "report",
+        help="per-bucket p50/p95/p99 latency + retry/wedge/drift/SLO "
+        "breakdowns over a time range of the JSONL event log",
+    )
+    report.add_argument(
+        "--events", required=True, metavar="EVENTS.jsonl",
+        help="the service's --events-path file",
+    )
+    report.add_argument(
+        "--since", type=float, default=None, metavar="UNIX_TS",
+        help="ignore events before this unix timestamp",
+    )
+    report.add_argument(
+        "--until", type=float, default=None, metavar="UNIX_TS",
+        help="ignore events after this unix timestamp",
+    )
+    report.add_argument(
+        "--json", action="store_true", dest="report_json",
+        help="emit the report as JSON instead of text",
+    )
+    bundle = sub.add_parser(
+        "bundle",
+        help="cut a forensic tar.gz for one job: record, events slice, "
+        "spans, rendered trace, optional live /metrics snapshot, env "
+        "fingerprint — never the data matrix",
+    )
+    bundle.add_argument("job_id")
+    bundle.add_argument(
+        "--events", default=None, metavar="EVENTS.jsonl",
+        help="the service's --events-path file (omit for a "
+        "record-only bundle)",
+    )
+    bundle.add_argument(
+        "--out", default=None, metavar="OUT.tar.gz",
+        help="output path (default: <job_id>-bundle.tar.gz)",
+    )
+    bundle.add_argument(
+        "--metrics-url", default=None, metavar="URL",
+        help="live service /metrics endpoint to snapshot into the "
+        "bundle (fetch failure is non-fatal — the service may be the "
+        "thing being debugged)",
+    )
 
 
 def cmd_serve_admin(args) -> int:
@@ -253,5 +324,87 @@ def cmd_serve_admin(args) -> int:
             f"{path}; one-shot — re-arm for another capture). Watch "
             "for the profile_captured event."
         )
+        return 0
+    if args.admin_cmd == "trace":
+        # The query engine is stdlib-only like everything the obs
+        # package exports — imported here so list/show/release stay as
+        # light as they always were.
+        from consensus_clustering_tpu.obs.query import (
+            load_events,
+            render_trace,
+        )
+
+        try:
+            events = load_events(args.events)
+        except OSError as e:
+            print(f"cannot read events log: {e}", file=sys.stderr)
+            return 1
+        print(render_trace(events, args.job_id))
+        return 0
+    if args.admin_cmd == "report":
+        from consensus_clustering_tpu.obs.query import (
+            load_events,
+            render_report,
+            summarize,
+        )
+
+        try:
+            # Time bounds applied at the reader: a long-lived service's
+            # log need not be materialized past the requested range.
+            events = load_events(
+                args.events, since=args.since, until=args.until
+            )
+        except OSError as e:
+            print(f"cannot read events log: {e}", file=sys.stderr)
+            return 1
+        report = summarize(events, since=args.since, until=args.until)
+        if args.report_json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            print(render_report(report))
+        return 0
+    if args.admin_cmd == "bundle":
+        from consensus_clustering_tpu.obs.query import build_bundle
+
+        if args.events is not None and not os.path.isfile(args.events):
+            # The sibling trace/report error here too: a mistyped
+            # --events during an incident must not silently cut a
+            # capsule with no events/spans/trace/report members
+            # (omitting --events entirely still cuts the documented
+            # record-only bundle).
+            print(
+                f"cannot read events log: {args.events}",
+                file=sys.stderr,
+            )
+            return 1
+        metrics_text = None
+        if args.metrics_url:
+            # Best-effort: the bundle is cut during incidents, and the
+            # service being down is not a reason to lose the capsule.
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                    args.metrics_url, timeout=10
+                ) as r:
+                    metrics_text = r.read().decode()
+            except Exception as e:  # noqa: BLE001 — non-fatal by design
+                print(
+                    f"warning: /metrics snapshot skipped ({e})",
+                    file=sys.stderr,
+                )
+        out_path = args.out or f"{args.job_id}-bundle.tar.gz"
+        try:
+            members = build_bundle(
+                args.store_dir, args.events, args.job_id, out_path,
+                metrics_text=metrics_text,
+            )
+        except OSError as e:
+            print(f"bundle failed: {e}", file=sys.stderr)
+            return 1
+        print(f"wrote {os.path.abspath(out_path)}:")
+        for name in members:
+            print(f"  {name}")
+        print("(no data matrix — bundles are for sharing)")
         return 0
     return 2

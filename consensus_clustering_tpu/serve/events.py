@@ -19,11 +19,16 @@ Events emitted by the service:
 - ``k_batch_complete``— per-K PAC at sweep completion (job_id, k, pac);
   emitted host-side by the executor once per K (the streaming driver
   owns the final curves, so no staged debug callback is involved)
-- ``job_done``        — result stored (job_id, fingerprint, seconds)
+- ``job_done``        — result stored (job_id, fingerprint, seconds,
+  bucket — the calibration shape-bucket string, so the offline query
+  engine can group latency per bucket; ``cached=True`` instead of
+  seconds when served by late dedup)
 - ``job_retry``       — transient failure, will re-run (job_id, attempt,
   backoff_seconds, error)
 - ``job_failed``      — permanent failure / retries exhausted / timeout
-  (job_id, error, kind)
+  (job_id, error, kind; plus bucket when the job reached worker pickup
+  — the forensic report joins failed jobs' queue waits through it, so
+  a backlog of failing jobs still shows up per bucket)
 
 Hostile-path events (docs/SERVING.md "Overload & wedge runbook"):
 
@@ -65,6 +70,18 @@ Observability events (docs/OBSERVABILITY.md):
 - ``profile_captured``— a one-shot ``serve-admin profile-next`` arm was
   consumed: the named job's first attempt ran under a ``jax.profiler``
   trace (job_id, profile_dir)
+- ``slo_breach``      — an (objective, bucket) pair's error-budget burn
+  rate exceeded the threshold over BOTH rolling windows (objective,
+  signal, bucket, threshold_seconds, target, burn_short, burn_long,
+  window_short_seconds, window_long_seconds, bad_count, sample_count);
+  one event per excursion, re-armed when the short-window burn drops
+  back under the threshold — docs/OBSERVABILITY.md "SLO layer"
+- ``preflight_inaccurate`` — the memory preflight model's accuracy
+  (estimated ÷ measured) left the configured band at a bucket (bucket,
+  accuracy, estimated_bytes, measured_bytes, source: device | compiled,
+  band_low, band_high, correction, observations); the correction
+  factor is already feeding the 413 gate — docs/OBSERVABILITY.md
+  "Memory accounting"
 """
 
 from __future__ import annotations

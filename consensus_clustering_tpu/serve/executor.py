@@ -45,6 +45,11 @@ import numpy as np
 from consensus_clustering_tpu.config import SweepConfig
 from consensus_clustering_tpu.obs.drift import DriftWatchdog
 from consensus_clustering_tpu.obs.histograms import LatencyHistogram
+from consensus_clustering_tpu.obs.memory import (
+    MemoryAccountant,
+    attributable_peak_delta,
+    judge_measurement,
+)
 from consensus_clustering_tpu.obs.tracing import Tracer
 
 _CLUSTERERS = ("kmeans", "gmm", "agglomerative", "spectral")
@@ -418,6 +423,7 @@ class SweepExecutor:
         calibration_store=None,
         integrity_check_every: int = 0,
         drift_watchdog: Optional[DriftWatchdog] = None,
+        memory_accountant: Optional[MemoryAccountant] = None,
     ):
         if default_h_block is not None and default_h_block < 1:
             raise ValueError(
@@ -500,6 +506,17 @@ class SweepExecutor:
         self.drift = (
             drift_watchdog if drift_watchdog is not None
             else DriftWatchdog()
+        )
+        # Memory accounting (docs/OBSERVABILITY.md "Memory accounting"):
+        # per-bucket preflight-estimate vs measured reality (allocator
+        # high-water when the backend reports one, else XLA's compiled
+        # plan), fed once per successful execution.  The scheduler
+        # surfaces the snapshot in /metrics, binds the
+        # preflight_inaccurate emitter, and feeds the correction factor
+        # back into the admission gate.
+        self.memory_accounting = (
+            memory_accountant if memory_accountant is not None
+            else MemoryAccountant()
         )
         self._engines: Dict[str, Any] = {}
         self._lock = threading.Lock()
@@ -783,6 +800,24 @@ class SweepExecutor:
         if heartbeat is not None:
             heartbeat.beat(PHASE_ENGINE_READY)
 
+        # Memory accounting (docs/OBSERVABILITY.md): the allocator view
+        # at attempt start — the peak delta around the run is measured
+        # against it.  CPU backends report {} (no allocator stats); the
+        # compiled plan below is the portable fallback truth.  With
+        # accounting disabled (--no-memory-accounting) the measurement
+        # cost is skipped too — no allocator reads, and crucially no
+        # per-bucket AOT retrace for the compiled plan; results then
+        # carry the (free) model estimate with measured fields null.
+        accounting_on = getattr(self.memory_accounting, "enabled", True)
+        if accounting_on:
+            from consensus_clustering_tpu.utils.metrics import (
+                device_memory_stats,
+            )
+
+            mem_before = device_memory_stats()
+        else:
+            mem_before = {}
+
         with self._lock:
             self._cb_gen += 1
             gen = self._cb_gen
@@ -978,6 +1013,56 @@ class SweepExecutor:
                 checkpointer.close()
 
         streaming = host["streaming"]
+
+        # Memory accounting: estimate (the preflight model, at the
+        # block size this job actually streamed with) vs measured
+        # reality — the allocator high-water delta when the backend
+        # reports one, else XLA's static plan for the warm block
+        # executable (memoized per engine; with the persistent compile
+        # cache the one-time AOT analysis is a disk hit).  Fed to the
+        # per-bucket accountant, whose correction flows back into the
+        # admission 413 gate, and disclosed per result below.
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_job_bytes,
+        )
+
+        if accounting_on:
+            from consensus_clustering_tpu.utils.metrics import (
+                device_memory_stats,
+            )
+
+            mem_after = device_memory_stats()
+            compiled_mem = engine.compiled_memory_stats()
+        else:
+            mem_after = {}
+            compiled_mem = {}
+        estimate = estimate_job_bytes(
+            n, d, spec.k_values,
+            dtype=spec.dtype,
+            h_block=int(resolution.value),
+            subsampling=spec.subsampling,
+            checkpoints=checkpointer is not None,
+        )
+        # High-water minus occupancy at start, attributable to THIS
+        # attempt only when the high-water advanced during it — a
+        # masked reading (an earlier larger job's peak) is disclosed
+        # but never measured, or the correction EWMA would permanently
+        # inflate the bucket's 413 gate (docs/OBSERVABILITY.md).
+        peak_delta, peak_masked = attributable_peak_delta(
+            mem_before, mem_after
+        )
+        measured_bytes, mem_source, accuracy = judge_measurement(
+            estimate["total_bytes"],
+            compiled_bytes=compiled_mem.get("total_bytes"),
+            peak_delta_bytes=peak_delta,
+        )
+        self.memory_accounting.observe(
+            drift_bucket,
+            estimate["total_bytes"],
+            compiled_bytes=compiled_mem.get("total_bytes"),
+            peak_delta_bytes=peak_delta,
+        )
+
         with self._lock:
             # Both totals advance together, on SUCCESSFUL executions
             # only: if requested were counted per attempt (retries,
@@ -1037,6 +1122,32 @@ class SweepExecutor:
             "resumed_from_block": int(
                 streaming.get("resumed_from_block", 0)
             ),
+            # Memory accounting (docs/OBSERVABILITY.md "Memory
+            # accounting"): what the preflight model predicted for this
+            # job vs what was measured — the per-job spelling of the
+            # /metrics memory_accounting section.  preflight_accuracy =
+            # estimated / measured (1.0 = the model is exact; the model
+            # deliberately over-counts, so healthy values sit above 1
+            # once N² dominates — tiny shapes sit below, XLA's lane
+            # temps being the part the model ignores).
+            "memory": {
+                "estimated_bytes": int(estimate["total_bytes"]),
+                "estimate": {
+                    key: estimate[key]
+                    for key in (
+                        "state_bytes", "pinned_state_generations",
+                        "workspace_bytes", "data_bytes", "lane_bytes",
+                    )
+                },
+                "compiled": compiled_mem,
+                "device_before": mem_before,
+                "device_after": mem_after,
+                "peak_delta_bytes": peak_delta,
+                "peak_masked": peak_masked,
+                "measured_bytes": measured_bytes,
+                "measurement_source": mem_source,
+                "preflight_accuracy": accuracy,
+            },
             "streaming": {
                 "h_block": int(streaming["h_block"]),
                 "h_requested": int(streaming["h_requested"]),

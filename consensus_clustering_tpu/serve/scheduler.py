@@ -73,8 +73,11 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from consensus_clustering_tpu.autotune.store import shape_bucket
 from consensus_clustering_tpu.obs.drift import DriftWatchdog
 from consensus_clustering_tpu.obs.histograms import LatencyHistogram
+from consensus_clustering_tpu.obs.memory import MemoryAccountant
+from consensus_clustering_tpu.obs.slo import SLOMonitor
 from consensus_clustering_tpu.obs.tracing import Tracer
 from consensus_clustering_tpu.resilience.faults import (
     IntegrityError,
@@ -194,11 +197,13 @@ _EXECUTOR_COUNTER_ATTRS = {
 
 # Executor-owned observability OBJECTS metrics() snapshots (same
 # rename-risk contract as the counter map above): the two histograms
-# the executor feeds first-hand, and the drift watchdog.
+# the executor feeds first-hand, the drift watchdog, and the memory
+# accountant.
 _EXECUTOR_OBJECT_ATTRS = (
     "hist_block_seconds",
     "hist_checkpoint_write_seconds",
     "drift",
+    "memory_accounting",
 )
 
 # Stub-safe zero sources: a duck-typed executor without the obs layer
@@ -206,6 +211,7 @@ _EXECUTOR_OBJECT_ATTRS = (
 # snapshot-only).
 _ZERO_HISTOGRAM = LatencyHistogram()
 _ZERO_DRIFT = DriftWatchdog(enabled=False)
+_ZERO_MEMORY = MemoryAccountant(enabled=False)
 
 # Statuses that never transition again: once mirrored to the jobstore,
 # records in these states are served from disk and evicted from memory.
@@ -241,6 +247,7 @@ class Scheduler:
         wedge_poll: float = 0.25,
         shed_policy: Optional[ShedPolicy] = None,
         memory_budget_bytes: Optional[int] = None,
+        slo: Optional[SLOMonitor] = None,
     ):
         if quarantine_after < 1:
             raise ValueError(
@@ -319,12 +326,29 @@ class Scheduler:
         self.hist_queue_wait_seconds = LatencyHistogram()
         self.perf_drift_events_total = 0
         self.profile_requests_total = 0
+        # SLO layer (docs/OBSERVABILITY.md "SLO layer"): per-bucket
+        # latency/error objectives over rolling windows, fed per
+        # executed job / per attempt below; breaches surface as
+        # slo_breach events + the pre-seeded counter.  The scheduler
+        # owns the monitor the way the executor owns the drift
+        # watchdog: it is where the signals live.
+        self.slo = slo if slo is not None else SLOMonitor()
+        self.slo.set_emitter(self._on_slo_breach)
+        self.slo_breach_events_total = 0
+        self.preflight_inaccurate_events_total = 0
         # Wire the executor's drift watchdog (when it has one) to this
         # scheduler's event log + counter: the watchdog computes the
         # verdicts, the scheduler owns the operator surfaces.
         drift = getattr(self.executor, "drift", None)
         if drift is not None and hasattr(drift, "set_emitter"):
             drift.set_emitter(self._on_perf_drift)
+        # Same wiring for the executor's memory accountant: the
+        # accountant judges the preflight model per bucket, the
+        # scheduler emits preflight_inaccurate and feeds the correction
+        # back into the admission gate (_preflight).
+        accountant = getattr(self.executor, "memory_accounting", None)
+        if accountant is not None and hasattr(accountant, "set_emitter"):
+            accountant.set_emitter(self._on_preflight_inaccurate)
 
     def _on_perf_drift(self, **payload) -> None:
         """Drift-watchdog emitter: one JSONL event + counter per
@@ -332,6 +356,28 @@ class Scheduler:
         with self._lock:
             self.perf_drift_events_total += 1
         self.events.emit("perf_drift", **payload)
+
+    def _on_slo_breach(self, **payload) -> None:
+        """SLO-monitor emitter: one JSONL event + counter per breach
+        excursion (docs/OBSERVABILITY.md "SLO layer")."""
+        with self._lock:
+            self.slo_breach_events_total += 1
+        self.events.emit("slo_breach", **payload)
+
+    def _on_preflight_inaccurate(self, **payload) -> None:
+        """Memory-accountant emitter: the preflight model left its
+        accuracy band at a bucket (docs/OBSERVABILITY.md "Memory
+        accounting")."""
+        with self._lock:
+            self.preflight_inaccurate_events_total += 1
+        self.events.emit("preflight_inaccurate", **payload)
+
+    @staticmethod
+    def _job_bucket(spec: JobSpec, n: int, d: int) -> str:
+        """The calibration-store bucket string for a job — the key the
+        drift watchdog, SLO monitor, and memory accountant all share,
+        so one bucket name means the same traffic on every surface."""
+        return shape_bucket(n, d, spec.n_iterations, spec.k_values)
 
     def _span_sink(self, payload: Dict[str, Any]) -> None:
         self.events.emit("span", **payload)
@@ -625,6 +671,27 @@ class Scheduler:
             subsampling=spec.subsampling,
             checkpoints=self.checkpoints,
         )
+        # Measured-reality feedback (docs/OBSERVABILITY.md "Memory
+        # accounting"): when this bucket's executed jobs have shown the
+        # model under-counting, scale the estimate UP by the observed
+        # correction before judging the budget.  The factor is >= 1 by
+        # construction — live evidence only ever tightens the gate, it
+        # never relaxes the model's own lower bound.
+        accountant = getattr(self.executor, "memory_accounting", None)
+        if accountant is not None and hasattr(accountant, "correction"):
+            try:
+                correction = float(
+                    accountant.correction(self._job_bucket(spec, n, d))
+                )
+            except Exception:  # noqa: BLE001 — the gate survives an
+                correction = 1.0  # accounting hiccup; the model stands
+            if correction > 1.0:
+                estimate = dict(estimate)
+                estimate["model_total_bytes"] = estimate["total_bytes"]
+                estimate["correction_factor"] = round(correction, 4)
+                estimate["total_bytes"] = int(
+                    estimate["total_bytes"] * correction
+                )
         try:
             check_admission(estimate, self.memory_budget_bytes, x.shape)
         except PreflightReject as e:
@@ -696,6 +763,9 @@ class Scheduler:
             _ZERO_HISTOGRAM,
         )
         drift = getattr(self.executor, "drift", _ZERO_DRIFT)
+        accountant = getattr(
+            self.executor, "memory_accounting", _ZERO_MEMORY
+        )
         with self._lock:
             return {
                 "queue_depth": self._queue.qsize(),
@@ -754,6 +824,16 @@ class Scheduler:
                 "perf_drift": drift.snapshot(),
                 "perf_drift_events_total": self.perf_drift_events_total,
                 "profile_requests_total": self.profile_requests_total,
+                # Resource accounting + SLO layer (docs/OBSERVABILITY.md
+                # "Memory accounting" / "SLO layer"): both snapshots
+                # carry FIXED top-level keys (schema-tested) with
+                # per-bucket sub-dicts that grow with traffic, copied
+                # under each object's own lock.
+                "memory_accounting": accountant.snapshot(),
+                "slo": self.slo.snapshot(),
+                "slo_breach_events_total": self.slo_breach_events_total,
+                "preflight_inaccurate_events_total":
+                    self.preflight_inaccurate_events_total,
                 "sweeps_executed": self.executor.run_count,
                 "backend": self.executor.backend(),
             }
@@ -917,6 +997,17 @@ class Scheduler:
         queue_wait = max(0.0, time.time() - submitted_at)
         self.hist_queue_wait_seconds.observe(queue_wait)
         tracer.record("queue_wait", queue_wait)
+        # The shared per-bucket key for the SLO ledger and the forensic
+        # report's grouping (job_done carries it — the JSONL log must
+        # be able to tell buckets apart offline, long-tail big-N jobs
+        # are not a small bucket's regression).
+        bucket = self._job_bucket(spec, *(int(v) for v in x.shape))
+        # Queue wait feeds its SLO ledger HERE, outcome-blind: an
+        # admission backlog whose jobs then fail or time out must
+        # still burn the objective (the wedged-backend overload is
+        # exactly when it pages; end-to-end latency stays success-only
+        # in the terminal path below).
+        self.slo.observe_queue_wait(bucket, queue_wait)
 
         # Late dedup: submission-time dedup misses a twin that was
         # still RUNNING (its result not yet stored), and a restart can
@@ -934,6 +1025,7 @@ class Scheduler:
             )
             self.events.emit(
                 "job_done", job_id=job_id, fingerprint=fp, cached=True,
+                bucket=bucket,
             )
             return
 
@@ -1037,6 +1129,9 @@ class Scheduler:
                             profile_dir=profile_dir,
                         )
             except JobTimeout as e:
+                # A timed-out attempt burned error budget like any
+                # other failed one (the SLO's error_rate signal).
+                self.slo.observe_attempt(bucket, ok=False)
                 with self._lock:
                     self.jobs_timed_out += 1
                     self.jobs_failed += 1
@@ -1045,7 +1140,8 @@ class Scheduler:
                     finished_at=round(time.time(), 3),
                 )
                 self.events.emit(
-                    "job_failed", job_id=job_id, error=str(e), kind="timeout"
+                    "job_failed", job_id=job_id, error=str(e),
+                    kind="timeout", bucket=bucket,
                 )
                 return
             except JobSpecError as e:
@@ -1058,10 +1154,14 @@ class Scheduler:
                 )
                 self.events.emit(
                     "job_failed", job_id=job_id, error=str(e),
-                    kind="bad_request",
+                    kind="bad_request", bucket=bucket,
                 )
                 return
             except Exception as e:
+                # Every failed attempt — retried or terminal — is one
+                # bad event for the SLO error_rate objective: a job
+                # that completes after two retries still burned budget.
+                self.slo.observe_attempt(bucket, ok=False)
                 # Triage before burning the retry budget: deterministic
                 # errors re-raise identically on every attempt, while
                 # the transient class (preemptions, device/runtime/IO
@@ -1146,6 +1246,7 @@ class Scheduler:
                         "retries_exhausted" if kind == "retryable"
                         else f"fatal:{reason}"
                     ),
+                    bucket=bucket,
                 )
                 return
             seconds = time.perf_counter() - t0
@@ -1166,15 +1267,20 @@ class Scheduler:
             # queue wait and retries included; dedup hits excluded —
             # they are disk reads, and folding their ~0s in would make
             # the execution distribution look bimodally fast).
-            self.hist_job_seconds.observe(
-                max(0.0, time.time() - submitted_at)
-            )
+            end_to_end = max(0.0, time.time() - submitted_at)
+            self.hist_job_seconds.observe(end_to_end)
+            # SLO feeds (docs/OBSERVABILITY.md "SLO layer"): the same
+            # end-to-end latency the histogram sees, judged against the
+            # bucket's objectives, plus one good attempt (queue wait
+            # was already fed at pickup, outcome-blind).
+            self.slo.observe_attempt(bucket, ok=True)
+            self.slo.observe_job(bucket, end_to_end, ok=True)
             self._update(
                 job_id, status="done", result=stored,
                 finished_at=round(time.time(), 3), seconds=seconds,
             )
             self.events.emit(
                 "job_done", job_id=job_id, fingerprint=fp,
-                seconds=round(seconds, 3),
+                seconds=round(seconds, 3), bucket=bucket,
             )
             return

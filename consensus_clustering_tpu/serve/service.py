@@ -239,6 +239,7 @@ class ConsensusService:
         wedge_compile_grace: float = 600.0,
         shed_policy: Optional[ShedPolicy] = None,
         memory_budget_bytes: Optional[int] = None,
+        slo_monitor=None,
     ):
         self.store = JobStore(store_dir)
         self.events = EventLog(events_path)
@@ -259,6 +260,7 @@ class ConsensusService:
             wedge_compile_grace=wedge_compile_grace,
             shed_policy=shed_policy,
             memory_budget_bytes=memory_budget_bytes,
+            slo=slo_monitor,
         )
         self.max_body_bytes = max_body_bytes
         self.started_at = time.time()

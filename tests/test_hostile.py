@@ -509,22 +509,61 @@ class TestQuarantine:
         assert exc.value.code == 0
         assert "no quarantined jobs" in capsys.readouterr().out
 
-    def test_serve_admin_never_imports_jax(self, tmp_path):
+    @pytest.mark.parametrize(
+        "subcommand", ["list", "trace", "report", "bundle"]
+    )
+    def test_serve_admin_never_imports_jax(self, tmp_path, subcommand):
         """serve-admin exists for the moments the device stack is
         wedged: it must not import — let alone initialise — jax (the
-        same ``-X importtime`` pin the lint subcommand carries)."""
+        same ``-X importtime`` pin the lint subcommand carries).  The
+        forensic query subcommands (trace/report/bundle — the obs
+        query engine) carry the identical contract: a span tree must
+        render while the backend is hung."""
+        import json as _json
         import subprocess
         import sys as _sys
 
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        jobs_dir = tmp_path / "jobs"
+        jobs_dir.mkdir(exist_ok=True)
+        (jobs_dir / "fedc01.json").write_text(
+            _json.dumps({"job_id": "fedc01", "status": "done"})
+        )
+        events = tmp_path / "ev.jsonl"
+        events.write_text(
+            _json.dumps(
+                {"ts": 1.0, "event": "job_done", "job_id": "fedc01",
+                 "seconds": 2.0, "bucket": "n40_d3_h16_k2-3"}
+            ) + "\n"
+            + _json.dumps(
+                {"ts": 1.0, "event": "span", "name": "queue_wait",
+                 "trace_id": "fedc01", "span_id": "ab", "seconds": 0.1,
+                 "parent_span_id": None, "status": "ok"}
+            ) + "\n"
+        )
+        args = {
+            "list": ["list"],
+            "trace": ["trace", "fedc01", "--events", str(events)],
+            "report": ["report", "--events", str(events)],
+            "bundle": [
+                "bundle", "fedc01", "--events", str(events),
+                "--out", str(tmp_path / "b.tar.gz"),
+            ],
+        }[subcommand]
         proc = subprocess.run(
             [_sys.executable, "-X", "importtime", "-m",
              "consensus_clustering_tpu", "serve-admin",
-             "--store-dir", str(tmp_path), "list"],
+             "--store-dir", str(tmp_path), *args],
             capture_output=True, text=True, cwd=repo, timeout=120,
         )
         assert proc.returncode == 0, proc.stderr
-        assert "no quarantined jobs" in proc.stdout
+        expected_out = {
+            "list": "no quarantined jobs",
+            "trace": "trace fedc01",
+            "report": "per-bucket latency",
+            "bundle": "env.json",
+        }[subcommand]
+        assert expected_out in proc.stdout
         imported = {
             line.split("|")[-1].strip()
             for line in proc.stderr.splitlines()

@@ -18,7 +18,7 @@ from consensus_clustering_tpu.config import SweepConfig
 from consensus_clustering_tpu.models.kmeans import KMeans
 from consensus_clustering_tpu.parallel.mesh import resample_mesh
 from consensus_clustering_tpu.parallel.sweep import (
-    _compiled_memory_stats,
+    compiled_memory_stats,
     build_sweep,
 )
 
@@ -34,7 +34,7 @@ def _plan(row_shards):
     sweep = build_sweep(KMeans(n_init=1), config, mesh)
     x = jax.numpy.zeros((N, 16), jax.numpy.float32)
     compiled = sweep.lower(x, jax.random.PRNGKey(0)).compile()
-    return _compiled_memory_stats(compiled)
+    return compiled_memory_stats(compiled)
 
 
 @pytest.mark.slow

@@ -30,10 +30,16 @@ from consensus_clustering_tpu.obs.histograms import (
     LatencyHistogram,
     bucket_label,
 )
+from consensus_clustering_tpu.obs.memory import (
+    MemoryAccountant,
+    attributable_peak_delta,
+    judge_measurement,
+)
 from consensus_clustering_tpu.obs.prom import (
     render_prometheus,
     validate_exposition,
 )
+from consensus_clustering_tpu.obs.slo import SLOMonitor, parse_objective
 from consensus_clustering_tpu.obs.tracing import Tracer
 from consensus_clustering_tpu.resilience.faults import (
     FaultInjector,
@@ -495,6 +501,526 @@ class TestQuietLogMirror:
 
 
 # ---------------------------------------------------------------------------
+# SLO monitor (docs/OBSERVABILITY.md "SLO layer")
+
+
+class TestSLOMonitor:
+    def _monitor(self, objectives, **kw):
+        self.clock = [1000.0]
+        kw.setdefault("windows", (60.0, 600.0))
+        kw.setdefault("burn_threshold", 1.0)
+        kw.setdefault("min_count", 1)
+        return SLOMonitor(
+            objectives, time_fn=lambda: self.clock[0], **kw
+        )
+
+    def test_parse_objective(self):
+        o = parse_objective("job_seconds:30")
+        assert (o.signal, o.threshold, o.target) == (
+            "job_seconds", 30.0, 0.95
+        )
+        o = parse_objective("queue_wait_seconds:5:0.99")
+        assert (o.threshold, o.target) == (5.0, 0.99)
+        o = parse_objective("error_rate::0.9")
+        assert o.threshold is None and o.target == 0.9
+
+    @pytest.mark.parametrize("bad", [
+        "job_seconds",            # no threshold slot at all
+        "nope:1:0.9",             # unknown signal
+        "job_seconds::0.9",       # latency objective needs a threshold
+        "job_seconds:0:0.9",      # threshold must be positive
+        "job_seconds:1:1.5",      # target outside (0, 1)
+        "job_seconds:1:0:9",      # too many fields
+    ])
+    def test_parse_objective_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_objective(bad)
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            SLOMonitor(windows=(100.0, 10.0))  # short > long
+        with pytest.raises(ValueError):
+            SLOMonitor(burn_threshold=0)
+        with pytest.raises(ValueError):
+            SLOMonitor(min_count=0)
+        with pytest.raises(ValueError):
+            SLOMonitor(["job_seconds:1", "job_seconds:2"])  # duplicate
+
+    def test_latency_breach_one_shot_and_rearm(self):
+        m = self._monitor(["job_seconds:5:0.9"])
+        hits = []
+        m.set_emitter(lambda **p: hits.append(p))
+        assert m.observe_job("b", 1.0) == []
+        out = m.observe_job("b", 50.0)
+        assert len(out) == 1 and out[0]["objective"] == "job_seconds"
+        assert out[0]["bucket"] == "b"
+        assert out[0]["burn_long"] >= 1.0
+        assert hits == out
+        # One-shot inside the excursion.
+        assert m.observe_job("b", 50.0) == []
+        snap = m.snapshot()
+        assert snap["active"]["job_seconds"]["b"] is True
+        assert snap["breaches_total"]["job_seconds"]["b"] == 1
+        # Good traffic dilutes the burn below threshold -> re-armed.
+        for _ in range(40):
+            m.observe_job("b", 1.0)
+        assert m.snapshot()["active"]["job_seconds"]["b"] is False
+
+    def test_breach_needs_both_windows(self):
+        """An incident that already resolved (bad events old enough to
+        have left the SHORT window) must not page: burn is required
+        over both windows."""
+        m = self._monitor(["job_seconds:5:0.5"], windows=(10.0, 600.0))
+        for _ in range(4):
+            m.observe_job("b", 50.0)  # breaches... but
+        # (min_count=1, so the above DID breach; reset to test re-entry)
+        assert m.snapshot()["active"]["job_seconds"]["b"] is True
+        self.clock[0] += 100  # bad events leave the short window
+        out = m.observe_job("b", 1.0)
+        assert out == []
+        assert m.snapshot()["active"]["job_seconds"]["b"] is False
+        # Long-window burn is still high, short is clean: stays quiet.
+        assert m.observe_job("b", 1.0) == []
+
+    def test_min_count_gate(self):
+        m = self._monitor(["job_seconds:5:0.9"], min_count=5)
+        for _ in range(4):
+            assert m.observe_job("b", 50.0) == []
+        assert len(m.observe_job("b", 50.0)) == 1
+
+    def test_error_rate_judged_per_attempt(self):
+        m = self._monitor(["error_rate::0.5"])
+        assert m.observe_attempt("b", ok=True) is None
+        out = m.observe_attempt("b", ok=False)
+        assert out is not None and out["signal"] == "error_rate"
+        # Latency observe_job never touches the error_rate ledger.
+        m2 = self._monitor(["error_rate::0.5"])
+        assert m2.observe_job("b", 1e9, ok=True) == []
+        assert m2.snapshot()["samples"]["error_rate"] == {}
+
+    def test_queue_wait_fed_at_pickup_outcome_blind(self):
+        """An admission backlog whose jobs then fail or time out must
+        still burn the queue_wait objective (the wedged-backend
+        overload is exactly the incident it exists to page on) — the
+        wait is fed at pickup via observe_queue_wait, before the
+        outcome exists, and observe_job no longer owns that ledger."""
+        m = self._monitor(["queue_wait_seconds:5:0.9"])
+        assert m.observe_queue_wait("b", 1.0) == []
+        out = m.observe_queue_wait("b", 500.0)
+        assert len(out) == 1
+        assert out[0]["objective"] == "queue_wait_seconds"
+        assert out[0]["bucket"] == "b"
+        # observe_job feeds job_seconds only — no double-count of the
+        # pickup-fed wait, however terminal latency arrives.
+        m2 = self._monitor(["queue_wait_seconds:5:0.9"])
+        assert m2.observe_job("b", 1e9) == []
+        assert m2.snapshot()["samples"]["queue_wait_seconds"] == {}
+
+    def test_failed_jobs_skip_latency_signals(self):
+        m = self._monitor(["job_seconds:5:0.5"])
+        assert m.observe_job("b", 1e9, ok=False) == []
+        assert m.snapshot()["samples"]["job_seconds"] == {}
+
+    def test_window_eviction(self):
+        m = self._monitor(["job_seconds:5:0.5"], windows=(10.0, 60.0))
+        m.observe_job("b", 50.0)
+        self.clock[0] += 120  # past the long window
+        m.observe_job("b", 1.0)
+        snap = m.snapshot()
+        assert snap["samples"]["job_seconds"]["b"] == 1  # old one gone
+        assert snap["good_fraction"]["job_seconds"]["b"] == 1.0
+
+    def test_breach_decays_without_traffic(self):
+        """A bucket that breaches and then goes QUIET must not report
+        active=true forever: snapshot() re-evaluates the windows
+        against the current time, so the breach state decays as the
+        bad samples age out — the re-arm cannot depend on a next
+        observation that never comes."""
+        m = self._monitor(["job_seconds:5:0.9"], windows=(10.0, 60.0))
+        m.observe_job("b", 50.0)
+        snap = m.snapshot()
+        assert snap["active"]["job_seconds"]["b"] is True
+        assert snap["burn_rate"]["job_seconds"]["b"] > 0
+        # Past the short window (bad sample still in the long one):
+        # the both-windows rule no longer holds -> re-armed, burn 0.
+        self.clock[0] += 30
+        snap = m.snapshot()
+        assert snap["active"]["job_seconds"]["b"] is False
+        assert snap["burn_rate"]["job_seconds"]["b"] == 0.0
+        assert snap["samples"]["job_seconds"]["b"] == 1
+        # Past the long window too: the sample evicts entirely.
+        self.clock[0] += 60
+        snap = m.snapshot()
+        assert snap["samples"]["job_seconds"]["b"] == 0
+        assert snap["good_fraction"]["job_seconds"] == {}
+        # The breach COUNT is history, not state: it stays.
+        assert snap["breaches_total"]["job_seconds"]["b"] == 1
+
+    def test_disabled_is_inert(self):
+        m = self._monitor(["job_seconds:5:0.9"], enabled=False)
+        assert m.observe_job("b", 1e9) == []
+        assert m.observe_attempt("b", ok=False) is None
+        snap = m.snapshot()
+        assert snap["enabled"] is False
+        assert snap["samples"]["job_seconds"] == {}
+
+    def test_snapshot_schema_preseeded_per_objective(self):
+        m = SLOMonitor()  # the default objectives
+        snap = m.snapshot()
+        assert set(snap) == {
+            "enabled", "windows", "burn_threshold", "min_count",
+            "objectives", "burn_rate", "good_fraction", "active",
+            "breaches_total", "samples",
+        }
+        assert set(snap["objectives"]) == {
+            "job_seconds", "queue_wait_seconds", "error_rate",
+        }
+        for section in (
+            "burn_rate", "good_fraction", "active", "breaches_total",
+            "samples",
+        ):
+            assert set(snap[section]) == set(snap["objectives"])
+
+    def test_emitter_failure_swallowed(self):
+        m = self._monitor(["job_seconds:5:0.9"])
+
+        def boom(**_p):
+            raise RuntimeError("sink down")
+
+        m.set_emitter(boom)
+        out = m.observe_job("b", 50.0)  # must not raise
+        assert len(out) == 1
+
+
+# ---------------------------------------------------------------------------
+# Memory accountant (docs/OBSERVABILITY.md "Memory accounting")
+
+
+class TestMemoryAccountant:
+    def test_judge_measurement_precedence(self):
+        # Allocator delta beats the compiled plan; compiled is the
+        # portable fallback; neither -> nothing to judge.
+        assert judge_measurement(100, 50, 200) == (200, "device", 0.5)
+        assert judge_measurement(100, 50, None) == (
+            50, "compiled", 2.0
+        )
+        assert judge_measurement(100, None, None) == (None, None, None)
+        assert judge_measurement(None, 50)[2] is None
+
+    def test_attributable_peak_delta_masking(self):
+        # High-water advanced during the attempt: delta attributable.
+        delta, masked = attributable_peak_delta(
+            {"bytes_in_use": 100, "peak_bytes_in_use": 500},
+            {"peak_bytes_in_use": 900},
+        )
+        assert (delta, masked) == (800, False)
+        # High-water did NOT advance: an earlier larger job's peak is
+        # masking this one's — discarded, or the correction EWMA would
+        # converge on the old job's footprint and permanently 413 the
+        # bucket (the gate floor means corrections only ever tighten).
+        delta, masked = attributable_peak_delta(
+            {"bytes_in_use": 100, "peak_bytes_in_use": 10_000},
+            {"peak_bytes_in_use": 10_000},
+        )
+        assert (delta, masked) == (None, True)
+        # No before-peak (backend reports only after): keep the legacy
+        # upper-bound reading rather than dropping the only signal.
+        delta, masked = attributable_peak_delta(
+            {"bytes_in_use": 100},
+            {"peak_bytes_in_use": 900},
+        )
+        assert (delta, masked) == (800, False)
+        # CPU backend: no allocator stats at all.
+        assert attributable_peak_delta({}, {}) == (None, None)
+
+    def test_unjudgeable_observation_clears_stale_accuracy(self):
+        """When a bucket's measurement disappears (masked peak AND no
+        compiled plan), the snapshot must not keep reporting the
+        previous accuracy as current — though ``active`` stays latched
+        (no measurement is not evidence the excursion resolved)."""
+        acc = MemoryAccountant(band=(0.2, 10.0))
+        acc.observe("b", 1000, peak_delta_bytes=100_000)  # flags
+        snap = acc.snapshot()
+        assert snap["active"]["b"] is True
+        assert snap["accuracy"]["b"] == 0.01
+        acc.observe("b", 1000)  # unjudgeable observation
+        snap = acc.snapshot()
+        assert "b" not in snap["accuracy"]
+        assert "b" not in snap["measured_bytes"]
+        assert snap["active"]["b"] is True
+
+    def test_accuracy_correction_and_floor(self):
+        acc = MemoryAccountant(band=(0.2, 10.0))
+        # Over-estimate (measured < estimated): correction floors at 1
+        # — live evidence never relaxes the gate below the model.
+        acc.observe("b", 1000, compiled_bytes=500)
+        assert acc.correction("b") == 1.0
+        # Under-estimate ratchets the correction up (EWMA toward 3.0).
+        acc2 = MemoryAccountant(band=(0.2, 10.0))
+        acc2.observe("b", 1000, peak_delta_bytes=3000)
+        assert acc2.correction("b") == 3.0
+        acc2.observe("b", 1000, peak_delta_bytes=5000)
+        assert 3.0 < acc2.correction("b") < 5.0
+        assert acc2.correction("never_seen") == 1.0
+
+    def test_band_one_shot_and_rearm(self):
+        acc = MemoryAccountant(band=(0.5, 2.0))
+        hits = []
+        acc.set_emitter(lambda **p: hits.append(p))
+        assert acc.observe("b", 1000, compiled_bytes=1000) is None
+        out = acc.observe("b", 1000, compiled_bytes=100)  # acc 10
+        assert out is not None and out["accuracy"] == 10.0
+        assert out["source"] == "compiled"
+        assert hits == [out]
+        # One-shot while outside the band.
+        assert acc.observe("b", 1000, compiled_bytes=100) is None
+        assert acc.snapshot()["active"]["b"] is True
+        # Back in band -> re-armed, then flags again.
+        assert acc.observe("b", 1000, compiled_bytes=1000) is None
+        assert acc.snapshot()["active"]["b"] is False
+        assert acc.observe("b", 1000, compiled_bytes=100) is not None
+        assert acc.snapshot()["flagged_total"]["b"] == 2
+
+    def test_no_measurement_is_inert(self):
+        acc = MemoryAccountant()
+        assert acc.observe("b", 1000) is None
+        snap = acc.snapshot()
+        assert snap["estimated_bytes"] == {"b": 1000}
+        assert snap["measured_bytes"] == {}
+        assert snap["accuracy"] == {}
+        assert acc.correction("b") == 1.0
+
+    def test_disabled_and_validation(self):
+        acc = MemoryAccountant(enabled=False)
+        assert acc.observe("b", 1000, compiled_bytes=1) is None
+        assert acc.snapshot()["enabled"] is False
+        with pytest.raises(ValueError):
+            MemoryAccountant(band=(1.5, 2.0))  # low must be <= 1
+        with pytest.raises(ValueError):
+            MemoryAccountant(band=(0.5, 0.9))  # high must be >= 1
+        with pytest.raises(ValueError):
+            MemoryAccountant(ewma_alpha=0)
+
+    def test_snapshot_schema(self):
+        snap = MemoryAccountant().snapshot()
+        assert set(snap) == {
+            "enabled", "band", "estimated_bytes", "measured_bytes",
+            "compiled_bytes", "peak_delta_bytes", "accuracy",
+            "correction", "source", "flagged_total", "active",
+        }
+
+    def test_emitter_failure_swallowed(self):
+        acc = MemoryAccountant(band=(0.5, 2.0))
+
+        def boom(**_p):
+            raise RuntimeError("sink down")
+
+        acc.set_emitter(boom)
+        assert acc.observe("b", 1000, compiled_bytes=1) is not None
+
+
+# ---------------------------------------------------------------------------
+# Forensic query engine (docs/OBSERVABILITY.md "Query engine")
+
+
+def _query():
+    from consensus_clustering_tpu.obs import query
+
+    return query
+
+
+_QUERY_EVENTS = [
+    {"ts": 10.0, "event": "job_submitted", "job_id": "j1",
+     "shape": [40, 3]},
+    {"ts": 10.1, "event": "span", "name": "queue_wait",
+     "trace_id": "j1", "span_id": "a", "parent_span_id": None,
+     "seconds": 0.1, "status": "ok"},
+    {"ts": 14.0, "event": "span", "name": "attempt", "trace_id": "j1",
+     "span_id": "b", "parent_span_id": None, "seconds": 3.8,
+     "status": "ok", "attempt": 0},
+    {"ts": 13.9, "event": "span", "name": "execute", "trace_id": "j1",
+     "span_id": "c", "parent_span_id": "b", "seconds": 3.0,
+     "status": "ok"},
+    {"ts": 12.0, "event": "span", "name": "h_block", "trace_id": "j1",
+     "span_id": "d", "parent_span_id": "c", "seconds": 1.0, "block": 0},
+    {"ts": 12.5, "event": "span", "name": "orphan_child",
+     "trace_id": "j1", "span_id": "e", "parent_span_id": "gone",
+     "seconds": 0.2, "status": "ok"},
+    {"ts": 14.1, "event": "job_done", "job_id": "j1", "seconds": 4.0,
+     "bucket": "n40_d3_h16_k2-3"},
+    {"ts": 20.0, "event": "job_retry", "job_id": "j2",
+     "reason": "oom", "attempt": 0},
+    {"ts": 21.0, "event": "perf_drift", "bucket": "n40_d3_h16_k2-3",
+     "ratio": 0.4},
+    {"ts": 22.0, "event": "slo_breach", "objective": "job_seconds",
+     "bucket": "n40_d3_h16_k2-3"},
+    {"ts": 30.0, "event": "job_done", "job_id": "j3", "seconds": 9.0,
+     "bucket": "n40_d3_h16_k2-3"},
+]
+
+
+class TestQueryEngine:
+    def test_percentile_nearest_rank(self):
+        q = _query()
+        vals = [float(v) for v in range(1, 21)]  # 1..20
+        assert q.percentile(vals, 0.50) == 10.0
+        assert q.percentile(vals, 0.95) == 19.0
+        assert q.percentile(vals, 0.99) == 20.0
+        assert q.percentile([7.0], 0.95) == 7.0
+        assert q.percentile([], 0.95) is None
+
+    def test_iter_events_tolerates_garbage(self, tmp_path):
+        q = _query()
+        path = str(tmp_path / "ev.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"ts": 1, "event": "job_done"}) + "\n")
+            f.write("NOT JSON AT ALL\n")
+            f.write('"a bare string, not an object"\n')
+            f.write(json.dumps({"ts": 2, "event": "span"})[:-4] + "\n")
+            f.write(json.dumps({"ts": 3, "event": "job_failed"}) + "\n")
+        # A torn line with invalid UTF-8 bytes (crash mid-append): the
+        # reader must survive the DECODE too, not just the JSON parse.
+        with open(path, "ab") as f:
+            f.write(b'{"ts": 4, "event": "job_\xff\xfe\n')
+        events = list(q.iter_events(path))
+        assert [e["event"] for e in events] == ["job_done", "job_failed"]
+
+    def test_trace_renders_tree_and_orphans(self):
+        q = _query()
+        text = q.render_trace(_QUERY_EVENTS, "j1")
+        assert "trace j1" in text
+        assert "job_submitted" in text and "job_done" in text
+        # The tree: h_block indented under execute under attempt.
+        exec_line = next(
+            line for line in text.splitlines() if "execute" in line
+        )
+        block_line = next(
+            line for line in text.splitlines() if "h_block" in line
+        )
+        assert block_line.index("h_block") > exec_line.index("execute")
+        # A span whose parent was dropped (generation guard) still
+        # surfaces as a root instead of disappearing.
+        assert "orphan_child" in text
+        assert "(no events" in q.render_trace(_QUERY_EVENTS, "nope")
+
+    def test_summarize_per_bucket_and_range(self):
+        q = _query()
+        report = q.summarize(_QUERY_EVENTS)
+        section = report["per_bucket"]["n40_d3_h16_k2-3"]
+        assert section["job_seconds"]["count"] == 2
+        assert section["job_seconds"]["p50"] == 4.0
+        assert section["job_seconds"]["max"] == 9.0
+        assert section["queue_wait_seconds"]["count"] == 1
+        assert report["retries"] == {"oom": 1}
+        assert report["perf_drift"] == {"n40_d3_h16_k2-3": 1}
+        assert report["slo_breaches"]["job_seconds"] == {
+            "n40_d3_h16_k2-3": 1
+        }
+        # Time-sliced: only the second job_done remains.
+        late = q.summarize(_QUERY_EVENTS, since=25.0)
+        assert late["per_bucket"]["n40_d3_h16_k2-3"][
+            "job_seconds"
+        ]["count"] == 1
+        assert late["retries"] == {}
+        text = q.render_report(report)
+        assert "n40_d3_h16_k2-3" in text and "p95" in text
+        assert "slo_breach[job_seconds]" in text
+
+    def test_bundle_members_and_no_data_matrix(self, tmp_path):
+        q = _query()
+        store = tmp_path / "store"
+        (store / "jobs").mkdir(parents=True)
+        (store / "payloads").mkdir()
+        (store / "jobs" / "j1.json").write_text(
+            json.dumps({"job_id": "j1", "status": "done"})
+        )
+        # The data matrix that must NOT travel.
+        (store / "payloads" / "j1.npy").write_bytes(b"\x93NUMPY")
+        events_path = str(tmp_path / "ev.jsonl")
+        with open(events_path, "w") as f:
+            for event in _QUERY_EVENTS:
+                f.write(json.dumps(event) + "\n")
+        out = str(tmp_path / "bundle.tar.gz")
+        members = q.build_bundle(
+            str(store), events_path, "j1", out, metrics_text="{}"
+        )
+        import tarfile
+
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+        assert set(members) == set(names)
+        for member in (
+            "record.json", "events.jsonl", "spans.jsonl", "trace.txt",
+            "report.json", "metrics.json", "env.json",
+        ):
+            assert f"j1/{member}" in names
+        assert not any(name.endswith(".npy") for name in names)
+        # Record-less store still cuts a capsule (the record member
+        # says why) — the tool serves incidents, not happy paths.
+        members2 = q.build_bundle(
+            str(store), events_path, "ghost",
+            str(tmp_path / "b2.tar.gz"),
+        )
+        assert "ghost/record.json" in members2
+        assert "ghost/metrics.json" not in (m for m in members2)
+
+    def test_bundle_cli_errors_on_missing_events(self, tmp_path, capsys):
+        """A mistyped --events during an incident must error like the
+        sibling trace/report subcommands do — NOT exit 0 with a capsule
+        silently missing its events/spans/trace/report members."""
+        from consensus_clustering_tpu.cli import main
+
+        (tmp_path / "jobs").mkdir()
+        with pytest.raises(SystemExit) as exc:
+            main([
+                "serve-admin", "--store-dir", str(tmp_path),
+                "bundle", "j1",
+                "--events", str(tmp_path / "tpyo.jsonl"),
+                "--out", str(tmp_path / "b.tar.gz"),
+            ])
+        assert exc.value.code == 1
+        assert "cannot read events log" in capsys.readouterr().err
+        assert not os.path.exists(tmp_path / "b.tar.gz")
+        # Omitting --events entirely stays the documented record-only
+        # path — the guard is for mistyped paths, not for the feature.
+        with pytest.raises(SystemExit) as exc:
+            main([
+                "serve-admin", "--store-dir", str(tmp_path),
+                "bundle", "j1",
+                "--out", str(tmp_path / "b2.tar.gz"),
+            ])
+        assert exc.value.code == 0
+        assert os.path.exists(tmp_path / "b2.tar.gz")
+
+    def test_report_keeps_failed_and_unfinished_queue_waits(self):
+        """A backlog whose jobs fail (or never finish) must still show
+        per-bucket queue waits — job_failed carries the bucket since
+        pickup, and waits with no terminal event file under
+        'unknown' instead of vanishing."""
+        q = _query()
+        events = [
+            {"ts": 1.0, "event": "span", "name": "queue_wait",
+             "trace_id": "f1", "span_id": "a1", "parent_span_id": None,
+             "seconds": 600.0, "status": "ok"},
+            {"ts": 2.0, "event": "job_failed", "job_id": "f1",
+             "error": "wall-clock", "kind": "timeout", "bucket": "bX"},
+            {"ts": 3.0, "event": "span", "name": "queue_wait",
+             "trace_id": "ghost", "span_id": "a2",
+             "parent_span_id": None, "seconds": 300.0, "status": "ok"},
+        ]
+        report = q.summarize(events)
+        # No completed job anywhere, yet both waits survive.
+        assert report["per_bucket"]["bX"]["queue_wait_seconds"][
+            "count"
+        ] == 1
+        assert report["per_bucket"]["bX"]["job_seconds"]["count"] == 0
+        assert report["per_bucket"]["unknown"]["queue_wait_seconds"][
+            "max"
+        ] == 300.0
+        q.render_report(report)  # zero-job rows must render
+
+
+# ---------------------------------------------------------------------------
 # Events contract: every emitted name is catalogued, and vice versa
 
 
@@ -634,6 +1160,7 @@ class _ObsStubExecutor:
         self.hist_block_seconds = LatencyHistogram()
         self.hist_checkpoint_write_seconds = LatencyHistogram()
         self.drift = DriftWatchdog(min_observations=1)
+        self.memory_accounting = MemoryAccountant(band=(0.5, 2.0))
         self.run_calls = []
         self._script = list(script or [])
 
@@ -804,6 +1331,132 @@ class TestSchedulerObsWiring:
         finally:
             sched.stop()
 
+    def test_slo_error_rate_breach_wired(self, tmp_path):
+        """A failed attempt burns error budget; past the burn threshold
+        the scheduler emits slo_breach with the job's shape bucket and
+        counts it — the drift watchdog's wiring shape, for SLOs."""
+        events_path = str(tmp_path / "ev.jsonl")
+        ex = _ObsStubExecutor(script=[RuntimeError("boom")])
+        sched = Scheduler(
+            ex, JobStore(str(tmp_path / "store")),
+            events=EventLog(events_path), max_retries=0,
+            sleep=lambda _s: None,
+            slo=SLOMonitor(
+                ["error_rate::0.5"], windows=(60.0, 600.0),
+                burn_threshold=1.0, min_count=1,
+            ),
+        )
+        sched.start()
+        try:
+            rec = sched.submit(*_spec())
+            assert (
+                _wait_done(sched, rec["job_id"])["status"] == "failed"
+            )
+            m = sched.metrics()
+            assert m["slo_breach_events_total"] == 1
+            assert m["slo"]["breaches_total"]["error_rate"] == {
+                "n4_d2_h5_k2-2": 1
+            }
+            breaches = [
+                json.loads(line) for line in open(events_path)
+                if '"slo_breach"' in line
+            ]
+            assert breaches and breaches[0]["bucket"] == "n4_d2_h5_k2-2"
+            assert breaches[0]["objective"] == "error_rate"
+        finally:
+            sched.stop()
+
+    def test_job_seconds_objective_breach_on_completion(self, tmp_path):
+        """A completed job's end-to-end latency is judged against its
+        bucket's objective (threshold 1µs here, so any real job
+        breaches) — and missing the SLO does not fail the job."""
+        events_path = str(tmp_path / "ev.jsonl")
+        ex = _ObsStubExecutor()
+        sched = Scheduler(
+            ex, JobStore(str(tmp_path / "store")),
+            events=EventLog(events_path),
+            slo=SLOMonitor(
+                ["job_seconds:0.000001:0.5"], windows=(60.0, 600.0),
+                burn_threshold=1.0, min_count=1,
+            ),
+        )
+        sched.start()
+        try:
+            rec = sched.submit(*_spec())
+            assert _wait_done(sched, rec["job_id"])["status"] == "done"
+            m = sched.metrics()
+            assert m["slo_breach_events_total"] == 1
+            breaches = [
+                json.loads(line) for line in open(events_path)
+                if '"slo_breach"' in line
+            ]
+            assert breaches[0]["objective"] == "job_seconds"
+            assert breaches[0]["bucket"] == "n4_d2_h5_k2-2"
+            # The job_done event carries the same bucket — the offline
+            # report's join key.
+            done = [
+                json.loads(line) for line in open(events_path)
+                if '"job_done"' in line
+            ]
+            assert done[0]["bucket"] == "n4_d2_h5_k2-2"
+        finally:
+            sched.stop()
+
+    def test_memory_accountant_emitter_wired(self, tmp_path):
+        """Scheduler construction binds the executor accountant's
+        emitter: an out-of-band observation surfaces as a
+        preflight_inaccurate event + counter + /metrics flag."""
+        events_path = str(tmp_path / "ev.jsonl")
+        ex = _ObsStubExecutor()
+        sched = Scheduler(
+            ex, JobStore(str(tmp_path / "store")),
+            events=EventLog(events_path),
+        )
+        ex.memory_accounting.observe("bX", 1000, compiled_bytes=100)
+        m = sched.metrics()
+        assert m["preflight_inaccurate_events_total"] == 1
+        assert m["memory_accounting"]["flagged_total"] == {"bX": 1}
+        assert m["memory_accounting"]["accuracy"] == {"bX": 10.0}
+        flagged = [
+            json.loads(line) for line in open(events_path)
+            if '"preflight_inaccurate"' in line
+        ]
+        assert flagged and flagged[0]["bucket"] == "bX"
+        assert flagged[0]["source"] == "compiled"
+
+    def test_preflight_correction_tightens_gate(self, tmp_path):
+        """Measured under-estimates feed back into admission: the same
+        job that passes the uncorrected model 413s once the bucket's
+        correction scales the estimate past the budget."""
+        from consensus_clustering_tpu.serve.preflight import (
+            PreflightReject,
+            estimate_job_bytes,
+        )
+
+        spec, x = _spec()
+        model = estimate_job_bytes(
+            4, 2, spec.k_values, dtype=spec.dtype, h_block=16,
+            subsampling=spec.subsampling, checkpoints=True,
+        )["total_bytes"]
+        ex = _ObsStubExecutor()
+        sched = Scheduler(
+            ex, JobStore(str(tmp_path / "store")),
+            memory_budget_bytes=model * 2,
+        )
+        # Uncorrected model under budget: admitted (worker not started
+        # — the queue slot is all this test needs).
+        sched.submit(spec, x)
+        # Live evidence: this bucket actually uses 3x the model.
+        ex.memory_accounting.observe(
+            "n4_d2_h5_k2-2", model, peak_delta_bytes=model * 3
+        )
+        with pytest.raises(PreflightReject) as exc:
+            sched.submit(spec, x)
+        payload = exc.value.payload
+        assert payload["estimated_bytes"] > model * 2
+        assert payload["estimate"]["correction_factor"] == 3.0
+        assert payload["estimate"]["model_total_bytes"] == model
+
     def test_metrics_prom_of_stub_scheduler_validates(self, tmp_path):
         sched = Scheduler(_ObsStubExecutor(), JobStore(str(tmp_path)))
         text = render_prometheus(sched.metrics())
@@ -827,6 +1480,10 @@ def test_obs_package_is_stdlib_only():
         "o.LatencyHistogram().observe(0.1);"
         "o.Tracer(lambda p: None).record('x', 0.1);"
         "o.DriftWatchdog().observe('b', 0.1, 1.0);"
+        "o.SLOMonitor().observe_job('b', 1.0);"
+        "o.MemoryAccountant().observe('b', 10, compiled_bytes=20);"
+        "from consensus_clustering_tpu.obs import query as q;"
+        "assert q.percentile([1.0, 2.0], 0.95) == 2.0;"
         "print('ok')"
     )
     out = subprocess.run(

@@ -111,6 +111,17 @@ def test_submit_poll_result_roundtrip(base, service):
     disclosure = result["autotune"]["stream_h_block"]
     assert disclosure["provenance"] == "default"
     assert disclosure["value"] == 16  # autotune_stream_block(10)
+    # Memory accounting (docs/OBSERVABILITY.md): every executed job
+    # reports its memory story — the preflight estimate, the compiled
+    # plan (the measured truth on CPU, where the allocator reports
+    # nothing), and a finite positive accuracy ratio.
+    mem = result["memory"]
+    assert mem["estimated_bytes"] > 0
+    assert mem["estimate"]["state_bytes"] > 0
+    assert mem["measurement_source"] in ("device", "compiled")
+    assert mem["measured_bytes"] > 0
+    assert mem["preflight_accuracy"] > 0
+    assert mem["compiled"].get("total_bytes", 0) > 0
 
 
 def test_duplicate_submission_served_from_jobstore(base, service):
@@ -175,6 +186,8 @@ EXPECTED_METRICS_KEYS = frozenset(
         "memory_budget_bytes", "integrity_checks_total",
         "integrity_violations_total", "latency_histograms", "perf_drift",
         "perf_drift_events_total", "profile_requests_total",
+        "memory_accounting", "slo", "slo_breach_events_total",
+        "preflight_inaccurate_events_total",
     }
 )
 
@@ -207,6 +220,28 @@ def test_metrics_schema(base):
         "enabled", "band", "ratio", "anchor_rate", "anchor_provenance",
         "flagged_total", "active",
     }
+    # Resource accounting + SLO layer (docs/OBSERVABILITY.md): both
+    # snapshots carry FIXED top-level keys; per-bucket sub-dicts are
+    # traffic-dynamic like retry_total.
+    assert set(m["memory_accounting"]) == {
+        "enabled", "band", "estimated_bytes", "measured_bytes",
+        "compiled_bytes", "peak_delta_bytes", "accuracy", "correction",
+        "source", "flagged_total", "active",
+    }
+    assert set(m["slo"]) == {
+        "enabled", "windows", "burn_threshold", "min_count",
+        "objectives", "burn_rate", "good_fraction", "active",
+        "breaches_total", "samples",
+    }
+    # Every per-objective section is pre-seeded with every configured
+    # objective (the dict-copy rule applied one level down).
+    for section in (
+        "burn_rate", "good_fraction", "active", "breaches_total",
+        "samples",
+    ):
+        assert set(m["slo"][section]) == set(m["slo"]["objectives"]), (
+            section
+        )
 
 
 def test_metrics_executor_attr_map_matches_real_executor():
