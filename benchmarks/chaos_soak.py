@@ -36,10 +36,29 @@ asserting the invariants the hostile path must hold:
   in ``checkpoint_verify_rejects_total`` — and recovery replays from
   the last *verified* generation, again byte-identically.
 
+- **at-most-once across workers** (cluster schedule) — TWO live serve
+  processes share one jobstore: flooded jobs complete exactly once
+  (every ``job_started``/``job_done`` attributed to exactly one
+  ``worker_id`` — the run-counter oracle), and a healthy renewing
+  worker is never falsely taken over (``lease_takeovers_total == 0``
+  on both);
+- **dead-worker takeover** (cluster schedule) — SIGKILL one worker
+  mid-job: the survivor's lease sweep claims the expired lease while
+  RUNNING (not at a boot), bumps the fencing token, resumes from the
+  dead worker's checkpoint ring, and finishes with a byte-identical
+  ``result_fingerprint``;
+- **zombie fencing** (cluster schedule) — a ``pause``-faulted worker
+  stops renewing (its attempt keeps running: the deterministic
+  zombie), a peer takes the job over and completes it, and the
+  zombie's late terminal write is REFUSED
+  (``lease_refused_writes_total`` ≥ 1) — the job still ends done
+  exactly once.
+
 Schedules::
 
     python benchmarks/chaos_soak.py --schedule smoke   # kill + hang (CI)
     python benchmarks/chaos_soak.py --schedule corrupt # bitflip defense (CI)
+    python benchmarks/chaos_soak.py --schedule cluster # two-worker leases (CI)
     python benchmarks/chaos_soak.py --schedule full    # everything above
                                                        # + oom, preflight, flood
 
@@ -732,12 +751,295 @@ def phase_flood(root, report):
 
 
 # ---------------------------------------------------------------------------
+# Cluster phases: two live workers over ONE shared jobstore
+# (docs/SERVING.md "Multi-worker runbook")
+
+
+def _worker_args(worker_id, ttl=None, extra=()):
+    args = ["--worker-id", worker_id]
+    if ttl is not None:
+        args += ["--lease-ttl", str(ttl)]
+    return args + list(extra)
+
+
+def _job_events(path, job_id, name):
+    return [e for e in _events(path)
+            if e.get("event") == name and e.get("job_id") == job_id]
+
+
+def phase_cluster_flood(root, report):
+    """The run-counter oracle: N jobs flooded across two workers on one
+    store complete EXACTLY once each (every started/done event
+    attributed to exactly one worker_id), and healthy wall-clock
+    renewal means zero takeovers, zero fenced writes, zero requeues —
+    the false-takeover invariant."""
+    store = os.path.join(root, "cluster_flood_store")
+    ev_a = os.path.join(root, "cluster_flood_a.jsonl")
+    ev_b = os.path.join(root, "cluster_flood_b.jsonl")
+    svc_a = ServiceProc(
+        store, extra_args=_worker_args("wa"), events_path=ev_a,
+    )
+    svc_b = None
+    try:
+        # Two jobs land on A BEFORE B boots: B's startup reconciliation
+        # walks the shared store, sees live-leased queued/running
+        # records, and must leave every one of them alone.
+        early = [svc_a.post("/jobs", _body(901 + i, n=48, d=3, iters=12))
+                 for i in range(2)]
+        svc_b = ServiceProc(
+            store, extra_args=_worker_args("wb"), events_path=ev_b,
+        )
+        owned = {}  # job_id -> the service that must run it
+        for _, rec, _ in early:
+            owned[rec["job_id"]] = svc_a
+        for i in range(2):
+            _, rec, _ = svc_a.post(
+                "/jobs", _body(903 + i, n=48, d=3, iters=12)
+            )
+            owned[rec["job_id"]] = svc_a
+        for i in range(4):
+            _, rec, _ = svc_b.post(
+                "/jobs", _body(905 + i, n=48, d=3, iters=12)
+            )
+            owned[rec["job_id"]] = svc_b
+        for job_id, svc in owned.items():
+            record = svc.poll_job(job_id)
+            if record["status"] != "done":
+                raise Violation(
+                    f"flooded job {job_id} ended {record['status']}: "
+                    f"{record.get('error')}"
+                )
+        # The oracle: merge both logs, attribute every attempt.
+        merged = _events(ev_a) + _events(ev_b)
+        for job_id in owned:
+            starters = {
+                e.get("worker_id") for e in merged
+                if e.get("event") == "job_started"
+                and e.get("job_id") == job_id
+            }
+            if len(starters) != 1:
+                raise Violation(
+                    f"job {job_id} started by {sorted(starters)} — a "
+                    "double execution across workers"
+                )
+            dones = [e for e in merged if e.get("event") == "job_done"
+                     and e.get("job_id") == job_id]
+            if len(dones) != 1:
+                raise Violation(
+                    f"job {job_id} has {len(dones)} job_done events, "
+                    "expected exactly 1"
+                )
+        metrics_a = svc_a.get("/metrics")
+        metrics_b = svc_b.get("/metrics")
+        if {metrics_a["worker_id"], metrics_b["worker_id"]} != {"wa", "wb"}:
+            raise Violation("worker identities not surfaced in /metrics")
+        for label, m in (("wa", metrics_a), ("wb", metrics_b)):
+            for counter in ("lease_takeovers_total",
+                            "lease_refused_writes_total",
+                            "jobs_requeued"):
+                if m[counter] != 0:
+                    raise Violation(
+                        f"false takeover: {label} {counter}="
+                        f"{m[counter]} with both workers healthy"
+                    )
+        if metrics_a["jobs_completed"] + metrics_b["jobs_completed"] != 8:
+            raise Violation(
+                "completions across workers sum to "
+                f"{metrics_a['jobs_completed'] + metrics_b['jobs_completed']}"
+                ", expected 8"
+            )
+        report["cluster_flood"] = {
+            "jobs": len(owned),
+            "completed_by": {
+                "wa": metrics_a["jobs_completed"],
+                "wb": metrics_b["jobs_completed"],
+            },
+            "false_takeovers": 0,
+        }
+    finally:
+        svc_a.stop()
+        if svc_b is not None:
+            svc_b.stop()
+
+
+def phase_cluster_takeover(root, report, refs):
+    """SIGKILL one of two live workers mid-job: the SURVIVOR (already
+    running — takeover must not wait for a boot) claims the expired
+    lease, bumps the fencing token, resumes from the dead worker's
+    checkpoint ring, and finishes byte-identically."""
+    store = os.path.join(root, "cluster_kill_store")
+    ev_a = os.path.join(root, "cluster_kill_a.jsonl")
+    ev_b = os.path.join(root, "cluster_kill_b.jsonl")
+    ttl = 4  # floored to 2x the 3 s wedge floor = 6 s effective
+    body = _body(911, n=160, d=5, iters=160)
+    svc_a = ServiceProc(
+        store, extra_args=_worker_args("wa", ttl=ttl), events_path=ev_a,
+    )
+    svc_b = None
+    try:
+        _, rec, _ = svc_a.post("/jobs", body)
+        job_id = rec["job_id"]
+        svc_b = ServiceProc(
+            store, extra_args=_worker_args("wb", ttl=ttl),
+            events_path=ev_b,
+        )
+        # Kill A the moment a checkpoint generation exists (the kill
+        # phase's window), so the takeover provably RESUMES.
+        ckpt_root = os.path.join(store, "checkpoints")
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if glob.glob(os.path.join(ckpt_root, "*", "gen-*.ckpt")):
+                svc_a.proc.kill()
+                svc_a.proc.wait(60)
+                break
+            status = svc_a.get(f"/jobs/{job_id}")["status"]
+            if status not in ("queued", "running"):
+                raise Violation(
+                    f"job reached {status} before any checkpoint landed"
+                )
+            time.sleep(0.05)
+        else:
+            raise Violation("no checkpoint generation appeared in budget")
+        record = svc_b.poll_job(job_id)
+        if record["status"] != "done":
+            raise Violation(
+                f"taken-over job ended {record['status']}: "
+                f"{record.get('error')}"
+            )
+        if record["result"]["result_fingerprint"] != refs["cluster_kill"]:
+            raise Violation(
+                "takeover fingerprint differs from uninterrupted run"
+            )
+        if not record.get("requeued_after_restart"):
+            raise Violation("survivor did not requeue the orphan")
+        takeovers = _job_events(ev_b, job_id, "lease_takeover")
+        if not takeovers:
+            raise Violation("no lease_takeover event on the survivor")
+        take = takeovers[0]
+        if take.get("prior_worker") != "wa" or take.get("token", 0) < 2:
+            raise Violation(
+                f"lease_takeover misattributed: {take}"
+            )
+        metrics_b = svc_b.get("/metrics")
+        if metrics_b["lease_takeovers_total"] < 1:
+            raise Violation("lease_takeovers_total not counted")
+        dones = (_job_events(ev_a, job_id, "job_done")
+                 + _job_events(ev_b, job_id, "job_done"))
+        if len(dones) != 1 or dones[0].get("worker_id") != "wb":
+            raise Violation(
+                f"expected exactly one job_done from wb, got {dones}"
+            )
+        report["cluster_takeover"] = {
+            "takeover_reason": take.get("reason"),
+            "fencing_token": take.get("token"),
+            "resumed_from_block": record["result"]["resumed_from_block"],
+            "lease_takeovers_total": metrics_b["lease_takeovers_total"],
+            "fingerprint_parity": True,
+        }
+    finally:
+        svc_a.stop()
+        if svc_b is not None:
+            svc_b.stop()
+
+
+def phase_cluster_zombie(root, report, refs):
+    """The deterministic zombie: worker A's lease renewal is stalled by
+    the ``pause`` fault while its attempt keeps running (a ``slow``
+    block holds the attempt open past the ttl).  Worker B takes the
+    job over and completes it from the ring; A wakes, finishes its
+    stale attempt, and its terminal write must be REFUSED by the fence
+    — the job still ends done EXACTLY once, byte-identically."""
+    store = os.path.join(root, "cluster_zombie_store")
+    ev_a = os.path.join(root, "cluster_zombie_a.jsonl")
+    ev_b = os.path.join(root, "cluster_zombie_b.jsonl")
+    ttl = 4  # effective 6 s (2x wedge floor)
+    body = _body(912, n=48, d=3, iters=24)
+    # --no-watchdog on BOTH: the zombie's 25 s silent block must play
+    # out as a lease story, not be preempted by a wedge verdict.
+    svc_a = ServiceProc(
+        store,
+        extra_args=_worker_args("wz", ttl=ttl, extra=["--no-watchdog"]),
+        env_faults="lease_renewal=0:pause:40,block_start=2:slow:25",
+        events_path=ev_a,
+    )
+    svc_b = None
+    try:
+        svc_b = ServiceProc(
+            store,
+            extra_args=_worker_args("wt", ttl=ttl,
+                                    extra=["--no-watchdog"]),
+            events_path=ev_b,
+        )
+        _, rec, _ = svc_a.post("/jobs", body)
+        job_id = rec["job_id"]
+        # B completes the takeover while A is still asleep in its slow
+        # block with renewal paused.
+        record = svc_b.poll_job(job_id, budget=300)
+        if record["status"] != "done":
+            raise Violation(
+                f"zombie-phase job ended {record['status']}: "
+                f"{record.get('error')}"
+            )
+        if record["result"]["result_fingerprint"] != refs["cluster_zombie"]:
+            raise Violation(
+                "post-takeover fingerprint differs from the oracle"
+            )
+        if not _job_events(ev_b, job_id, "lease_takeover"):
+            raise Violation("no lease_takeover on the taker")
+        # The zombie wakes, finishes its stale attempt, and is fenced.
+        deadline = time.time() + 120
+        refused = 0
+        while time.time() < deadline:
+            metrics_a = svc_a.try_get("/metrics")
+            if metrics_a is not None:
+                refused = metrics_a["lease_refused_writes_total"]
+                if refused >= 1:
+                    break
+            time.sleep(0.25)
+        if refused < 1:
+            raise Violation(
+                "zombie's late terminal write was never refused "
+                "(lease_refused_writes_total == 0)"
+            )
+        if not _job_events(ev_a, job_id, "lease_refused"):
+            raise Violation("no lease_refused event on the zombie")
+        # Done exactly once, by the taker, and the record still says so
+        # AFTER the zombie's attempt finished (nothing clobbered it).
+        dones = (_job_events(ev_a, job_id, "job_done")
+                 + _job_events(ev_b, job_id, "job_done"))
+        if len(dones) != 1 or dones[0].get("worker_id") != "wt":
+            raise Violation(
+                f"expected exactly one job_done from wt, got {dones}"
+            )
+        final = svc_b.get(f"/jobs/{job_id}")
+        if final["status"] != "done":
+            raise Violation(
+                f"record clobbered after the zombie woke: "
+                f"{final['status']}"
+            )
+        report["cluster_zombie"] = {
+            "lease_refused_writes_total": refused,
+            "taker_takeovers": svc_b.get("/metrics")[
+                "lease_takeovers_total"
+            ],
+            "done_exactly_once": True,
+            "fingerprint_parity": True,
+        }
+    finally:
+        svc_a.stop()
+        if svc_b is not None:
+            svc_b.stop()
+
+
+# ---------------------------------------------------------------------------
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
-        "--schedule", choices=["smoke", "corrupt", "full"], default="smoke"
+        "--schedule",
+        choices=["smoke", "corrupt", "cluster", "full"],
+        default="smoke",
     )
     p.add_argument("--out", default=None, help="write the JSON report here")
     p.add_argument("--root", default=None,
@@ -762,6 +1064,11 @@ def main(argv=None):
             "corrupt_acc": _body(707, n=48, d=3, iters=24),
             "corrupt_ckpt": _body(708, n=160, d=5, iters=160),
         })
+    if args.schedule in ("cluster", "full"):
+        ref_bodies.update({
+            "cluster_kill": _body(911, n=160, d=5, iters=160),
+            "cluster_zombie": _body(912, n=48, d=3, iters=24),
+        })
     if args.schedule == "full":
         ref_bodies["oom"] = _body(404, n=48, d=3, iters=24)
     refs = _reference_fingerprints(ref_bodies)
@@ -779,6 +1086,14 @@ def main(argv=None):
              lambda: phase_corrupt_accumulator(root, report, refs)),
             ("corrupt_checkpoint",
              lambda: phase_corrupt_checkpoint(root, report, refs)),
+        ]
+    if args.schedule in ("cluster", "full"):
+        phases += [
+            ("cluster_flood", lambda: phase_cluster_flood(root, report)),
+            ("cluster_takeover",
+             lambda: phase_cluster_takeover(root, report, refs)),
+            ("cluster_zombie",
+             lambda: phase_cluster_zombie(root, report, refs)),
         ]
     if args.schedule == "full":
         phases += [

@@ -269,6 +269,10 @@ def cmd_serve(args):
     from consensus_clustering_tpu.obs.memory import MemoryAccountant
     from consensus_clustering_tpu.obs.slo import SLOMonitor
 
+    if args.lease_ttl <= 0:
+        raise SystemExit(
+            f"serve: --lease-ttl must be > 0, got {args.lease_ttl}"
+        )
     try:
         lo_s, _, hi_s = args.drift_band.partition(":")
         drift = DriftWatchdog(
@@ -374,6 +378,9 @@ def cmd_serve(args):
         ),
         memory_budget_bytes=memory_budget,
         slo_monitor=slo_monitor,
+        worker_id=args.worker_id,
+        leases=not args.no_leases,
+        lease_ttl=args.lease_ttl,
     )
     if args.port_file:
         # The orchestration handshake for --port 0 (ephemeral): whoever
@@ -693,6 +700,22 @@ def main(argv=None):
                          "admissions shed")
     serve_p.add_argument("--shed-retry-after", type=float, default=15.0,
                          help="Retry-After seconds on shed responses")
+    serve_p.add_argument("--worker-id", default=None,
+                         help="restart-stable identity of this worker "
+                         "over a SHARED jobstore (docs/SERVING.md "
+                         "'Multi-worker runbook'); default: the "
+                         "hostname — co-hosted workers must set their "
+                         "own")
+    serve_p.add_argument("--lease-ttl", type=float, default=60.0,
+                         help="job-lease expiry in seconds; a worker "
+                         "silent past this is presumed dead and its "
+                         "jobs are taken over by a peer (floored at "
+                         "2x --wedge-floor so a slow block never reads "
+                         "as death)")
+    serve_p.add_argument("--no-leases", action="store_true",
+                         help="disable fenced job leases (single-worker "
+                         "stores only: two lease-less workers on one "
+                         "store WILL double-run jobs)")
     serve_p.set_defaults(fn=cmd_serve)
 
     admin_p = sub.add_parser(

@@ -25,6 +25,11 @@ Rendering rules (``cctpu_`` prefix throughout):
   byte gauges, ``preflight_accuracy``/``_correction`` and the accuracy
   band (docs/OBSERVABILITY.md "Memory accounting");
 - ``backend`` (a string) → ``cctpu_backend_info{backend="…"} 1``;
+- ``worker_id`` (a string) → ``cctpu_worker_info{worker_id="…"} 1``,
+  and ``active_leases`` carries the same ``worker_id`` label — the
+  per-worker lease gauge of docs/SERVING.md "Multi-worker runbook"
+  (each process exports its own exposition; the label is what lets one
+  scrape job aggregate a worker fleet over a shared store);
 - ``None`` values (an unset ``memory_budget_bytes``) are OMITTED — the
   text format has no null, and a fake 0 would read as "budget: zero
   bytes".  Documented in docs/OBSERVABILITY.md.
@@ -346,6 +351,29 @@ def render_prometheus(metrics: Dict[str, Any]) -> str:
             )
             lines.append(
                 _sample(f"{name}_info", {"backend": value}, 1)
+            )
+            continue
+        if key == "worker_id":
+            _family(
+                lines, f"{PREFIX}_worker_info", "gauge",
+                "this process's restart-stable worker identity over "
+                "the shared jobstore",
+            )
+            lines.append(
+                _sample(f"{PREFIX}_worker_info", {"worker_id": value}, 1)
+            )
+            continue
+        if key == "active_leases":
+            _family(
+                lines, name, "gauge",
+                "job leases this worker currently holds",
+            )
+            lines.append(
+                _sample(
+                    name,
+                    {"worker_id": metrics.get("worker_id") or "worker"},
+                    value,
+                )
             )
             continue
         if isinstance(value, Mapping):

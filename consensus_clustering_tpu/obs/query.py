@@ -229,7 +229,21 @@ def summarize(
     slo: Dict[str, Dict[str, int]] = {}
     integrity = 0
     preflight_inaccurate: Dict[str, int] = {}
+    # Per-worker attribution (docs/SERVING.md "Multi-worker runbook"):
+    # job_* events carry worker_id, so a merged log from a shared-store
+    # fleet still tells which worker ran — or was refused — what.
+    per_worker: Dict[str, Dict[str, int]] = {}
     ts_lo = ts_hi = None
+
+    def worker_row(event: Dict[str, Any]) -> Optional[Dict[str, int]]:
+        worker = event.get("worker_id")
+        if worker is None:
+            return None  # pre-lease logs: no fleet, no rows
+        return per_worker.setdefault(
+            str(worker),
+            {"done": 0, "failed": 0, "retried": 0, "requeued": 0,
+             "takeovers": 0, "refused_writes": 0},
+        )
     for e in events:
         ts = e.get("ts")
         if isinstance(ts, (int, float)):
@@ -256,6 +270,9 @@ def summarize(
                 job_seconds.setdefault(bucket, []).append(
                     float(e["seconds"])
                 )
+            row = worker_row(e)
+            if row is not None:
+                row["done"] += 1
         elif name == "job_failed":
             # Failed jobs join their queue waits through the bucket
             # too (carried since the job reached worker pickup): an
@@ -263,9 +280,27 @@ def summarize(
             # per bucket, not vanish from the report.
             if e.get("job_id") and e.get("bucket"):
                 bucket_of[e["job_id"]] = e["bucket"]
+            row = worker_row(e)
+            if row is not None:
+                row["failed"] += 1
         elif name == "job_retry":
             reason = e.get("reason", "unknown")
             retries[reason] = retries.get(reason, 0) + 1
+            row = worker_row(e)
+            if row is not None:
+                row["retried"] += 1
+        elif name == "job_requeued":
+            row = worker_row(e)
+            if row is not None:
+                row["requeued"] += 1
+        elif name == "lease_takeover":
+            row = worker_row(e)
+            if row is not None:
+                row["takeovers"] += 1
+        elif name == "lease_refused":
+            row = worker_row(e)
+            if row is not None:
+                row["refused_writes"] += 1
         elif name == "job_wedged":
             wedges += 1
         elif name == "perf_drift":
@@ -316,6 +351,7 @@ def summarize(
         "last_ts": ts_hi,
         "jobs": statuses,
         "per_bucket": per_bucket,
+        "per_worker": {k: per_worker[k] for k in sorted(per_worker)},
         "retries": retries,
         "wedges": wedges,
         "perf_drift": drift,
@@ -358,6 +394,17 @@ def render_report(report: Dict[str, Any]) -> str:
             f" p99={fmt(js['p99'])} max={fmt(js['max'])}"
             f"  queue p95={fmt(qs['p95'])}"
         )
+    per_worker = report.get("per_worker") or {}
+    if per_worker:
+        lines.append("")
+        lines.append("per-worker (docs/SERVING.md multi-worker runbook):")
+        for worker, row in per_worker.items():
+            lines.append(
+                f"  {worker}  done={row['done']} failed={row['failed']}"
+                f" retried={row['retried']} requeued={row['requeued']}"
+                f" takeovers={row['takeovers']}"
+                f" refused_writes={row['refused_writes']}"
+            )
     lines.append("")
     lines.append(
         "retries: " + (

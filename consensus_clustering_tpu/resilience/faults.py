@@ -31,6 +31,15 @@ test instead of trusted:
                                a throughput regression, not a failure —
                                what the perf-drift watchdog exists to
                                catch (default 1 s when unspecified)
+      lease_renewal=0:pause:30 sleep 30 s at the lease-renewal point
+                               and CONTINUE (default 30 s): the worker
+                               stops renewing its job leases while its
+                               attempts keep running — the deterministic
+                               ZOMBIE of the multi-worker story (a peer
+                               takes the expired leases over, and this
+                               worker's late writes are then fenced);
+                               unlike slow it stalls liveness telemetry,
+                               never the work itself
       checkpoint_mid_write=1   raise with a torn temp file half-written
       checkpoint_post_write=0:kill   die after the atomic rename
       accumulator=2:bitflip    flip 1 bit in the block-2 device
@@ -73,7 +82,7 @@ from typing import Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _ENV = "CCTPU_FAULTS"
-_ACTIONS = ("raise", "kill", "hang", "oom", "bitflip", "slow")
+_ACTIONS = ("raise", "kill", "hang", "oom", "bitflip", "slow", "pause")
 _KILL_EXIT_CODE = 137  # what a SIGKILL'd process reports (128 + 9)
 # A 'hang' with no duration: long enough that nothing short of the hang
 # watchdog (or the end of the test process) notices the thread again —
@@ -142,6 +151,13 @@ class IntegrityError(RuntimeError):
 #: a CI job hostage.
 _DEFAULT_SLOW_SECONDS = 1.0
 
+#: A 'pause' with no duration: comfortably past any sane lease ttl (the
+#: point exists to let a lease EXPIRE under a live worker — the default
+#: serve ttl is 60 s, so anything shorter than ~2× that produces no
+#: observable zombie at all) while still bounded enough that an
+#: unwatched test run terminates.
+_DEFAULT_PAUSE_SECONDS = 150.0
+
 
 @dataclasses.dataclass
 class _Rule:
@@ -161,17 +177,18 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
         try:
             point, rest = entry.split("=", 1)
             index_s, _, action = rest.partition(":")
-            # hang/slow take an optional duration ("hang" or "hang:30"),
-            # bitflip an optional bit count ("bitflip" or "bitflip:3").
+            # hang/slow/pause take an optional duration ("hang" or
+            # "hang:30"), bitflip an optional bit count ("bitflip" or
+            # "bitflip:3").
             action = action or "raise"
             base, _, arg = action.partition(":")
-            seconds = (
-                _DEFAULT_SLOW_SECONDS if base == "slow"
-                else _DEFAULT_HANG_SECONDS
-            )
+            seconds = {
+                "slow": _DEFAULT_SLOW_SECONDS,
+                "pause": _DEFAULT_PAUSE_SECONDS,
+            }.get(base, _DEFAULT_HANG_SECONDS)
             nbits = 1
             if arg:
-                if base in ("hang", "slow"):
+                if base in ("hang", "slow", "pause"):
                     seconds = float(arg)
                     if seconds < 0:
                         raise ValueError(arg)
@@ -189,6 +206,7 @@ def _parse_plan(spec: Optional[str]) -> List[_Rule]:
                 f"bad fault spec entry {entry!r}: expected "
                 "point=index[:action] with action raise | kill | "
                 "hang[:seconds] | oom | bitflip[:nbits] | slow[:seconds]"
+                " | pause[:seconds]"
             )
         if rule.action not in _ACTIONS:
             raise ValueError(
@@ -263,13 +281,20 @@ class FaultInjector:
                 f"injected hang at {point}[{index}] "
                 f"(slept {rule.seconds:.1f}s)"
             )
-        if rule.action == "slow":
-            # A pure throughput regression: the work completes, only
-            # slower — the drift-watchdog driver.  Unlike hang, nothing
-            # is raised: the run must SUCCEED with degraded timing, or
-            # the perf_drift signal would be confounded with a retry.
+        if rule.action in ("slow", "pause"):
+            # Sleep-and-continue, two spellings.  ``slow`` is a pure
+            # throughput regression: the work completes, only slower —
+            # the drift-watchdog driver.  ``pause`` is a LIVENESS
+            # stall: the point it is armed at (the lease-renewal
+            # round) goes silent while the worker's attempts keep
+            # executing — the deterministic zombie of docs/SERVING.md
+            # "Multi-worker runbook".  Either way nothing is raised:
+            # the run must SUCCEED, or the perf-drift / fence-refusal
+            # signal would be confounded with a retry.  The semantic
+            # difference lives entirely in where each action is armed.
             logger.warning(
-                "fault injection: slowing %.1fs at %s[%d]",
+                "fault injection: %s %.1fs at %s[%d]",
+                "slowing" if rule.action == "slow" else "pausing",
                 rule.seconds, point, index,
             )
             time.sleep(rule.seconds)

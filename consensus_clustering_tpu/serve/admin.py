@@ -20,6 +20,13 @@ the service.
         bundle JOB_ID --events EVENTS.jsonl [--out X.tar.gz] \
         [--metrics-url http://HOST:PORT/metrics]
 
+``list``/``show`` also render each job's LEASE — owner worker, fencing
+token, expiry, and a computed state (``live`` | ``expired`` |
+``released`` | ``torn``) — straight from the store's
+``leases/<job_id>/token-*.json`` files (docs/SERVING.md "Multi-worker
+runbook"): who owns a job is exactly the question an operator asks
+while one worker of a shared-store fleet is wedged.
+
 ``trace``/``report``/``bundle`` are the forensic query engine
 (:mod:`consensus_clustering_tpu.obs.query`, docs/OBSERVABILITY.md
 "Query engine") over the service's JSONL event log: ``trace`` renders
@@ -72,6 +79,15 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+# Stdlib-only by design (the module docstring's contract): serve.leases
+# imports nothing beyond the stdlib, and the serve package __init__ is
+# lazy — the importtime pin in tests/test_hostile.py holds this line to
+# that claim.
+from consensus_clustering_tpu.serve.leases import (
+    lease_state_name,
+    read_lease,
+)
+
 
 def _atomic_write_json(path: str, payload: Dict[str, Any]) -> None:
     # Same unique-temp + rename rule as the jobstore: two writers must
@@ -123,6 +139,34 @@ def _load_payload_envelope(
     if isinstance(raw, dict) and "spec" in raw and "restart_attempts" in raw:
         return raw["spec"], int(raw["restart_attempts"])
     return raw, 0
+
+
+def lease_state(store_dir: str, job_id: str) -> Optional[Dict[str, Any]]:
+    """The newest lease for a job, from the store's JSON alone, with a
+    computed human ``state``: ``live`` | ``expired`` | ``released`` |
+    ``torn``.  ``None`` when the job has never been leased (pre-lease
+    stores, or ``--no-leases`` deployments).  Stdlib-only like the rest
+    of this tool — who owns a job is exactly the question an operator
+    asks while a worker is wedged (docs/SERVING.md "Multi-worker
+    runbook")."""
+    lease = read_lease(os.path.join(store_dir, "leases"), job_id)
+    if lease is None:
+        return None
+    lease = dict(lease)
+    # The scheduler's own classifier: what this renders can never
+    # disagree with the takeover decision the fleet actually makes.
+    lease["state"] = lease_state_name(lease, time.time())
+    return lease
+
+
+def _lease_column(store_dir: str, job_id: str) -> str:
+    lease = lease_state(store_dir, job_id)
+    if lease is None:
+        return "lease=-"
+    return (
+        f"lease={lease.get('worker_id') or '?'}"
+        f"@{lease.get('token')}({lease['state']})"
+    )
 
 
 def quarantined_jobs(store_dir: str) -> List[Dict[str, Any]]:
@@ -208,9 +252,13 @@ def add_arguments(parser) -> None:
     )
     sub = parser.add_subparsers(dest="admin_cmd", required=True)
     sub.add_parser(
-        "list", help="list quarantined jobs (id, restarts, when, error)"
+        "list", help="list quarantined jobs (id, restarts, when, error, "
+        "lease owner/state)"
     )
-    show = sub.add_parser("show", help="print one job's full record")
+    show = sub.add_parser(
+        "show", help="print one job's full record plus its lease "
+        "(owner, fencing token, expiry) when one exists"
+    )
     show.add_argument("job_id")
     release = sub.add_parser(
         "release",
@@ -291,7 +339,8 @@ def cmd_serve_admin(args) -> int:
                 f"{record['job_id']}  "
                 f"restarts={record.get('restart_requeues', '?')}  "
                 f"quarantined_at={record.get('quarantined_at', '?')}  "
-                f"fingerprint={record.get('fingerprint', '?')}"
+                f"fingerprint={record.get('fingerprint', '?')}  "
+                + _lease_column(args.store_dir, record["job_id"])
             )
         return 0
     if args.admin_cmd == "show":
@@ -299,7 +348,14 @@ def cmd_serve_admin(args) -> int:
         if record is None:
             print(f"unknown job {args.job_id}", file=sys.stderr)
             return 1
-        print(json.dumps(record, indent=1, sort_keys=True, default=float))
+        # The record plus its lease (rendered, never written back: the
+        # "lease" key exists only in this view — the record file stays
+        # exactly what the scheduler wrote).
+        out = dict(record)
+        lease = lease_state(args.store_dir, args.job_id)
+        if lease is not None:
+            out["lease"] = lease
+        print(json.dumps(out, indent=1, sort_keys=True, default=float))
         return 0
     if args.admin_cmd == "release":
         try:

@@ -8,11 +8,15 @@ arrive on JAX debug-callback threads).  The schema mirrors
 ``{"ts": <unix>, "event": <name>, ...fields}`` — so one JSONL consumer
 can tail both a batch run's metrics file and the service's event log.
 
-Events emitted by the service:
+Events emitted by the service (every ``job_*`` event carries the
+emitting scheduler's ``worker_id`` — docs/SERVING.md "Multi-worker
+runbook": a merged log from several workers over one shared store must
+still attribute every attempt):
 
 - ``job_submitted``   — admission accepted (fields: job_id, fingerprint,
-  shape, cached)
-- ``job_started``     — worker picked the job up (job_id, attempt)
+  shape, cached, worker_id)
+- ``job_started``     — worker picked the job up (job_id, attempt,
+  worker_id)
 - ``h_block_complete``— a streamed H-block's curves landed (job_id,
   block, h_done, pac_area): the per-block progress of the streaming
   sweep engine, the signs-of-life signal for a long job
@@ -20,29 +24,45 @@ Events emitted by the service:
   emitted host-side by the executor once per K (the streaming driver
   owns the final curves, so no staged debug callback is involved)
 - ``job_done``        — result stored (job_id, fingerprint, seconds,
-  bucket — the calibration shape-bucket string, so the offline query
-  engine can group latency per bucket; ``cached=True`` instead of
-  seconds when served by late dedup)
+  worker_id, bucket — the calibration shape-bucket string, so the
+  offline query engine can group latency per bucket; ``cached=True``
+  instead of seconds when served by late dedup)
 - ``job_retry``       — transient failure, will re-run (job_id, attempt,
-  backoff_seconds, error)
+  backoff_seconds, error, worker_id)
 - ``job_failed``      — permanent failure / retries exhausted / timeout
-  (job_id, error, kind; plus bucket when the job reached worker pickup
-  — the forensic report joins failed jobs' queue waits through it, so
-  a backlog of failing jobs still shows up per bucket)
+  (job_id, error, kind, worker_id; plus bucket when the job reached
+  worker pickup — the forensic report joins failed jobs' queue waits
+  through it, so a backlog of failing jobs still shows up per bucket)
 
 Hostile-path events (docs/SERVING.md "Overload & wedge runbook"):
 
 - ``job_wedged``      — the hang watchdog abandoned a silent attempt
   (job_id, attempt, point, silent_seconds, deadline_seconds); followed
   by ``job_retry`` with reason ``wedged:<point>`` or ``job_failed``
-- ``job_requeued``    — restart reconciliation re-queued an orphan
-  (job_id, fingerprint, restart_requeues)
+- ``job_requeued``    — reconciliation/takeover re-queued an orphan
+  (job_id, fingerprint, restart_requeues, worker_id)
 - ``job_quarantined`` — a crash-looping orphan crossed the requeue cap
-  (job_id, fingerprint, restarts); payload + ring retained
+  (job_id, fingerprint, restarts, worker_id); payload + ring retained
 - ``job_preflight_reject`` — admission refused on the memory estimate
-  (fingerprint, shape, estimated_bytes, budget_bytes); HTTP 413
+  (fingerprint, shape, estimated_bytes, budget_bytes, worker_id);
+  HTTP 413
 - ``job_shed``        — admission refused by the overload shed policy
-  (fingerprint, priority, reason, queue_depth); HTTP 429 + Retry-After
+  (fingerprint, priority, reason, queue_depth, worker_id); HTTP 429 +
+  Retry-After
+
+Multi-worker lease events (docs/SERVING.md "Multi-worker runbook"):
+
+- ``lease_takeover``  — this worker claimed an orphan's lease and will
+  re-queue the job (job_id, fingerprint, worker_id — the TAKER,
+  prior_worker — whose lease was superseded (None when never leased),
+  token — the new fencing token, reason: absent | expired | released |
+  torn | self_restart); the job then resumes from its checkpoint ring
+  bit-identically, and the previous owner's late writes are fenced
+- ``lease_refused``   — a state-mutating write was REFUSED by the lease
+  fence: a newer token supersedes this worker's, i.e. the job was taken
+  over and we are the zombie (job_id, op — which write, worker_id — the
+  ZOMBIE, token — the token we held, newer_token); the successor's
+  record stands, local state is dropped
 
 Data-integrity events (docs/SERVING.md "Integrity runbook"):
 

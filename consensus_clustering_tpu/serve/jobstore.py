@@ -18,6 +18,9 @@ result that a later hit would serve)::
                                        of failing it (crash-resume)
     <dir>/checkpoints/<fingerprint>/   per-job streamed block-checkpoint
                                        ring (resilience.StreamCheckpointer)
+    <dir>/leases/<job_id>/token-*.json fenced ownership (serve.leases):
+                                       which worker may run — and WRITE —
+                                       this job, at which fencing token
 
 Results are stored as CANONICAL JSON bytes (``sort_keys=True``) and served
 back verbatim: two submissions that dedup to the same fingerprint receive
@@ -62,6 +65,9 @@ class JobStore:
         self.jobs_dir = os.path.join(directory, "jobs")
         self.payloads_dir = os.path.join(directory, "payloads")
         self.checkpoints_dir = os.path.join(directory, "checkpoints")
+        # Per-job fenced ownership leases (serve/leases.py) — which
+        # worker may run and WRITE each job, at which fencing token.
+        self.leases_dir = os.path.join(directory, "leases")
         # Operator control surface (serve-admin writes here with the
         # same atomic-rename discipline; the scheduler polls/claims):
         # today one file, profile_next.json.
@@ -70,10 +76,12 @@ class JobStore:
         os.makedirs(self.jobs_dir, exist_ok=True)
         os.makedirs(self.payloads_dir, exist_ok=True)
         os.makedirs(self.checkpoints_dir, exist_ok=True)
+        os.makedirs(self.leases_dir, exist_ok=True)
         os.makedirs(self.control_dir, exist_ok=True)
         self._sweep_stale_tmps()
         self._sweep_stale_checkpoints()
         self._sweep_orphan_payloads()
+        self.gc_stale_leases()
 
     # Temp files younger than this are treated as another process's
     # live writes (two services can share a store dir); older ones are
@@ -138,13 +146,62 @@ class JobStore:
             ):
                 self.delete_payload(job_id)
 
+    def gc_stale_leases(self) -> None:
+        """GC lease directories whose fencing history is dead weight.
+
+        A lease tombstone must OUTLIVE its job long enough to refuse a
+        zombie's late write (serve/leases.py), so live and recently
+        terminal jobs' lease dirs are spared; what this sweeps is the
+        long tail — jobs whose record is terminal (or gone) and whose
+        newest token file is older than the grace window, where no
+        writer that could be fenced can still exist.  Runs at store
+        construction AND periodically from the scheduler's lease
+        maintenance thread: a long-lived service otherwise accumulates
+        one tombstone dir per terminal job forever, and the periodic
+        takeover sweep re-reads every one of them each round."""
+        now = time.time()
+        for job_id in os.listdir(self.leases_dir):
+            job_dir = os.path.join(self.leases_dir, job_id)
+            try:
+                newest = max(
+                    (
+                        os.path.getmtime(os.path.join(job_dir, f))
+                        for f in os.listdir(job_dir)
+                    ),
+                    default=os.path.getmtime(job_dir),
+                )
+            except OSError:
+                continue
+            if now - newest <= self._TMP_GRACE_SECONDS:
+                continue
+            record = self.load_job(job_id)
+            if record is None or record.get("status") not in (
+                "queued", "running",
+            ):
+                try:
+                    shutil.rmtree(job_dir)
+                except OSError:
+                    pass
+
     def _sweep_stale_tmps(self) -> None:
         now = time.time()
+        lease_dirs = [
+            os.path.join(self.leases_dir, name)
+            for name in os.listdir(self.leases_dir)
+            if os.path.isdir(os.path.join(self.leases_dir, name))
+        ]
         for directory in (
             self.results_dir, self.jobs_dir, self.payloads_dir,
-            self.control_dir,
+            self.control_dir, *lease_dirs,
         ):
-            for name in os.listdir(directory):
+            try:
+                names = os.listdir(directory)
+            except OSError:
+                # A peer on the shared store removed this lease dir
+                # between the listing above and here (admission
+                # rollback, or another booting store's stale-lease GC).
+                continue
+            for name in names:
                 # Canonical names are <hex>.json / <hex>.npy; every
                 # temp spelling here embeds ".tmp".
                 if ".tmp" not in name:

@@ -29,7 +29,23 @@ Each job runs with:
   in the jobstore for the job's whole non-terminal life, so the startup
   reconciliation of a RESTARTED process re-queues orphaned jobs (they
   then resume from their checkpoint ring) instead of failing them; only
-  orphans whose payload is missing (pre-durability stores) are failed.
+  orphans whose payload is missing (pre-durability stores) are failed;
+- **fenced leases** (docs/SERVING.md "Multi-worker runbook"): with
+  ``leases=True`` (the default) every job is owned by exactly one
+  worker via :mod:`~consensus_clustering_tpu.serve.leases` — claimed at
+  admission, renewed from the per-block heartbeat path and a
+  wall-clock maintenance thread, released (tombstoned) on the terminal
+  transition.  Reconciliation becomes *takeover*: an orphan is claimed
+  only when its lease is absent/expired/released/torn (a live peer's
+  lease is left alone and is NOT counted as a restart — the solo
+  fast-restart race that used to push healthy jobs toward quarantine
+  is closed by the same rule), the taker bumps the fencing token and
+  resumes from the checkpoint ring, and a periodic sweep makes
+  dead-worker takeover happen while the survivor is RUNNING, not just
+  at its next boot.  Every state-mutating jobstore write is fenced
+  against the token, so a zombie worker's late write is refused
+  (``lease_refused`` event) instead of clobbering the successor's
+  result.
 
 Hostile-path hardening (docs/SERVING.md "Overload & wedge runbook"):
 
@@ -65,7 +81,9 @@ every transition, so ``GET /jobs/<id>`` survives a restart.
 from __future__ import annotations
 
 import logging
+import os
 import queue
+import socket
 import threading
 import time
 import uuid
@@ -92,6 +110,11 @@ from consensus_clustering_tpu.serve.executor import (
     SweepExecutor,
 )
 from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.leases import (
+    LeaseLost,
+    LeaseManager,
+    lease_state_name,
+)
 from consensus_clustering_tpu.serve.preflight import (
     PreflightReject,
     check_admission,
@@ -228,6 +251,12 @@ class JobTimeout(Exception):
 class Scheduler:
     """FIFO queue + worker loop in front of a :class:`SweepExecutor`."""
 
+    #: How often the lease maintenance thread runs the store's
+    #: tombstone GC (the grace window that spares fence-able leases is
+    #: the store's own; this just bounds how long a long-lived service
+    #: lets terminal jobs' lease dirs accumulate between boots).
+    _LEASE_GC_EVERY_SECONDS = 600.0
+
     def __init__(
         self,
         executor: SweepExecutor,
@@ -248,11 +277,17 @@ class Scheduler:
         shed_policy: Optional[ShedPolicy] = None,
         memory_budget_bytes: Optional[int] = None,
         slo: Optional[SLOMonitor] = None,
+        worker_id: Optional[str] = None,
+        leases: bool = True,
+        lease_ttl: float = 60.0,
+        lease_sweep: Optional[float] = None,
     ):
         if quarantine_after < 1:
             raise ValueError(
                 f"quarantine_after must be >= 1, got {quarantine_after}"
             )
+        if lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
         self.executor = executor
         self.store = store
         self.events = events or EventLog(None)
@@ -276,6 +311,35 @@ class Scheduler:
         self.wedge_poll = wedge_poll
         self.shed_policy = shed_policy
         self.memory_budget_bytes = memory_budget_bytes
+        # Fenced-lease layer (docs/SERVING.md "Multi-worker runbook").
+        # The worker_id must be RESTART-STABLE and unique per worker
+        # over a shared store: stability is what lets a restarted
+        # worker reclaim its dead former self's leases instantly
+        # instead of waiting out the ttl; uniqueness is what makes a
+        # peer's lease mean "leave this job alone".  The default
+        # (hostname) suits one worker per host — co-hosted workers
+        # must set --worker-id themselves.  The effective ttl never
+        # sits below twice the wedge floor: expiry inherits the wedge
+        # model's "no healthy silence is shorter than this" bound, and
+        # renewal is wall-clock (maintenance thread + heartbeat path),
+        # so a slow block or long compile can never read as death.
+        self.worker_id = str(worker_id) if worker_id else (
+            socket.gethostname() or "worker"
+        )
+        ttl = max(float(lease_ttl), 2.0 * float(wedge_floor))
+        self.leases: Optional[LeaseManager] = (
+            LeaseManager(store.leases_dir, self.worker_id, ttl=ttl)
+            if leases else None
+        )
+        if lease_sweep is not None and float(lease_sweep) <= 0:
+            raise ValueError(
+                f"lease_sweep must be > 0, got {lease_sweep}"
+            )
+        self.lease_sweep = (
+            float(lease_sweep) if lease_sweep
+            else max(0.5, ttl / 4.0)
+        )
+        self._lease_thread: Optional[threading.Thread] = None
         self._sleep = sleep  # injectable so retry tests need not wait
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._jobs: Dict[str, Dict[str, Any]] = {}
@@ -300,6 +364,15 @@ class Scheduler:
         self.jobs_quarantined = 0
         self.preflight_rejects_total = 0
         self.jobs_shed_total: Dict[str, int] = {p: 0 for p in PRIORITIES}
+        # Lease-layer counters (docs/SERVING.md "Multi-worker runbook"),
+        # pre-seeded like everything /metrics dict-copies: orphan leases
+        # this worker claimed (absent/expired/released/torn/
+        # self_restart), writes the fence refused (we were the zombie),
+        # and leases of OURS that expired and were superseded by a peer
+        # (discovered at renewal — the other half of the zombie story).
+        self.lease_takeovers_total = 0
+        self.lease_refused_writes_total = 0
+        self.lease_expired_total = 0
         # Silent-corruption defense counters (docs/SERVING.md
         # "Integrity runbook"): sentinel evaluations across executed
         # jobs, and breaches by detection point — pre-seeded with every
@@ -392,10 +465,157 @@ class Scheduler:
             target=self._worker_loop, name="serve-worker", daemon=True
         )
         self._worker.start()
+        if self.leases is not None:
+            # Lease maintenance: renew everything we own (wall-clock,
+            # so compile phases / idle queue slots stay alive) and
+            # sweep the store for dead peers' orphans — dead-worker
+            # takeover must happen while the survivor is RUNNING, not
+            # at its next boot.
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, name="serve-leases", daemon=True
+            )
+            self._lease_thread.start()
 
-    def _reconcile_orphans(self) -> None:
-        """Re-queue, quarantine, or fail over jobs a previous process
-        left non-terminal.
+    def _lease_loop(self) -> None:
+        last_gc = time.time()
+        while not self._stop.wait(self.lease_sweep):
+            try:
+                self._note_lost_leases(self.leases.renew_owned())
+            except Exception:  # noqa: BLE001 — renewal must not die
+                logger.exception("lease renewal round failed")
+            try:
+                self._reconcile_orphans(boot=False)
+            except Exception:  # noqa: BLE001 — the sweep must not die
+                logger.exception("lease takeover sweep failed")
+            # Periodic tombstone GC (grace-windowed inside the store):
+            # without it a long-lived service keeps one released lease
+            # dir per terminal job forever, and the takeover sweep
+            # above re-reads every one of them each round.
+            if time.time() - last_gc >= self._LEASE_GC_EVERY_SECONDS:
+                last_gc = time.time()
+                try:
+                    self.store.gc_stale_leases()
+                except Exception:  # noqa: BLE001 — GC must not die
+                    logger.exception("stale-lease GC failed")
+
+    def _lease_beat(self) -> None:
+        """The per-block heartbeat renewal path: every beat the
+        executor lands also keeps our leases fresh (rate-limited and
+        non-blocking inside the manager — it never stalls a block
+        loop).  Failures are swallowed: renewal is liveness telemetry,
+        and a hiccup here must not fail a healthy job."""
+        if self.leases is None:
+            return
+        try:
+            lost = self.leases.maybe_renew()
+        except Exception:  # noqa: BLE001 — see docstring
+            logger.exception("heartbeat lease renewal failed")
+            return
+        if lost:
+            self._note_lost_leases(lost)
+
+    def _note_lost_leases(self, lost: List[str]) -> None:
+        """Leases of OURS a peer superseded (we are a zombie for these
+        jobs): count them, drop the local state so ``get()`` falls back
+        to the successor's on-disk record, and leave any still-running
+        thread to be refused by the fence at its next write."""
+        for job_id in lost:
+            with self._lock:
+                self.lease_expired_total += 1
+                self._jobs.pop(job_id, None)
+                self._specs.pop(job_id, None)
+                self._data.pop(job_id, None)
+            logger.warning(
+                "lease for job %s expired and was taken over by a peer; "
+                "local state dropped (any in-flight attempt will be "
+                "fenced at its next write)", job_id,
+            )
+
+    def _fence(self, job_id: str, op: str) -> None:
+        """The write-side lease gate: every state-mutating jobstore
+        write for a job runs through here first.  A newer token means
+        the job was taken over — we are the zombie — so the write is
+        REFUSED: counted, logged as ``lease_refused``, local state
+        dropped (the successor's record is the record), and
+        :class:`LeaseLost` raised to unwind the caller."""
+        if self.leases is None:
+            return
+        if self.leases.check_fence(job_id):
+            return
+        mine, newest = self.leases.fence_info(job_id)
+        self.leases.forget(job_id)
+        with self._lock:
+            self.lease_refused_writes_total += 1
+            self._jobs.pop(job_id, None)
+            self._specs.pop(job_id, None)
+            self._data.pop(job_id, None)
+        self.events.emit(
+            "lease_refused", job_id=job_id, op=op,
+            worker_id=self.worker_id, token=mine, newer_token=newest,
+        )
+        logger.warning(
+            "fenced write refused for job %s (%s): held token %s, "
+            "newest %s — the job was taken over", job_id, op, mine,
+            newest,
+        )
+        raise LeaseLost(job_id, op, mine, newest)
+
+    def _dead_lease_candidates(self):
+        """Candidate ``(job_id, record)`` pairs for the PERIODIC
+        takeover sweep: jobs whose newest lease looks dead.
+
+        The boot pass walks every job record — it must also see
+        pre-lease ``absent`` orphans and ``serve-admin release``'d
+        work — but doing that every ``lease_sweep`` interval would
+        re-parse the store's whole (unbounded, result-embedding)
+        terminal history every few seconds forever.  A dead WORKER's
+        jobs are exactly the ones whose leases stop being renewed, so
+        the running sweep reads the tiny token files instead and
+        touches a job record only when its lease is actually expired
+        or torn: released tombstones are terminal jobs' normal end
+        state and are skipped at the cost of one tiny token-file read
+        (the lease loop's periodic tombstone GC bounds how many
+        accumulate — which also keeps ``serve-admin release``'s
+        documented takes-effect-at-next-start semantics), and
+        ``absent`` only exists in pre-lease stores, which the boot
+        pass owns."""
+        try:
+            names = sorted(os.listdir(self.store.leases_dir))
+        except OSError:
+            return
+        now = time.time()
+        for job_id in names:
+            cur = self.leases.current(job_id)
+            if cur is None or lease_state_name(cur, now) not in (
+                "expired", "torn",
+            ):
+                # Absent, released, or live (a healthy peer's, or our
+                # own, renewed): not a dead worker's leaving.
+                continue
+            record = self.store.load_job(job_id)
+            if record is not None:
+                yield job_id, record
+
+    def _fresh_or_stand_down(self, job_id):
+        """Post-claim freshness gate, shared by both taker paths: re-
+        read the record, and if a peer terminalised the job while we
+        were claiming, re-tombstone the token we just burned and
+        return None — proceeding on the stale queued/running snapshot
+        would overwrite a terminal record with a failure (the zombie
+        clobber, spelled by the taker).  Returns the fresh record when
+        the takeover is still real."""
+        fresh = self.store.load_job(job_id)
+        if fresh is None or fresh.get("status") not in (
+            "queued", "running",
+        ):
+            self.leases.release(
+                job_id, (fresh or {}).get("status") or "done"
+            )
+            return None
+        return fresh
+
+    def _reconcile_orphans(self, boot: bool = True) -> None:
+        """Re-queue, quarantine, or fail over jobs no live worker owns.
 
         The jobstore persists every job's (config, data) payload for its
         non-terminal life, so a ``queued``/``running`` orphan from a
@@ -421,12 +641,60 @@ class Scheduler:
         terminate either way.  Jobs this scheduler tracks in memory are
         skipped (a stop()/start() cycle within one process must not
         touch live work).
+
+        **Leases make "orphan" mean something over a SHARED store**
+        (docs/SERVING.md "Multi-worker runbook"): a non-terminal record
+        is only ours to touch after :meth:`LeaseManager.claim_orphan`
+        wins its fencing token — absent/expired/released/torn leases
+        (and, at ``boot=True``, a live-looking lease held by our own
+        restart-stable worker_id: the dead former self) are claimable;
+        a LIVE PEER's lease skips the job entirely, so a booting worker
+        neither double-queues a running peer's job nor counts it as a
+        restart toward quarantine (the solo fast-restart race closed by
+        the same rule).  With ``boot=False`` this is the periodic
+        takeover sweep the lease maintenance thread runs: a SIGKILLed
+        peer's jobs are claimed by a survivor within ~ttl + one sweep,
+        token bumped, resumed from the checkpoint ring.
         """
-        for job_id, record in self.store.iter_jobs():
+        if boot or self.leases is None:
+            candidates = self.store.iter_jobs()
+        else:
+            candidates = self._dead_lease_candidates()
+        for job_id, record in candidates:
             with self._lock:
                 if job_id in self._jobs:
                     continue
             if record.get("status") not in ("queued", "running"):
+                continue
+            lease_token = None
+            lease_reason = prior_worker = None
+            if self.leases is not None:
+                claimed = self.leases.claim_orphan(job_id, boot=boot)
+                if claimed is None:
+                    # A live peer's lease (or a lost claim race): not an
+                    # orphan — leave it alone, bump NOTHING.
+                    continue
+                lease_token, lease_reason, prior_worker = claimed
+                # Re-read AFTER winning the claim: a peer may have
+                # terminalised the job between our record read and the
+                # claim (its released tombstone is exactly what made
+                # the lease claimable).
+                record = self._fresh_or_stand_down(job_id)
+                if record is None:
+                    continue
+                with self._lock:
+                    self.lease_takeovers_total += 1
+                self.events.emit(
+                    "lease_takeover", job_id=job_id,
+                    fingerprint=record.get("fingerprint"),
+                    worker_id=self.worker_id,
+                    prior_worker=prior_worker,
+                    token=lease_token, reason=lease_reason,
+                )
+            elif not boot:
+                # The periodic sweep exists only for the lease world;
+                # without leases there is no safe way to distinguish a
+                # peer's live job from a dead one's.
                 continue
             requeued = False
             reason = "interrupted by service restart"
@@ -468,12 +736,15 @@ class Scheduler:
                         # Payload + ring deliberately NOT deleted: the
                         # exact poison (config, data, partial state) is
                         # the debugging artefact.
+                        if self.leases is not None:
+                            self.leases.release(job_id, "quarantined")
                         with self._lock:
                             self.jobs_quarantined += 1
                         self.events.emit(
                             "job_quarantined", job_id=job_id,
                             fingerprint=record.get("fingerprint"),
                             restarts=requeues - 1,
+                            worker_id=self.worker_id,
                         )
                         logger.error(
                             "quarantined crash-looping job %s after %d "
@@ -530,8 +801,20 @@ class Scheduler:
                             "job_requeued", job_id=job_id,
                             fingerprint=record.get("fingerprint"),
                             restart_requeues=record["restart_requeues"],
+                            worker_id=self.worker_id,
                         )
                         continue
+            if self.leases is not None:
+                # Last freshness check before failing over.  The one
+                # interleaving the post-claim re-read above cannot see:
+                # the previous owner passed its fence check BEFORE our
+                # claim, then its terminal save_job + delete_payload
+                # landed AFTER our re-read — the missing payload that
+                # sent us down this fail path IS its completion, and we
+                # hold the newest token so nothing fences THIS write.
+                record = self._fresh_or_stand_down(job_id)
+                if record is None:
+                    continue
             record.update(
                 status="failed",
                 error=reason,
@@ -539,8 +822,11 @@ class Scheduler:
             )
             self.store.save_job(record)
             self.store.delete_payload(job_id)
+            if self.leases is not None:
+                self.leases.release(job_id, "failed")
             self.events.emit(
                 "job_failed", job_id=job_id, error=reason, kind="restart",
+                worker_id=self.worker_id,
             )
 
     def stop(self, timeout: float = 5.0) -> None:
@@ -555,6 +841,9 @@ class Scheduler:
         if self._worker is not None:
             self._worker.join(timeout)
             self._worker = None
+        if self._lease_thread is not None:
+            self._lease_thread.join(timeout)
+            self._lease_thread = None
 
     # -- submission ------------------------------------------------------
 
@@ -596,6 +885,7 @@ class Scheduler:
             self.events.emit(
                 "job_submitted", job_id=job_id, fingerprint=fp,
                 shape=record["shape"], cached=True,
+                worker_id=self.worker_id,
             )
             return record
 
@@ -623,6 +913,28 @@ class Scheduler:
                 del self._data[job_id]
             self.store.delete_payload(job_id)  # any half-written part
             raise
+        # Claim the job's lease BEFORE the record is mirrored: from the
+        # moment a peer's takeover sweep can see the "queued" record,
+        # the live lease is what tells it a healthy worker owns this
+        # job (renewed by the maintenance thread even while the job
+        # waits behind a long one).  The other order would publish a
+        # disk-write-wide window where the record exists lease-less and
+        # a peer's sweep could legitimately claim it as an orphan.
+        if self.leases is not None:
+            token = self.leases.claim_new(job_id)
+            if token is None:
+                # Unreachable for a fresh uuid barring store tampering;
+                # admitting an unclaimable job would strand it (every
+                # fenced write would refuse), so reject loudly instead.
+                with self._lock:
+                    del self._jobs[job_id]
+                    del self._specs[job_id]
+                    del self._data[job_id]
+                self.store.delete_payload(job_id)
+                raise RuntimeError(
+                    f"could not claim a lease for new job {job_id} — "
+                    "another worker holds its token (store tampering?)"
+                )
         # Mirror to the jobstore BEFORE enqueueing: once the worker can see
         # the job it starts writing "running"/"done" transitions, and the
         # admission-time "queued" snapshot must never land after (and
@@ -640,12 +952,15 @@ class Scheduler:
                 del self._data[job_id]
             self.store.delete_job(job_id)
             self.store.delete_payload(job_id)
+            if self.leases is not None:
+                self.leases.drop(job_id)
             raise QueueFull(
                 f"queue full ({self._queue.maxsize} jobs); retry later"
             )
         self.events.emit(
             "job_submitted", job_id=job_id, fingerprint=fp,
             shape=record["shape"], cached=False,
+            worker_id=self.worker_id,
         )
         return snapshot
 
@@ -702,6 +1017,7 @@ class Scheduler:
                 shape=[n, d],
                 estimated_bytes=e.payload["estimated_bytes"],
                 budget_bytes=e.payload["budget_bytes"],
+                worker_id=self.worker_id,
             )
             raise
 
@@ -731,6 +1047,7 @@ class Scheduler:
         self.events.emit(
             "job_shed", fingerprint=fp, priority=spec.priority,
             reason=reason, queue_depth=self._queue.qsize(),
+            worker_id=self.worker_id,
         )
         raise QueueShed(
             spec.priority, reason, self.shed_policy.retry_after
@@ -792,6 +1109,20 @@ class Scheduler:
                 "jobs_shed_total": dict(self.jobs_shed_total),
                 "preflight_rejects_total": self.preflight_rejects_total,
                 "memory_budget_bytes": self.memory_budget_bytes,
+                # Fenced-lease layer (docs/SERVING.md "Multi-worker
+                # runbook"): who this worker is, how many leases it
+                # holds right now, orphans it claimed, writes the fence
+                # refused (we were the zombie), and leases of ours a
+                # peer superseded.  All pre-seeded / always-present.
+                "worker_id": self.worker_id,
+                "active_leases": (
+                    self.leases.owned_count()
+                    if self.leases is not None else 0
+                ),
+                "lease_takeovers_total": self.lease_takeovers_total,
+                "lease_refused_writes_total":
+                    self.lease_refused_writes_total,
+                "lease_expired_total": self.lease_expired_total,
                 # Silent-corruption defense (docs/SERVING.md "Integrity
                 # runbook"): sentinel evaluations and breaches by
                 # detection point (retried as corrupt:<point>).  All
@@ -841,8 +1172,17 @@ class Scheduler:
     # -- worker ----------------------------------------------------------
 
     def _update(self, job_id: str, **fields) -> Dict[str, Any]:
+        # The fence: a record write for a job whose lease a peer
+        # superseded must not land — the successor owns this job's
+        # story now.  Raises LeaseLost (handled by the worker loop)
+        # after emitting lease_refused.
+        self._fence(job_id, f"update:{fields.get('status') or 'fields'}")
         with self._lock:
-            record = self._jobs[job_id]
+            record = self._jobs.get(job_id)
+            if record is None:
+                # A takeover raced between the fence check and here:
+                # _note_lost_leases already dropped the local state.
+                raise LeaseLost(job_id, "update", None, None)
             record.update(fields)
             snapshot = dict(record)
         self.store.save_job(snapshot)
@@ -867,6 +1207,11 @@ class Scheduler:
                 "fingerprint"
             ):
                 self.store.clear_checkpoints(snapshot["fingerprint"])
+            # Terminal = release: the lease is tombstoned (token KEPT)
+            # so a zombie's write after this still finds a newer-or-
+            # released token and is refused — released, not deleted.
+            if self.leases is not None:
+                self.leases.release(job_id, snapshot["status"])
         return snapshot
 
     def _run_with_timeout(
@@ -962,6 +1307,33 @@ class Scheduler:
                 break
             try:
                 self._execute(job_id)
+            except LeaseLost as e:
+                # A fenced write was refused mid-execution: the job was
+                # taken over and the successor's record is the record.
+                # NOT a job failure — the fence already counted and
+                # emitted lease_refused, the local state is dropped,
+                # and writing "failed" here would be exactly the zombie
+                # clobber the fence exists to stop.
+                logger.warning(
+                    "worker stood down from job %s: %s", job_id, e
+                )
+                # Checkpoint-ring writes are NOT fenced (they are
+                # idempotent per-generation files, and fencing every
+                # block write would put a disk read on the hot path) —
+                # so blocks this zombie completed AFTER the successor's
+                # terminal clear_checkpoints have re-created gen-* files
+                # in a ring nobody will ever clear again.  If the
+                # record is already done, re-run the terminal clear.
+                try:
+                    rec = self.store.load_job(job_id)
+                    if (
+                        rec is not None
+                        and rec.get("status") == "done"
+                        and rec.get("fingerprint")
+                    ):
+                        self.store.clear_checkpoints(rec["fingerprint"])
+                except OSError:  # noqa: BLE001 — best-effort GC
+                    pass
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 # _execute handles job failures itself; anything escaping
                 # is a scheduler bug, and one bad job must not kill the
@@ -983,9 +1355,14 @@ class Scheduler:
 
     def _execute(self, job_id: str) -> None:
         with self._lock:
-            record = self._jobs[job_id]
-            spec = self._specs.pop(job_id)
-            x = self._data.pop(job_id)
+            record = self._jobs.get(job_id)
+            spec = self._specs.pop(job_id, None)
+            x = self._data.pop(job_id, None)
+        if record is None or spec is None or x is None:
+            # A lease takeover (note-lost sweep) evicted the job between
+            # dequeue and pickup: the successor owns it — stand down.
+            raise LeaseLost(job_id, "pickup", None, None)
+        with self._lock:
             fp = record["fingerprint"]
             submitted_at = float(record.get("submitted_at") or time.time())
 
@@ -1016,16 +1393,19 @@ class Scheduler:
         # now, serve it instead of re-running a whole sweep.
         cached = self.store.get_result(fp)
         if cached is not None:
-            with self._lock:
-                self.cache_hits += 1
-                self.jobs_completed += 1
             self._update(
                 job_id, status="done", result=cached, from_cache=True,
                 finished_at=round(time.time(), 3),
             )
+            # Counted only AFTER the fenced terminal write: a zombie
+            # whose job was taken over unwinds on LeaseLost above, and
+            # must not report a completion the store refused.
+            with self._lock:
+                self.cache_hits += 1
+                self.jobs_completed += 1
             self.events.emit(
                 "job_done", job_id=job_id, fingerprint=fp, cached=True,
-                bucket=bucket,
+                bucket=bucket, worker_id=self.worker_id,
             )
             return
 
@@ -1040,6 +1420,10 @@ class Scheduler:
         def block_cb(block: int, h_done: int, pac_list) -> None:
             # Per-streamed-block progress from the H-block driver: the
             # signs-of-life signal for a long job, at block resolution.
+            # The same beat renews this worker's leases (rate-limited,
+            # non-blocking inside the manager) — the heartbeat→renewal
+            # path of docs/SERVING.md "Multi-worker runbook".
+            self._lease_beat()
             self.events.emit(
                 "h_block_complete", job_id=job_id, block=block,
                 h_done=h_done, pac_area=pac_list,
@@ -1095,7 +1479,10 @@ class Scheduler:
                 job_id, status="running", attempt=attempt,
                 started_at=round(time.time(), 3),
             )
-            self.events.emit("job_started", job_id=job_id, attempt=attempt)
+            self.events.emit(
+                "job_started", job_id=job_id, attempt=attempt,
+                worker_id=self.worker_id,
+            )
             attempt_kwargs = dict(run_kwargs)
             attempt_span = tracer.span("attempt", attempt=attempt)
             if obs_executor:
@@ -1142,6 +1529,7 @@ class Scheduler:
                 self.events.emit(
                     "job_failed", job_id=job_id, error=str(e),
                     kind="timeout", bucket=bucket,
+                    worker_id=self.worker_id,
                 )
                 return
             except JobSpecError as e:
@@ -1155,6 +1543,7 @@ class Scheduler:
                 self.events.emit(
                     "job_failed", job_id=job_id, error=str(e),
                     kind="bad_request", bucket=bucket,
+                    worker_id=self.worker_id,
                 )
                 return
             except Exception as e:
@@ -1182,6 +1571,7 @@ class Scheduler:
                         point=e.point,
                         silent_seconds=round(e.silent_seconds, 3),
                         deadline_seconds=round(e.deadline, 3),
+                        worker_id=self.worker_id,
                     )
                 elif isinstance(e, IntegrityError):
                     # Silent corruption caught: count the breach by
@@ -1230,7 +1620,7 @@ class Scheduler:
                     self.events.emit(
                         "job_retry", job_id=job_id, attempt=attempt,
                         backoff_seconds=backoff, error=str(e),
-                        reason=reason,
+                        reason=reason, worker_id=self.worker_id,
                     )
                     self._sleep(backoff)
                     continue
@@ -1246,7 +1636,7 @@ class Scheduler:
                         "retries_exhausted" if kind == "retryable"
                         else f"fatal:{reason}"
                     ),
-                    bucket=bucket,
+                    bucket=bucket, worker_id=self.worker_id,
                 )
                 return
             seconds = time.perf_counter() - t0
@@ -1261,6 +1651,18 @@ class Scheduler:
             # always find the result bytes on disk.
             self.store.put_result(fp, result)
             stored = self.store.get_result(fp)
+            self._update(
+                job_id, status="done", result=stored,
+                finished_at=round(time.time(), 3), seconds=seconds,
+            )
+            # Success accounting only AFTER the fenced terminal write:
+            # a zombie whose job was taken over unwinds on LeaseLost at
+            # _update, and must not count a completion — or feed a good
+            # SLO attempt — for an attempt whose write the store
+            # refused (the fleet-wide jobs_completed sum would exceed
+            # the job count on every takeover-with-surviving-zombie
+            # otherwise; put_result above is the documented residual —
+            # first-writer-wins on canonical bytes).
             with self._lock:
                 self.jobs_completed += 1
             # End-to-end latency over EXECUTED jobs (admission to done,
@@ -1275,12 +1677,9 @@ class Scheduler:
             # was already fed at pickup, outcome-blind).
             self.slo.observe_attempt(bucket, ok=True)
             self.slo.observe_job(bucket, end_to_end, ok=True)
-            self._update(
-                job_id, status="done", result=stored,
-                finished_at=round(time.time(), 3), seconds=seconds,
-            )
             self.events.emit(
                 "job_done", job_id=job_id, fingerprint=fp,
                 seconds=round(seconds, 3), bucket=bucket,
+                worker_id=self.worker_id,
             )
             return

@@ -240,6 +240,10 @@ class ConsensusService:
         shed_policy: Optional[ShedPolicy] = None,
         memory_budget_bytes: Optional[int] = None,
         slo_monitor=None,
+        worker_id: Optional[str] = None,
+        leases: bool = True,
+        lease_ttl: float = 60.0,
+        lease_sweep: Optional[float] = None,
     ):
         self.store = JobStore(store_dir)
         self.events = EventLog(events_path)
@@ -261,6 +265,10 @@ class ConsensusService:
             shed_policy=shed_policy,
             memory_budget_bytes=memory_budget_bytes,
             slo=slo_monitor,
+            worker_id=worker_id,
+            leases=leases,
+            lease_ttl=lease_ttl,
+            lease_sweep=lease_sweep,
         )
         self.max_body_bytes = max_body_bytes
         self.started_at = time.time()

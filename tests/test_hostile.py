@@ -509,8 +509,27 @@ class TestQuarantine:
         assert exc.value.code == 0
         assert "no quarantined jobs" in capsys.readouterr().out
 
+    def test_admin_lease_state_roundtrips_real_lease(self, tmp_path):
+        """The no-drift guarantee, lease edition: serve-admin renders
+        lease state from the store's JSON directly (stdlib-only), and
+        this round trip against a real LeaseManager-written lease is
+        what keeps the two implementations honest."""
+        from consensus_clustering_tpu.serve.admin import lease_state
+        from consensus_clustering_tpu.serve.leases import LeaseManager
+
+        store = JobStore(str(tmp_path))
+        manager = LeaseManager(store.leases_dir, "wa", ttl=3600.0)
+        manager.claim_new("fedc01")
+        lease = lease_state(str(tmp_path), "fedc01")
+        assert lease["worker_id"] == "wa"
+        assert lease["token"] == 1
+        assert lease["state"] == "live"
+        manager.release("fedc01", "done")
+        assert lease_state(str(tmp_path), "fedc01")["state"] == "released"
+        assert lease_state(str(tmp_path), "neverleased") is None
+
     @pytest.mark.parametrize(
-        "subcommand", ["list", "trace", "report", "bundle"]
+        "subcommand", ["list", "show", "trace", "report", "bundle"]
     )
     def test_serve_admin_never_imports_jax(self, tmp_path, subcommand):
         """serve-admin exists for the moments the device stack is
@@ -529,6 +548,18 @@ class TestQuarantine:
         (jobs_dir / "fedc01.json").write_text(
             _json.dumps({"job_id": "fedc01", "status": "done"})
         )
+        # A lease for the show subcommand to render (owner/expiry from
+        # the store's JSON alone — still no jax, no numpy).
+        lease_dir = tmp_path / "leases" / "fedc01"
+        lease_dir.mkdir(parents=True, exist_ok=True)
+        (lease_dir / "token-00000002.json").write_text(
+            _json.dumps({
+                "job_id": "fedc01", "token": 2, "worker_id": "wa",
+                "acquired_at": 1.0, "renewed_at": 1.0,
+                "expires_at": 9.9e12, "released": False,
+                "released_status": None,
+            })
+        )
         events = tmp_path / "ev.jsonl"
         events.write_text(
             _json.dumps(
@@ -543,6 +574,7 @@ class TestQuarantine:
         )
         args = {
             "list": ["list"],
+            "show": ["show", "fedc01"],
             "trace": ["trace", "fedc01", "--events", str(events)],
             "report": ["report", "--events", str(events)],
             "bundle": [
@@ -559,11 +591,16 @@ class TestQuarantine:
         assert proc.returncode == 0, proc.stderr
         expected_out = {
             "list": "no quarantined jobs",
+            # show renders the lease (owner, token, computed state)
+            # from the store's JSON alone.
+            "show": '"state": "live"',
             "trace": "trace fedc01",
             "report": "per-bucket latency",
             "bundle": "env.json",
         }[subcommand]
         assert expected_out in proc.stdout
+        if subcommand == "show":
+            assert '"worker_id": "wa"' in proc.stdout
         imported = {
             line.split("|")[-1].strip()
             for line in proc.stderr.splitlines()

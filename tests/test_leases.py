@@ -1,0 +1,571 @@
+"""Fenced job leases: at-most-once execution over a SHARED jobstore.
+
+Unit coverage for :mod:`consensus_clustering_tpu.serve.leases` (claim /
+renew / fence / release / takeover, all against an injected clock — no
+sleeps) and for the scheduler integration the multi-worker story rests
+on: a live peer's jobs are untouchable, a dead peer's jobs are taken
+over, a zombie's writes are refused, and the solo fast-restart race
+that used to bump healthy jobs toward quarantine is closed.  The
+two-process version of this story — SIGKILL takeover with byte-identical
+resume, the pause-fault zombie — is ``benchmarks/chaos_soak.py
+--schedule cluster`` (CI ``chaos-cluster``).
+
+Everything here is host-only: stub executors, no compiles, no jax
+device work — the fast tier-1 lane stays fast.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.serve.executor import parse_job_spec
+from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.leases import (
+    LeaseLost,
+    LeaseManager,
+    read_lease,
+)
+from consensus_clustering_tpu.serve.scheduler import Scheduler
+
+
+class _Clock:
+    """An injectable wall clock: lease expiry without sleeping."""
+
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def tick(self, seconds):
+        self.now += seconds
+
+
+# ---------------------------------------------------------------------------
+# LeaseManager: claims, fencing tokens, renewal, release
+
+
+class TestLeaseManager:
+    def test_claim_new_then_fence_holds(self, tmp_path):
+        m = LeaseManager(str(tmp_path), "wa", ttl=10.0)
+        assert m.claim_new("job1") == 1
+        assert m.check_fence("job1")
+        assert m.owned_count() == 1
+        lease = read_lease(str(tmp_path), "job1")
+        assert lease["worker_id"] == "wa"
+        assert lease["token"] == 1
+        assert not lease["released"] and not lease["torn"]
+
+    def test_live_peer_lease_is_not_claimable(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        a.claim_new("job1")
+        # Neither a sweep (boot=False) nor a boot may touch a LIVE
+        # peer's lease — the rule that stops a booting worker counting
+        # a healthy peer's jobs as restarts.
+        assert b.claim_orphan("job1") is None
+        assert b.claim_orphan("job1", boot=True) is None
+
+    def test_expired_lease_taken_over_with_bumped_token(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        a.claim_new("job1")
+        clock.tick(10.1)  # past the ttl: wa is presumed dead
+        token, reason, prior = b.claim_orphan("job1")
+        assert (token, reason, prior) == (2, "expired", "wa")
+        # The zombie's fence now refuses; the taker's holds.
+        assert not a.check_fence("job1")
+        assert b.check_fence("job1")
+
+    def test_absent_released_torn_reasons(self, tmp_path):
+        clock = _Clock()
+        m = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        # absent: never leased (a pre-lease store).
+        assert m.claim_orphan("never")[1:] == ("absent", None)
+        # released: a terminal tombstone is re-claimable at token + 1
+        # (the serve-admin release path).
+        m.release("never", "done")
+        token, reason, prior = m.claim_orphan("never")
+        assert (token, reason, prior) == (2, "released", "wb")
+        # torn: an O_EXCL slot whose claimant died before writing JSON.
+        job_dir = os.path.join(str(tmp_path), "deadclaim")
+        os.makedirs(job_dir)
+        open(os.path.join(job_dir, "token-00000004.json"), "w").close()
+        assert read_lease(str(tmp_path), "deadclaim")["torn"]
+        token, reason, _ = m.claim_orphan("deadclaim")
+        assert (token, reason) == (5, "torn")
+
+    def test_self_restart_reclaims_at_boot_only(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        a.claim_new("job1")
+        # The same worker_id, a NEW process (fresh manager, lease still
+        # live): boot reclaims instantly — a restart-stable worker_id
+        # exists precisely so recovery need not wait out the ttl.
+        a2 = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        assert a2.claim_orphan("job1") is None  # sweep: not at boot
+        token, reason, prior = a2.claim_orphan("job1", boot=True)
+        assert (token, reason, prior) == (2, "self_restart", "wa")
+        # The ORIGINAL holder (still tracking token 1) is now fenced.
+        assert not a.check_fence("job1")
+
+    def test_boot_does_not_steal_own_tracked_lease(self, tmp_path):
+        # In-process stop()/start(): the manager still TRACKS the
+        # token, so boot must not ratchet it (requeue-ing live work).
+        m = LeaseManager(str(tmp_path), "wa", ttl=10.0)
+        m.claim_new("job1")
+        assert m.claim_orphan("job1", boot=True) is None
+        assert read_lease(str(tmp_path), "job1")["token"] == 1
+
+    def test_claim_race_single_winner(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        clock.tick(100)  # nothing leased yet; both race for token 1
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        wins = [m.claim_orphan("job1") for m in (a, b)]
+        assert sum(w is not None for w in wins) == 1
+
+    def test_in_flight_claim_is_invisible_not_torn(self, tmp_path):
+        """The claim is atomic with its content (tmp write + hard
+        link): a peer mid-claim — or one that crashed there — leaves
+        only a tmp file, which readers must NOT classify as a torn
+        claimable slot (a third worker doing so would falsely
+        supersede a live, healthy claimant)."""
+        mgr = LeaseManager(str(tmp_path), "wa", ttl=10.0)
+        assert mgr._try_claim("job1", 1)
+        stranded = os.path.join(
+            mgr._job_dir("job1"), "token-00000002.json.deadbeef.claim"
+        )
+        with open(stranded, "w") as f:
+            f.write('{"half": "writ')
+        lease = read_lease(str(tmp_path), "job1")
+        assert lease["token"] == 1 and not lease["torn"]
+        assert mgr.check_fence("job1")
+
+    def test_renew_extends_and_detects_loss(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        a.claim_new("job1")
+        clock.tick(8.0)
+        assert a.renew_owned() == []  # healthy renewal, nothing lost
+        lease = read_lease(str(tmp_path), "job1")
+        assert lease["expires_at"] == pytest.approx(clock.now + 10.0)
+        # A peer takes over after expiry; wa's next renewal round must
+        # REPORT the loss (we are a zombie for job1) and drop tracking.
+        clock.tick(10.1)
+        assert b.claim_orphan("job1") is not None
+        assert a.renew_owned() == ["job1"]
+        assert a.owned_count() == 0
+
+    def test_release_tombstones_keeping_token(self, tmp_path):
+        m = LeaseManager(str(tmp_path), "wa", ttl=10.0)
+        m.claim_new("job1")
+        assert m.release("job1", "done")
+        lease = read_lease(str(tmp_path), "job1")
+        assert lease["released"] and lease["released_status"] == "done"
+        assert lease["token"] == 1  # KEPT: the tombstone fences zombies
+        assert not m.check_fence("job1")  # released = no longer writable
+        assert not m.release("job1", "done")  # idempotent-ish: already gone
+
+    def test_superseded_slots_are_garbage_collected(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        a.claim_new("job1")
+        clock.tick(11)
+        b.claim_orphan("job1")
+        names = sorted(os.listdir(os.path.join(str(tmp_path), "job1")))
+        assert names == ["token-00000002.json"]
+
+    def test_maybe_renew_is_rate_limited(self, tmp_path):
+        clock = _Clock()
+        m = LeaseManager(
+            str(tmp_path), "wa", ttl=10.0, renew_every=2.0, clock=clock
+        )
+        m.claim_new("job1")
+        m.renew_owned()
+        first = read_lease(str(tmp_path), "job1")["expires_at"]
+        clock.tick(1.0)
+        m.maybe_renew()  # inside renew_every: skipped
+        assert read_lease(str(tmp_path), "job1")["expires_at"] == first
+        clock.tick(1.1)
+        m.maybe_renew()  # due now
+        assert read_lease(str(tmp_path), "job1")["expires_at"] > first
+
+    def test_invalid_job_ids_rejected(self, tmp_path):
+        m = LeaseManager(str(tmp_path), "wa", ttl=10.0)
+        with pytest.raises(ValueError):
+            m.claim_new("../escape")
+        assert read_lease(str(tmp_path), "../escape") is None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: stub executors over a shared store
+
+
+class _StubExecutor:
+    def __init__(self, block=None):
+        self.run_count = 0
+        self.executable_cache_hits = 0
+        self._block = block
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, spec, x, progress_cb=None):
+        self.run_count += 1
+        if self._block is not None:
+            self._block.wait()
+        return {"ok": True, "shape": [int(v) for v in x.shape]}
+
+
+def _spec(seed=23):
+    return parse_job_spec(
+        {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0], [3.0, 3.0]],
+         "config": {"k": [2], "iterations": 5, "seed": seed}}
+    )
+
+
+def _wait_status(sched, job_id, statuses=("done",), budget=10.0):
+    deadline = time.time() + budget
+    record = None
+    while time.time() < deadline:
+        record = sched.get(job_id)
+        if record and record["status"] in statuses:
+            return record
+        time.sleep(0.02)
+    raise AssertionError(f"job stuck at {record and record['status']}")
+
+
+class TestSchedulerLeases:
+    def test_live_peer_survives_two_boot_reconciliations(self, tmp_path):
+        """THE solo-regression satellite: a booting worker must not
+        requeue — nor bump ``restart_attempts`` toward quarantine for —
+        a job a live peer is legitimately running.  Two successive
+        reconciliations, because the old behaviour bumped once per
+        boot: one healthy job died of N fast restarts of the OTHER
+        process."""
+        gate = threading.Event()
+        store_a = JobStore(str(tmp_path))
+        a = Scheduler(
+            _StubExecutor(block=gate), store_a, worker_id="wa",
+            quarantine_after=2,
+        )
+        a.start()
+        try:
+            spec, x = _spec()
+            rec = a.submit(spec, x)
+            job_id = rec["job_id"]
+            _wait_status(a, job_id, ("running",))
+            for boot in range(2):
+                b = Scheduler(
+                    _StubExecutor(), JobStore(str(tmp_path)),
+                    worker_id="wb", quarantine_after=2,
+                )
+                b._reconcile_orphans(boot=True)
+                assert b.lease_takeovers_total == 0, f"boot {boot}"
+                assert b.get(job_id)["status"] == "running"
+            # The restart counter never moved: the payload still says 0.
+            _, _, attempts = store_a.load_payload(job_id)
+            assert attempts == 0
+            gate.set()
+            assert _wait_status(a, job_id)["status"] == "done"
+        finally:
+            gate.set()
+            a.stop()
+
+    def test_takeover_of_expired_lease_requeues_once(self, tmp_path):
+        """Dead-worker takeover: a queued orphan whose lease expired is
+        claimed exactly once (token bumped, lease_takeover counted) and
+        completes on the surviving worker."""
+        store = JobStore(str(tmp_path))
+        spec, x = _spec()
+        # A dead worker's leavings: queued record + payload + an
+        # already-expired lease (claimed in the past, never renewed).
+        clock = _Clock(start=time.time() - 3600)
+        dead = LeaseManager(store.leases_dir, "dead", ttl=5.0, clock=clock)
+        fp = store.fingerprint(spec.fingerprint_payload(), x)
+        record = {
+            "job_id": "f" * 32, "fingerprint": fp, "status": "queued",
+            "shape": [4, 2], "submitted_at": clock.now, "attempt": 0,
+            "priority": "normal", "from_cache": False,
+        }
+        store.save_payload("f" * 32, spec.fingerprint_payload(), x)
+        store.save_job(record)
+        dead.claim_new("f" * 32)
+        survivor = Scheduler(
+            _StubExecutor(), store, worker_id="wb", quarantine_after=3,
+        )
+        events = []
+        survivor.events.emit = lambda name, **f: events.append((name, f))
+        survivor.start()
+        try:
+            done = _wait_status(survivor, "f" * 32)
+            assert done["status"] == "done"
+            assert done["restart_requeues"] == 1
+            assert survivor.lease_takeovers_total == 1
+            takeovers = [f for n, f in events if n == "lease_takeover"]
+            assert takeovers[0]["reason"] == "expired"
+            assert takeovers[0]["prior_worker"] == "dead"
+            assert takeovers[0]["token"] == 2
+            # Terminal transition released the taker's lease.
+            lease = read_lease(store.leases_dir, "f" * 32)
+            assert lease["released"] and lease["worker_id"] == "wb"
+        finally:
+            survivor.stop()
+
+    def test_takeover_stands_down_when_peer_terminalises_in_claim_window(
+        self, tmp_path
+    ):
+        """A peer finishing the job between the sweeper's record read
+        and its winning claim (the released tombstone is exactly what
+        made the lease claimable) must NOT have its done record
+        clobbered by the taker's stale queued/running snapshot: the
+        taker re-reads after the claim, re-tombstones, and stands
+        down — no takeover counted, no requeue, no failure written."""
+        store = JobStore(str(tmp_path))
+        spec, x = _spec()
+        clock = _Clock(start=time.time() - 3600)
+        dead = LeaseManager(store.leases_dir, "dead", ttl=5.0, clock=clock)
+        fp = store.fingerprint(spec.fingerprint_payload(), x)
+        job_id = "e" * 32
+        store.save_payload(job_id, spec.fingerprint_payload(), x)
+        store.save_job({
+            "job_id": job_id, "fingerprint": fp, "status": "running",
+            "shape": [4, 2], "submitted_at": clock.now, "attempt": 1,
+            "priority": "normal", "from_cache": False,
+        })
+        dead.claim_new(job_id)
+        survivor = Scheduler(
+            _StubExecutor(), store, worker_id="wb", quarantine_after=3,
+        )
+        events = []
+        survivor.events.emit = lambda name, **f: events.append((name, f))
+        real_claim = survivor.leases.claim_orphan
+
+        def racing_claim(jid, boot=False):
+            out = real_claim(jid, boot=boot)
+            if out is not None:
+                # The peer's terminal write lands inside the claim
+                # window: record done, before the taker re-reads.
+                store.save_job({**store.load_job(jid), "status": "done",
+                                "result_fingerprint": "peer"})
+            return out
+
+        survivor.leases.claim_orphan = racing_claim
+        survivor._reconcile_orphans(boot=True)
+        record = store.load_job(job_id)
+        assert record["status"] == "done"
+        assert record["result_fingerprint"] == "peer"
+        assert survivor.lease_takeovers_total == 0
+        assert [n for n, _ in events] == []  # no takeover/requeue/fail
+        lease = read_lease(store.leases_dir, job_id)
+        assert lease["released"] and lease["worker_id"] == "wb"
+
+    def test_periodic_sweep_reads_leases_not_terminal_history(
+        self, tmp_path
+    ):
+        """The running takeover sweep (boot=False) must be driven from
+        the tiny lease token files, not a full walk of the store's
+        job records: released tombstones (terminal jobs' normal end
+        state) are skipped without ever parsing their result-embedding
+        records, while an expired lease's job is still taken over."""
+        store = JobStore(str(tmp_path))
+        spec, x = _spec()
+        fp = store.fingerprint(spec.fingerprint_payload(), x)
+        # A long-terminal job: done record + released lease tombstone.
+        done_id = "d" * 32
+        store.save_job({
+            "job_id": done_id, "fingerprint": fp, "status": "done",
+            "shape": [4, 2], "submitted_at": 1.0, "attempt": 1,
+            "priority": "normal", "from_cache": False,
+        })
+        finished = LeaseManager(store.leases_dir, "wa", ttl=60.0)
+        finished.claim_new(done_id)
+        finished.release(done_id, "done")
+        # A dead worker's leavings: queued record + expired lease.
+        orphan_id = "f" * 32
+        clock = _Clock(start=time.time() - 3600)
+        dead = LeaseManager(store.leases_dir, "dead", ttl=5.0, clock=clock)
+        store.save_payload(orphan_id, spec.fingerprint_payload(), x)
+        store.save_job({
+            "job_id": orphan_id, "fingerprint": fp, "status": "queued",
+            "shape": [4, 2], "submitted_at": clock.now, "attempt": 0,
+            "priority": "normal", "from_cache": False,
+        })
+        dead.claim_new(orphan_id)
+        survivor = Scheduler(
+            _StubExecutor(), store, worker_id="wb", quarantine_after=3,
+        )
+        survivor.store.iter_jobs = lambda: (_ for _ in ()).throw(
+            AssertionError(
+                "the periodic sweep must not walk the job records"
+            )
+        )
+        loaded = []
+        real_load = store.load_job
+        store.load_job = lambda jid: (loaded.append(jid), real_load(jid))[1]
+        survivor._reconcile_orphans(boot=False)
+        assert survivor.lease_takeovers_total == 1
+        assert real_load(orphan_id)["status"] == "queued"
+        assert real_load(orphan_id)["restart_requeues"] == 1
+        # The terminal job's record was never parsed: its released
+        # tombstone was skip enough.
+        assert done_id not in loaded
+
+    def test_zombie_terminal_write_refused(self, tmp_path):
+        """The fence: a worker whose lease was superseded mid-execution
+        must have its terminal write REFUSED (lease_refused counted,
+        job not flipped) — the successor's record is the record."""
+        gate = threading.Event()
+        store = JobStore(str(tmp_path))
+        zombie = Scheduler(
+            _StubExecutor(block=gate), store, worker_id="wz",
+        )
+        events = []
+        zombie.events.emit = lambda name, **f: events.append((name, f))
+        zombie.start()
+        try:
+            spec, x = _spec()
+            rec = zombie.submit(spec, x)
+            job_id = rec["job_id"]
+            _wait_status(zombie, job_id, ("running",))
+            # A peer supersedes the lease while wz's attempt is stuck
+            # on the gate (simulating the pause-fault renewal stall —
+            # disk says "taken over", wz doesn't know yet).
+            taker = LeaseManager(store.leases_dir, "wt", ttl=60.0)
+            taker._try_claim(job_id, 2)
+            store.save_job({**store.load_job(job_id), "status": "running",
+                            "owner": "wt"})
+            gate.set()  # wz's attempt completes and tries to write
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if zombie.lease_refused_writes_total >= 1:
+                    break
+                time.sleep(0.02)
+            assert zombie.lease_refused_writes_total >= 1
+            refused = [f for n, f in events if n == "lease_refused"]
+            assert refused and refused[0]["newer_token"] == 2
+            # The zombie wrote NOTHING terminal: the successor's record
+            # still stands exactly as it left it.
+            assert store.load_job(job_id)["status"] == "running"
+            assert store.load_job(job_id)["owner"] == "wt"
+            assert zombie.jobs_failed == 0  # stood down, not a failure
+            # Nor a success: the refused terminal write must not count
+            # a completion (or the fleet-wide jobs_completed sum would
+            # exceed the job count on every takeover with a surviving
+            # zombie).
+            assert zombie.jobs_completed == 0
+        finally:
+            gate.set()
+            zombie.stop()
+
+    def test_stand_down_clears_ring_when_record_already_done(
+        self, tmp_path
+    ):
+        """Checkpoint-ring writes are not fenced — a zombie completing
+        blocks after the successor's terminal clear re-creates gen-*
+        files nobody would ever clear again.  The LeaseLost stand-down
+        must re-run the terminal clear when the record is done."""
+        gate = threading.Event()
+        store = JobStore(str(tmp_path))
+        zombie = Scheduler(
+            _StubExecutor(block=gate), store, worker_id="wz",
+        )
+        zombie.start()
+        try:
+            spec, x = _spec()
+            job_id = zombie.submit(spec, x)["job_id"]
+            _wait_status(zombie, job_id, ("running",))
+            taker = LeaseManager(store.leases_dir, "wt", ttl=60.0)
+            taker._try_claim(job_id, 2)
+            # The successor already finished AND cleared the ring; the
+            # zombie's still-running blocks then re-created files in it.
+            record = store.load_job(job_id)
+            fp = record["fingerprint"]
+            ring = store.checkpoint_dir(fp)
+            os.makedirs(ring, exist_ok=True)
+            with open(os.path.join(ring, "gen-000001.ckpt"), "w") as f:
+                f.write("zombie block state")
+            store.save_job({**record, "status": "done", "owner": "wt"})
+            gate.set()  # zombie's terminal write → refused → stand-down
+            deadline = time.time() + 10
+            while time.time() < deadline and os.path.isdir(ring):
+                time.sleep(0.02)
+            assert not os.path.isdir(ring), (
+                "stand-down left the zombie's re-created ring on disk"
+            )
+            assert zombie.lease_refused_writes_total >= 1
+            assert store.load_job(job_id)["status"] == "done"
+        finally:
+            gate.set()
+            zombie.stop()
+
+    def test_lease_sweep_must_be_positive(self, tmp_path):
+        """A negative/zero sweep interval would turn the maintenance
+        thread's stop.wait into a disk-hammering busy loop — reject it
+        at construction like lease_ttl."""
+        store = JobStore(str(tmp_path))
+        for bad in (-1, 0.0):
+            with pytest.raises(ValueError, match="lease_sweep"):
+                Scheduler(_StubExecutor(), store, lease_sweep=bad)
+
+    def test_leases_off_keeps_solo_behaviour(self, tmp_path):
+        sched = Scheduler(_StubExecutor(), JobStore(str(tmp_path)),
+                          leases=False)
+        sched.start()
+        try:
+            spec, x = _spec()
+            rec = sched.submit(spec, x)
+            assert _wait_status(sched, rec["job_id"])["status"] == "done"
+            m = sched.metrics()
+            assert m["active_leases"] == 0
+            assert m["lease_takeovers_total"] == 0
+        finally:
+            sched.stop()
+
+    def test_queue_full_rollback_drops_lease_dir(self, tmp_path):
+        gate = threading.Event()
+        store = JobStore(str(tmp_path))
+        sched = Scheduler(
+            _StubExecutor(block=gate), store, max_queue=1, worker_id="wa",
+        )
+        sched.start()
+        try:
+            ids = []
+            overflow = None
+            for seed in range(5):
+                spec, x = _spec(seed=seed)
+                try:
+                    ids.append(sched.submit(spec, x)["job_id"])
+                except Exception:
+                    spec, x = _spec(seed=seed)
+                    overflow = True
+                    break
+            assert overflow, "queue never filled"
+            # Exactly the admitted jobs hold lease dirs — the rolled-
+            # back admission left nothing for a peer's sweep to find.
+            assert sorted(os.listdir(store.leases_dir)) == sorted(ids)
+            gate.set()
+        finally:
+            gate.set()
+            sched.stop()
+
+
+class TestLeaseLostUnwind:
+    def test_lease_lost_is_runtime_error_with_fields(self):
+        e = LeaseLost("j1", "update:done", 1, 2)
+        assert isinstance(e, RuntimeError)
+        assert (e.job_id, e.op, e.token, e.newer_token) == (
+            "j1", "update:done", 1, 2
+        )
+        assert "update:done" in str(e)

@@ -1019,6 +1019,48 @@ class TestQueryEngine:
         ] == 300.0
         q.render_report(report)  # zero-job rows must render
 
+    def test_per_worker_rows_attribute_fleet_activity(self):
+        """Satellite (docs/SERVING.md "Multi-worker runbook"): a merged
+        log from two workers over one store must attribute every
+        attempt, takeover, and fenced refusal to its worker."""
+        q = _query()
+        events = [
+            {"ts": 1.0, "event": "job_done", "job_id": "a1",
+             "seconds": 1.0, "bucket": "bX", "worker_id": "wa"},
+            {"ts": 2.0, "event": "job_done", "job_id": "a2",
+             "seconds": 2.0, "bucket": "bX", "worker_id": "wb"},
+            {"ts": 3.0, "event": "lease_takeover", "job_id": "a3",
+             "worker_id": "wb", "prior_worker": "wa", "token": 2,
+             "reason": "expired"},
+            {"ts": 4.0, "event": "job_requeued", "job_id": "a3",
+             "restart_requeues": 1, "worker_id": "wb"},
+            {"ts": 5.0, "event": "lease_refused", "job_id": "a3",
+             "op": "update:done", "worker_id": "wa", "token": 1,
+             "newer_token": 2},
+            {"ts": 6.0, "event": "job_failed", "job_id": "a4",
+             "error": "x", "kind": "fatal:ValueError", "bucket": "bX",
+             "worker_id": "wa"},
+        ]
+        report = q.summarize(events)
+        assert report["per_worker"] == {
+            "wa": {"done": 1, "failed": 1, "retried": 0, "requeued": 0,
+                   "takeovers": 0, "refused_writes": 1},
+            "wb": {"done": 1, "failed": 0, "retried": 0, "requeued": 1,
+                   "takeovers": 1, "refused_writes": 0},
+        }
+        text = q.render_report(report)
+        assert "per-worker" in text
+        assert "wa  done=1 failed=1" in text
+        assert "takeovers=1" in text
+        # Pre-lease logs (no worker_id anywhere) keep a clean report:
+        # no fleet, no rows, no crash.
+        bare = q.summarize([
+            {"ts": 1.0, "event": "job_done", "job_id": "a1",
+             "seconds": 1.0, "bucket": "bX"},
+        ])
+        assert bare["per_worker"] == {}
+        assert "per-worker" not in q.render_report(bare)
+
 
 # ---------------------------------------------------------------------------
 # Events contract: every emitted name is catalogued, and vice versa

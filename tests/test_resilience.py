@@ -252,6 +252,30 @@ class TestFaults:
             faults.configure("block_start")
         with pytest.raises(ValueError, match="action"):
             faults.configure("block_start=2:explode")
+        with pytest.raises(ValueError, match="point=index"):
+            faults.configure("lease_renewal=0:pause:-3")
+        with pytest.raises(ValueError, match="point=index"):
+            faults.configure("lease_renewal=0:pause:abc")
+
+    def test_pause_sleeps_and_continues(self):
+        """The deterministic-zombie action (docs/SERVING.md
+        "Multi-worker runbook"): pause must stall the calling thread
+        and then RETURN — stalling liveness telemetry must not fail
+        the attempt — and disarm like every rule."""
+        import time as _time
+
+        try:
+            faults.configure("lease_renewal=1:pause:0.2")
+            faults.fire("lease_renewal", index=0)  # unarmed: no-op
+            t0 = _time.monotonic()
+            faults.fire("lease_renewal", index=1)  # sleeps, no raise
+            assert _time.monotonic() - t0 >= 0.2
+            t0 = _time.monotonic()
+            faults.fire("lease_renewal", index=1)  # disarmed: instant
+            assert _time.monotonic() - t0 < 0.1
+            assert ("lease_renewal", 1, "pause") in faults.fired
+        finally:
+            faults.clear()
 
     @pytest.mark.slow
     def test_kill_action_exits_like_sigkill(self):

@@ -188,6 +188,9 @@ EXPECTED_METRICS_KEYS = frozenset(
         "perf_drift_events_total", "profile_requests_total",
         "memory_accounting", "slo", "slo_breach_events_total",
         "preflight_inaccurate_events_total",
+        # Fenced-lease layer (docs/SERVING.md "Multi-worker runbook").
+        "worker_id", "active_leases", "lease_takeovers_total",
+        "lease_refused_writes_total", "lease_expired_total",
     }
 )
 
@@ -206,6 +209,13 @@ def test_metrics_schema(base):
     # in checkpoint_verify_rejects_total, never a violation key that
     # cannot fire.
     assert set(m["integrity_violations_total"]) == {"accumulator"}
+    # Fenced-lease layer (docs/SERVING.md "Multi-worker runbook"): the
+    # worker identity is a string, the lease gauges/counters pre-seeded
+    # integers — present from the first scrape, leases on or off.
+    assert isinstance(m["worker_id"], str) and m["worker_id"]
+    for key in ("active_leases", "lease_takeovers_total",
+                "lease_refused_writes_total", "lease_expired_total"):
+        assert isinstance(m[key], int), key
     # Observability layer (docs/OBSERVABILITY.md): all four latency
     # histograms pre-seeded with the full fixed bucket ladder, and the
     # drift snapshot's fixed section keys.
@@ -287,6 +297,14 @@ def test_metrics_prom_exposition(base):
         'cctpu_job_seconds_bucket{le="+Inf"}',
         "cctpu_perf_drift_enabled 1",
         'cctpu_backend_info{backend="cpu-fallback"} 1',
+        # The lease families (docs/SERVING.md "Multi-worker runbook"):
+        # worker identity as an info metric, the per-worker lease gauge
+        # labelled with it, and the takeover/fence counters.
+        'cctpu_worker_info{worker_id="',
+        'cctpu_active_leases{worker_id="',
+        "# TYPE cctpu_lease_takeovers_total counter",
+        "# TYPE cctpu_lease_refused_writes_total counter",
+        "# TYPE cctpu_lease_expired_total counter",
     ):
         assert needle in text, needle
     code_q, _, text_q = _req_text(base, "/metrics?format=prom")
