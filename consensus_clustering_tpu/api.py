@@ -234,6 +234,37 @@ class ConsensusClustering:
         Calibration store for ``autotune=True`` (default: the repo's
         committed ``benchmarks/calibration`` seeds, or
         ``CCTPU_CALIBRATION_DIR``).
+    mode : {'exact', 'estimate', 'auto'}, keyword-only
+        Consensus execution mode (``config.ESTIMATOR_MODES``).
+        ``'exact'`` (default) runs the dense integer-accumulator
+        engines — the reference statistic, O(N²) device memory.
+        ``'estimate'`` runs the sampled-pair estimator
+        (:mod:`consensus_clustering_tpu.estimator`): PAC/CDF estimated
+        from ``n_pairs`` uniform upper-triangle pairs with O(M) state
+        — any N fits — and a DKW error band disclosed in
+        ``metrics_['estimator']`` (``pac_error_bound``,
+        ``cdf_error_bound``, confidence).  Matrices are never
+        materialised (``store_matrices=True`` raises;
+        ``compute_consensus_labels`` needs matrices and raises too),
+        and a host-backend clusterer raises — the estimator is a
+        device-path engine.  ``'auto'`` picks exact when the dense
+        footprint fits the resolved memory budget
+        (``CCTPU_MEMORY_BUDGET`` > device > host RAM), estimate
+        otherwise, and logs which way it went.
+    n_pairs : int, keyword-only, optional
+        Pair-sample size for estimate mode.  None (default) uses the
+        deterministic default (:func:`~consensus_clustering_tpu.
+        estimator.bounds.default_n_pairs`: 2^17 capped at the pair
+        population).  More pairs: tighter bound, more state — both
+        scale as documented in the disclosure.
+    exact_best_k : bool, keyword-only
+        With ``mode='estimate'``: after model selection, recompute the
+        CHOSEN K's curves exactly via the row-tiled exact pass
+        (:mod:`~consensus_clustering_tpu.estimator.tiled` — O(H·N + tile·N)
+        peak memory, O(N²·H) time for that one K) and replace its entry, so
+        best-K reporting carries no estimation band.  ``best_k_``
+        itself stays the estimator's selection (re-selecting on the
+        refined value would bias toward the refined K).
 
     Attributes
     ----------
@@ -291,6 +322,9 @@ class ConsensusClustering:
         integrity_check_every: int = 0,
         autotune: bool = False,
         calibration_dir: Optional[str] = None,
+        mode: str = "exact",
+        n_pairs: Optional[int] = None,
+        exact_best_k: bool = False,
     ):
         self.K_range = K_range
         self.n_iterations = n_iterations
@@ -368,6 +402,27 @@ class ConsensusClustering:
         self.integrity_check_every = integrity_check_every
         self.autotune = autotune
         self.calibration_dir = calibration_dir
+        from consensus_clustering_tpu.config import validate_mode
+
+        self.mode = validate_mode(mode)
+        if n_pairs is not None and (
+            isinstance(n_pairs, bool)
+            or not isinstance(n_pairs, int)
+            or n_pairs < 1
+        ):
+            raise ValueError(
+                f"n_pairs must be an int >= 1 or None, got {n_pairs!r}"
+            )
+        if n_pairs is not None and self.mode == "exact":
+            # Mirror the CLI and the serving parser: a pair-sample size
+            # on the engine that has no pair sample is a contradiction,
+            # not a knob to ignore (the user almost certainly meant
+            # mode='estimate').
+            raise ValueError(
+                "n_pairs only applies with mode='estimate' or 'auto'"
+            )
+        self.n_pairs = n_pairs
+        self.exact_best_k = bool(exact_best_k)
         # Calibrated clusterer options (currently the default KMeans'
         # max_iter): set by the fit-time resolution, merged by
         # _effective_options without outranking anything explicit.
@@ -484,6 +539,10 @@ class ConsensusClustering:
                 "(store_matrices is False, or 'auto' disabled them for this "
                 "N); pass store_matrices=True explicitly"
             )
+
+        mode = self._resolve_mode(n, d)
+        if mode == "estimate":
+            return self._fit_estimate(X, n, d)
 
         # Autotune resolution (docs/AUTOTUNE.md): fill UNSET perf knobs
         # from parity-gated calibration, user pins always winning.  Only
@@ -784,6 +843,228 @@ class ConsensusClustering:
             **self.metrics_,
         )
 
+        if self.plot_cdf:
+            from consensus_clustering_tpu.utils.plotting import plot_cdf
+
+            plot_cdf(self.cdf_at_K_data, self.PAC_interval)
+        return self
+
+    def _estimate_infeasible_reason(self) -> Optional[str]:
+        """Why estimate mode cannot run for THIS configuration, or
+        None.  The auto resolver consults it so 'auto' degrades to an
+        exact attempt (the serving resolver's rule: exact again when
+        the estimator is not an option) instead of resolving into a
+        guaranteed ValueError."""
+        if self.store_matrices is True:
+            return "store_matrices=True (the estimator never builds them)"
+        if self.compute_consensus_labels:
+            return "compute_consensus_labels needs the matrices"
+        _c = self.clusterer
+        if isinstance(_c, HostClusterer) or (
+            _c is not None
+            and hasattr(_c, "fit_predict")
+            and hasattr(_c, "get_params")
+        ):
+            return "host-backend clusterer (no compiled block to stream)"
+        return None
+
+    def _resolve_mode(self, n: int, d: int) -> str:
+        """Resolve ``mode='auto'`` against the memory budget: exact
+        when the dense footprint fits (or no budget is resolvable, or
+        estimate mode is infeasible for this configuration), the
+        sampled-pair estimator otherwise — the fit-API spelling of
+        the serving admission path, logged either way."""
+        if self.mode != "auto":
+            return self.mode
+        infeasible = self._estimate_infeasible_reason()
+        if infeasible is not None:
+            logger.info(
+                "mode=auto: estimate mode unavailable here (%s) — "
+                "attempting exact", infeasible,
+            )
+            return "exact"
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_job_bytes,
+            resolve_memory_budget,
+        )
+
+        budget = resolve_memory_budget()
+        if budget is None:
+            logger.info("mode=auto: no memory budget resolvable — exact")
+            return "exact"
+        from consensus_clustering_tpu.config import autotune_stream_block
+
+        estimate = estimate_job_bytes(
+            n, d, tuple(self.K_range),
+            dtype=self.compute_dtype,
+            h_block=self.stream_h_block
+            or autotune_stream_block(self.n_iterations),
+            subsampling=self.subsampling,
+            checkpoints=self.checkpoint_dir is not None,
+        )
+        if estimate["total_bytes"] <= budget:
+            logger.info(
+                "mode=auto: dense footprint %d bytes fits budget %d — "
+                "exact", estimate["total_bytes"], budget,
+            )
+            return "exact"
+        logger.info(
+            "mode=auto: dense footprint %d bytes exceeds budget %d — "
+            "running the sampled-pair estimator (disclosed error bound "
+            "in metrics_['estimator'])", estimate["total_bytes"], budget,
+        )
+        return "estimate"
+
+    def _fit_estimate(self, X: np.ndarray, n: int, d: int):
+        """The estimate-mode fit path: the sampled-pair engine
+        (:mod:`consensus_clustering_tpu.estimator`) instead of a dense
+        sweep — O(M) state, curves with a disclosed DKW band in
+        ``metrics_['estimator']``, optional row-tiled exactness
+        refinement of the chosen K (``exact_best_k``)."""
+        from consensus_clustering_tpu.config import autotune_stream_block
+        from consensus_clustering_tpu.estimator.engine import (
+            run_pair_estimate,
+        )
+
+        if self.store_matrices is True:
+            raise ValueError(
+                "store_matrices=True is incompatible with "
+                "mode='estimate': the estimator never materialises the "
+                "N x N matrices — that is the point; pass "
+                "store_matrices='auto' or False"
+            )
+        if self.compute_consensus_labels:
+            raise ValueError(
+                "compute_consensus_labels=True needs the consensus "
+                "matrices, which mode='estimate' never materialises"
+            )
+        clusterer, is_host = self._resolve_clusterer()
+        if is_host:
+            raise ValueError(
+                "mode='estimate' is a device-path engine: a "
+                "host-backend (sklearn) clusterer has no compiled "
+                "block to stream — use a JAX-native clusterer or "
+                "mode='exact'"
+            )
+        if self.k_batch_size is not None:
+            logger.info(
+                "k_batch_size is ignored with mode='estimate': the "
+                "pair engine runs every K in one O(M)-state program"
+            )
+        self.autotune_ = None
+        self._autotune_options = {}
+        config = SweepConfig(
+            n_samples=n,
+            n_features=d,
+            k_values=tuple(self.K_range),
+            n_iterations=self.n_iterations,
+            subsampling=self.subsampling,
+            bins=self.bins,
+            pac_interval=self.PAC_interval,
+            parity_zeros=self.parity_zeros,
+            store_matrices=False,
+            chunk_size=self.chunk_size,
+            cluster_batch=self.cluster_batch,
+            split_init=bool(self.split_init),
+            reseed_clusterer_per_resample=(
+                self.reseed_clusterer_per_resample
+            ),
+            stream_h_block=self.stream_h_block
+            or autotune_stream_block(self.n_iterations),
+            adaptive_tol=self.adaptive_tol,
+            adaptive_patience=self.adaptive_patience,
+            adaptive_min_h=self.adaptive_min_h,
+            integrity_check_every=self.integrity_check_every,
+            use_pallas=self.use_pallas,
+            dtype=self.compute_dtype,
+        )
+        from consensus_clustering_tpu.utils.metrics import MetricsLogger
+
+        metrics_logger = MetricsLogger(self.metrics_path)
+
+        def block_cb(block, h_done, pac):
+            metrics_logger.emit(
+                "h_block_complete",
+                block=block, h_done=h_done, pac_area=pac,
+            )
+
+        stream_ckpt = None
+        if self.checkpoint_dir is not None:
+            # Block-granular durability only: the per-K checkpoint
+            # files are an EXACT-result store (their fingerprint knows
+            # nothing of mode/n_pairs), so estimate mode must never
+            # read or write them — the stream ring, keyed by the
+            # estimator's own fingerprint scheme, is the resume layer.
+            import os as _os
+
+            from consensus_clustering_tpu.resilience.blocks import (
+                StreamCheckpointer,
+            )
+
+            stream_ckpt = StreamCheckpointer(
+                _os.path.join(self.checkpoint_dir, "stream")
+            )
+        try:
+            out = run_pair_estimate(
+                clusterer, config, X, self.random_state,
+                n_pairs=self.n_pairs,
+                block_callback=block_cb,
+                checkpointer=stream_ckpt,
+            )
+        finally:
+            if stream_ckpt is not None:
+                stream_ckpt.close()
+        ks = list(config.k_values)
+        entries = self._entries_from_out(out, ks, config)
+        if self.progress_callback is not None:
+            for i, k in enumerate(ks):
+                self.progress_callback(int(k), float(out["pac_area"][i]))
+        self._build_results(entries, config, {}, [out["timing"]])
+        self.metrics_["mode"] = "estimate"
+        self.metrics_["streaming"] = out["streaming"]
+        # The never-silent rule for an approximation: the band travels
+        # WITH the result, in the same metrics dict as the timings.
+        self.metrics_["estimator"] = out["estimator"]
+        if self.exact_best_k:
+            from consensus_clustering_tpu.estimator.tiled import (
+                exact_curves_for_k,
+            )
+
+            # Refine at the resamples the estimate ACTUALLY ran
+            # (h_effective): under adaptive early stop the estimator's
+            # statistic is "consensus over h_effective resamples", and
+            # a full-H refinement would be a DIFFERENT statistic whose
+            # distance from the estimate the disclosed band does not
+            # cover (pair choice must stay the only error source).
+            refine_config = dataclasses.replace(
+                config,
+                n_iterations=int(out["streaming"]["h_effective"]),
+            )
+            exact = exact_curves_for_k(
+                clusterer, refine_config, X, self.random_state,
+                self.best_k_,
+            )
+            entry = self.cdf_at_K_data[self.best_k_]
+            entry["hist"] = np.asarray(exact["hist"], np.float64)
+            entry["cdf"] = np.asarray(exact["cdf"], np.float64)
+            entry["pac_area"] = float(exact["pac_area"])
+            self.metrics_["exact_best_k"] = {
+                "k": int(self.best_k_),
+                "pac_area_exact": float(exact["pac_area"]),
+            }
+        metrics_logger.emit(
+            "sweep_complete",
+            n_samples=n,
+            k_values=[int(k) for k in ks],
+            n_iterations=config.n_iterations,
+            resumed_ks=[],
+            pac_area={
+                int(k): float(v["pac_area"])
+                for k, v in self.cdf_at_K_data.items()
+            },
+            best_k=self.best_k_,
+            **self.metrics_,
+        )
         if self.plot_cdf:
             from consensus_clustering_tpu.utils.plotting import plot_cdf
 

@@ -127,6 +127,15 @@ def cmd_run(args):
             print(f"K={k} done ({done_count[0]}{total}), pac={pac:.5f}",
                   file=sys.stderr, flush=True)
 
+    if args.mode == "estimate" and store_matrices:
+        raise SystemExit(
+            "--mode estimate never materialises the consensus matrices "
+            "(that is the point); drop --plot-dir / --store-matrices on"
+        )
+    if args.n_pairs is not None and args.mode == "exact":
+        raise SystemExit(
+            "--n-pairs only applies with --mode estimate or auto"
+        )
     if args.adaptive is not None and not args.stream:
         raise SystemExit(
             "--adaptive needs --stream: early stopping is a property of "
@@ -164,6 +173,9 @@ def cmd_run(args):
         adaptive_tol=args.adaptive,
         adaptive_patience=args.adaptive_patience,
         adaptive_min_h=args.adaptive_min_h,
+        mode=args.mode,
+        n_pairs=args.n_pairs,
+        exact_best_k=args.exact_best_k,
     )
     t0 = time.perf_counter()
     cc.fit(x)
@@ -526,6 +538,24 @@ def main(argv=None):
     run.add_argument("--adaptive-min-h", type=int, default=0,
                      help="resample floor before an adaptive stop may "
                      "trigger")
+    run.add_argument("--mode", choices=["exact", "estimate", "auto"],
+                     default="exact",
+                     help="consensus execution mode: 'exact' (dense "
+                     "O(N^2) accumulators, the reference statistic), "
+                     "'estimate' (the sampled-pair estimator — O(M) "
+                     "state, PAC with a disclosed DKW error bound in "
+                     "metrics.estimator), or 'auto' (exact when the "
+                     "dense footprint fits the memory budget, estimate "
+                     "otherwise)")
+    run.add_argument("--n-pairs", type=int, default=None,
+                     help="pair-sample size for --mode estimate "
+                     "(default: 2^17 capped at the N(N-1)/2 pair "
+                     "population; more pairs = tighter bound)")
+    run.add_argument("--exact-best-k", action="store_true",
+                     help="with --mode estimate: recompute the chosen "
+                     "K's curves exactly via the row-tiled pass "
+                     "(O(H*N + tile*N) memory) so best-K reporting carries "
+                     "no estimation band")
     run.add_argument("--store-matrices", choices=["auto", "on", "off"],
                      default="auto",
                      help="keep Iij/Mij/Cij in results (auto: only when "

@@ -18,6 +18,34 @@ from consensus_clustering_tpu.ops.analysis import pac_indices
 from consensus_clustering_tpu.ops.resample import subsample_size
 
 
+#: The consensus execution modes every surface shares (api.py ``mode``,
+#: the serving ``config.mode`` key, ``cli run --mode``):
+#:
+#: - ``exact``    — dense integer accumulators, the reference statistic
+#:   bit for bit; O(N²) memory (the preflight 413s past the budget).
+#: - ``estimate`` — the sampled-pair estimator
+#:   (:mod:`consensus_clustering_tpu.estimator`): O(M) state, PAC/CDF
+#:   estimated from M uniform upper-triangle pairs with a disclosed
+#:   DKW error bound in the result payload.
+#: - ``auto``     — exact when the dense footprint fits the memory
+#:   budget, estimate otherwise; the resolver (api fit / serve
+#:   admission) records which way it went.  Resolved BEFORE any
+#:   fingerprint is taken, so persisted jobs always carry a concrete
+#:   mode.
+ESTIMATOR_MODES = ("exact", "estimate", "auto")
+
+
+def validate_mode(mode: str) -> str:
+    """Validate (and return) a consensus execution mode; shared by the
+    api constructor, the CLI, and the serving job-spec parser so all
+    three reject the same vocabulary the same way."""
+    if mode not in ESTIMATOR_MODES:
+        raise ValueError(
+            f"mode must be one of {list(ESTIMATOR_MODES)}, got {mode!r}"
+        )
+    return mode
+
+
 def autotune_stream_block(n_iterations: int) -> int:
     """Serving-side default H-block size: ``H // 8`` clamped to [16, 128].
 

@@ -1,0 +1,63 @@
+"""Sampled-pair consensus estimator: O(M) state instead of O(N²).
+
+The subsystem that breaks the dense accumulators' memory wall
+(``benchmarks/memory_scaling.py``; ROADMAP item "Sampled-pair /
+blocked consensus for N >= 10^5") and turns the serving preflight's
+413 into an admission path — see docs/ARCHITECTURE.md "Sampled-pair
+estimator" and docs/SERVING.md "The 413 -> mode=estimate admission
+path".
+
+- :mod:`.sampler` — deterministic, seeded, device-side uniform
+  upper-triangle pair draws (i.i.d. with replacement — the DKW
+  hypothesis).
+- :mod:`.bounds`  — stdlib-only DKW/Massart error bands (CDF sup-norm
+  and PAC), the ``n_pairs`` default, and the disclosure payload every
+  estimator result carries.
+- :mod:`.engine`  — the O(M) pair-count streaming engine: same shared
+  resample/label helpers as the dense engines (sampled-pair counts are
+  bit-exact matrix entries), same driver contract (H-agnostic block
+  program, adaptive early stop, block checkpointing with verified
+  resume, integrity sentinel, fault points).
+- :mod:`.tiled`   — row-tiled EXACT curves for one chosen K (the
+  best-K exactness refinement; O(H·N + tile_rows·N) peak memory).
+- :mod:`.validate` — the exact-vs-estimator gate: pair-exactness
+  (bit-identical counts) + bound coverage, committed-record shaped
+  like the ``adaptive_tol`` calibration gate (``estimator-smoke`` CI).
+
+PEP-562 lazy like :mod:`~consensus_clustering_tpu.autotune` and
+:mod:`~consensus_clustering_tpu.serve`: importing the package must not
+pull jax/numpy, so the no-dependency CLI paths (lint, serve-admin)
+keep their import-time pins.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "PairConsensusEngine": "consensus_clustering_tpu.estimator.engine",
+    "run_pair_estimate": "consensus_clustering_tpu.estimator.engine",
+    "verify_pair_state_frame": "consensus_clustering_tpu.estimator.engine",
+    "sample_pairs": "consensus_clustering_tpu.estimator.sampler",
+    "pair_key": "consensus_clustering_tpu.estimator.sampler",
+    "default_n_pairs": "consensus_clustering_tpu.estimator.bounds",
+    "pac_error_bound": "consensus_clustering_tpu.estimator.bounds",
+    "cdf_error_bound": "consensus_clustering_tpu.estimator.bounds",
+    "bound_disclosure": "consensus_clustering_tpu.estimator.bounds",
+    "exact_curves_for_k": "consensus_clustering_tpu.estimator.tiled",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

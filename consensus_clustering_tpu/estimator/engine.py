@@ -1,0 +1,755 @@
+"""Sampled-pair streaming consensus engine: O(M) state, any N.
+
+The dense engines (:mod:`~consensus_clustering_tpu.parallel.sweep`,
+:mod:`~consensus_clustering_tpu.parallel.streaming`) accumulate the
+full ``Mij``/``Iij`` count matrices — ``4·(nK+1)·N²`` bytes of int32
+that `benchmarks/memory_scaling.py` documents as THE memory wall and
+PR 6's preflight enforces by 413-ing jobs past ~N = 10^4.  PAC model
+selection never needed the matrix: it needs the CDF of the consensus
+values over the upper-triangle PAIR POPULATION, and a CDF is exactly
+the thing a uniform sample estimates with a distribution-free band
+(:mod:`~consensus_clustering_tpu.estimator.bounds`).
+
+This engine streams the SAME resample blocks as the dense streaming
+engine but accumulates counts for only ``M`` sampled pairs
+(:mod:`~consensus_clustering_tpu.estimator.sampler`):
+
+- **Pair-exact counts.**  The block draws its resample plan through
+  the shared :func:`~consensus_clustering_tpu.ops.resample.
+  resample_indices` (global-index key folding) and its labels through
+  the shared :func:`~consensus_clustering_tpu.parallel.sweep.
+  fit_resample_lanes` / :func:`~consensus_clustering_tpu.parallel.
+  sweep.resample_lane_keys`, so for a given (config, seed) every
+  sampled pair's ``mij``/``iij`` count equals the dense engine's
+  matrix entry BIT FOR BIT (tests/test_estimator.py gathers dense
+  entries at the sampled pairs and compares ints).  The only
+  approximation in the whole path is which pairs were sampled.
+- **O(M) state.**  ``state = {"mij": (nK, M) int32, "iij": (M,)
+  int32}`` — about a megabyte per K at the default M, where the dense
+  state is 40 GB per K at N = 10^5.  Per block the engine materialises
+  one (h_block, N) label scatter per K (megabytes), never anything
+  N×N — enforced by the ``estimator`` lint rule pack (JL009).
+- **Same driver contract.**  ``run()`` mirrors
+  :meth:`~consensus_clustering_tpu.parallel.streaming.StreamingSweep.
+  run`: H-agnostic block program (``h_start``/``h_total`` traced),
+  double-buffer-free simple loop (the state is tiny; there is no HBM
+  round-trip to hide), adaptive early stop on the PAC trajectory,
+  block callbacks, tracer spans, the ``accumulator`` corruption fault
+  point, an O(M) integrity sentinel, and block checkpointing through
+  the same :class:`~consensus_clustering_tpu.resilience.blocks.
+  StreamCheckpointer` ring — digest-verified resume included
+  (:func:`verify_pair_state_frame`), under its own fingerprint scheme
+  (:func:`~consensus_clustering_tpu.utils.checkpoint.
+  estimator_stream_fingerprint`) so estimator state can never resume a
+  dense sweep or vice versa.
+
+Mesh note: the engine runs single-device by design in this PR — the
+wall it removes is MEMORY, not FLOPs, and the clustering lanes (the
+FLOPs) already have their sharded home in the dense engines.  Sharding
+the lane work here pairs with ROADMAP item 1's packed masks.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # import cycle guard (resilience never imports us)
+    from consensus_clustering_tpu.resilience.blocks import StreamCheckpointer
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.estimator.bounds import (
+    DEFAULT_DELTA,
+    bound_disclosure,
+    default_n_pairs,
+)
+from consensus_clustering_tpu.estimator.sampler import pair_key, sample_pairs
+from consensus_clustering_tpu.models.protocol import JaxClusterer
+from consensus_clustering_tpu.ops.analysis import masked_histogram_counts
+from consensus_clustering_tpu.ops.resample import resample_indices
+from consensus_clustering_tpu.parallel.sweep import (
+    compiled_memory_stats,
+    fit_resample_lanes,
+    resample_lane_keys,
+)
+from consensus_clustering_tpu.resilience.faults import IntegrityError, faults
+from consensus_clustering_tpu.resilience.integrity import (
+    flip_array_bits,
+    frame_digest,
+)
+from consensus_clustering_tpu.utils.checkpoint import (
+    data_fingerprint,
+    estimator_stream_fingerprint,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def verify_pair_state_frame(
+    header: Dict[str, Any], arrays: Dict[str, Any]
+) -> Optional[str]:
+    """Reason a pair-engine checkpoint frame must be REFUSED, or None.
+
+    The estimator's spelling of :func:`~consensus_clustering_tpu.
+    resilience.integrity.verify_state_frame` — same two layers (the
+    semantic digest the writer embedded, then the count invariants on
+    the decoded state), shaped for (nK, M)/(M,) pair counts instead of
+    matrices: ``0 <= mij <= iij <= h_done`` elementwise.  No diagonal
+    or symmetry clause — pairs are strictly upper-triangle, so neither
+    exists here.
+    """
+    recorded = header.get("digest")
+    if recorded is not None:
+        fresh = frame_digest(arrays)
+        if fresh != recorded:
+            changed = sorted(
+                name
+                for name in set(fresh) | set(recorded)
+                if fresh.get(name) != recorded.get(name)
+            )
+            return f"digest mismatch on {changed}"
+    mij = arrays.get("state_mij")
+    iij = arrays.get("state_iij")
+    if mij is not None and iij is not None:
+        mij = np.asarray(mij)
+        iij = np.asarray(iij)
+        if (mij < 0).any() or (mij > iij[None, :]).any():
+            return "invariant violation: pair mij outside [0, iij]"
+        h_done = header.get("h_done")
+        if (iij < 0).any() or (
+            h_done is not None and (iij > int(h_done)).any()
+        ):
+            return "invariant violation: pair iij outside [0, h_done]"
+    return None
+
+
+def estimate_curves_from_pair_counts(
+    counts: np.ndarray,
+    m: int,
+    n: int,
+    pac_lo_idx: int,
+    pac_hi_idx: int,
+    parity_zeros: bool = True,
+):
+    """(hist, cdf, pac_area) estimates from per-K sampled-pair bin
+    counts — the host half of the estimator, mirroring
+    :func:`~consensus_clustering_tpu.ops.analysis.cdf_pac_from_counts`.
+
+    ``counts`` is (nK, bins) int over the M sampled pair values.  The
+    empirical pair CDF ``cumsum(counts)/M`` estimates the population
+    pair CDF; the parity-zeros bookkeeping (quirk Q6 — ``N(N+1)/2``
+    structural zeros over an N² denominator) is a deterministic affine
+    map applied exactly, like the dense path applies it after its
+    psum.  Curves return float32 (the dense engines' output dtype) and
+    ``pac_area`` is computed from the f32 CDF so the returned payload
+    is self-consistent (``cdf[hi-1] - cdf[lo]`` reproduces it).
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    bins = counts.shape[-1]
+    m = float(int(m))
+    n = int(n)
+    t = n * (n - 1) / 2.0
+    f_pairs = np.cumsum(counts, axis=-1) / m
+    est_counts = counts / m * t
+    if parity_zeros:
+        total = float(n) * float(n)
+        cdf = (t * f_pairs + n * (n + 1) / 2.0) / total
+        est_counts = est_counts.copy()
+        est_counts[..., 0] += n * (n + 1) / 2.0
+    else:
+        total = t
+        cdf = f_pairs
+    dbin = 1.0 / bins
+    hist = (est_counts / (total * dbin)).astype(np.float32)
+    cdf = cdf.astype(np.float32)
+    pac = cdf[..., pac_hi_idx - 1] - cdf[..., pac_lo_idx]
+    return hist, cdf, np.asarray(pac, dtype=np.float32)
+
+
+class PairConsensusEngine:
+    """One compiled pair-count block step plus its host driver.
+
+    Build once per (shape, config-minus-H, n_pairs) bucket and call
+    :meth:`run` for any ``n_iterations`` — the block program is
+    H-agnostic exactly like the dense streaming engine's, so the serve
+    executor caches warm instances under the same bucket discipline.
+    """
+
+    def __init__(
+        self,
+        clusterer: JaxClusterer,
+        config: SweepConfig,
+        n_pairs: Optional[int] = None,
+    ):
+        if config.stream_h_block is None:
+            raise ValueError(
+                "PairConsensusEngine needs SweepConfig.stream_h_block "
+                "(the resamples-per-block size)"
+            )
+        if config.store_matrices:
+            raise ValueError(
+                "the pair estimator never materialises matrices; pass "
+                "store_matrices=False (it has nothing N×N to store)"
+            )
+        self.config = config
+        self.clusterer = clusterer
+        n = config.n_samples
+        n_sub = config.n_sub
+        k_max = config.k_max
+        lo, hi = config.pac_idx
+        self.n_pairs = int(
+            n_pairs if n_pairs is not None else default_n_pairs(n)
+        )
+        if self.n_pairs < 1:
+            raise ValueError(f"n_pairs must be >= 1, got {self.n_pairs}")
+        self._hb = int(config.stream_h_block)
+        self._n_ks = len(config.k_values)
+        self._k_arr = jnp.asarray(config.k_values, jnp.int32)
+        m = self.n_pairs
+        hb = self._hb
+
+        def step(state, x, pair_i, pair_j, key, h_start, h_total):
+            """One H-block over the sampled pairs.
+
+            Resample draw, masking and label derivation are IDENTICAL
+            to the dense streaming engine's (shared helpers, global
+            resample indices), so the pair counts this accumulates are
+            the dense matrix entries at (pair_i, pair_j) — bit-exact.
+            Returns the new state plus per-K (bins,) histogram counts
+            of the M accumulated pair consensus values.
+            """
+            x = x.astype(jnp.dtype(config.dtype))
+            key_resample, key_cluster = jax.random.split(key)
+            block_rows = h_start + jnp.arange(hb, dtype=jnp.int32)
+            h_valid = block_rows < h_total
+            indices = resample_indices(
+                key_resample, n, hb, n_sub, h_start=h_start
+            )
+            indices = jnp.where(h_valid[:, None], indices, -1)
+            rows = jnp.arange(hb, dtype=jnp.int32)[:, None]
+            # Padding sentinels (-1) redirect to the out-of-bounds
+            # column n, which mode="drop" discards — the
+            # indicator_matrix rule.
+            safe_idx = jnp.where(indices >= 0, indices, n)
+            samp = (
+                jnp.zeros((hb, n), jnp.int32)
+                .at[rows, safe_idx]
+                .set(1, mode="drop")
+            )
+            cos = samp[:, pair_i] * samp[:, pair_j]  # (hb, M)
+            iij = state["iij"] + jnp.sum(cos, axis=0, dtype=jnp.int32)
+            x_sub = x[jnp.where(indices >= 0, indices, 0)]
+
+            def per_k(_, scanned):
+                k, mij_acc = scanned
+                keys = resample_lane_keys(
+                    config, key_cluster, k, block_rows
+                )
+                labels = fit_resample_lanes(
+                    clusterer, config, keys, x_sub, k, k_max
+                )
+                labels = jnp.where(h_valid[:, None], labels, -1)
+                # label+1 scatter: 0 = not sampled, >= 1 = cluster id.
+                labmat = (
+                    jnp.zeros((hb, n), jnp.int32)
+                    .at[rows, safe_idx]
+                    .set(labels + 1, mode="drop")
+                )
+                li = labmat[:, pair_i]
+                lj = labmat[:, pair_j]
+                co = ((li > 0) & (li == lj)).astype(jnp.int32)
+                mij_new = mij_acc + jnp.sum(co, axis=0, dtype=jnp.int32)
+                # Consensus at the sampled pairs — the dense
+                # consensus_matrix arithmetic verbatim (f32 divide,
+                # 1e-6 regulariser; no diagonal clause: pairs are
+                # strictly i < j).
+                cons = mij_new.astype(jnp.float32) / (
+                    iij.astype(jnp.float32) + 1e-6
+                )
+                counts = masked_histogram_counts(
+                    cons[None, :],
+                    jnp.ones((1, m), dtype=bool),
+                    config.bins,
+                )
+                return 0, {"mij": mij_new, "counts": counts}
+
+            _, out = jax.lax.scan(per_k, 0, (self._k_arr, state["mij"]))
+            return {"mij": out["mij"], "iij": iij}, out["counts"]
+
+        self._step = jax.jit(step)
+
+        def init_state_fn():
+            return {
+                "mij": jnp.zeros((self._n_ks, m), jnp.int32),
+                "iij": jnp.zeros((m,), jnp.int32),
+            }
+
+        self._init = jax.jit(init_state_fn)
+
+        def sample_fn(key):
+            return sample_pairs(key, n, m)
+
+        # Bound once here (the init_state_fn pattern): the jit cache
+        # lives on the instance, one compile serves every run's draw.
+        self._sample = jax.jit(sample_fn)
+        # O(M) invariant sentinel (the resilience.integrity pattern at
+        # pair shape): compiled lazily so every=0 never pays the trace.
+        self._sentinel = None
+        self._compiled_memory: Optional[Dict[str, int]] = None
+
+    # -- memory accounting -----------------------------------------------
+
+    def compiled_memory_stats(self) -> Dict[str, int]:
+        """XLA's static memory plan for the warm block step (AOT
+        lower+compile at the exact run() signature, memoized); {} when
+        the backend exposes no plan.  Same contract as the dense
+        engine's — the serve executor asks once per bucket."""
+        if self._compiled_memory is not None:
+            return dict(self._compiled_memory)
+        try:
+            cfg = self.config
+            m = self.n_pairs
+            state_struct = {
+                "mij": jax.ShapeDtypeStruct(
+                    (self._n_ks, m), jnp.int32
+                ),
+                "iij": jax.ShapeDtypeStruct((m,), jnp.int32),
+            }
+            x_struct = jax.ShapeDtypeStruct(
+                (cfg.n_samples, cfg.n_features), jnp.dtype(cfg.dtype)
+            )
+            pair_struct = jax.ShapeDtypeStruct((m,), jnp.int32)
+            lowered = self._step.lower(
+                state_struct, x_struct, pair_struct, pair_struct,
+                jax.random.PRNGKey(0), jnp.int32(0), jnp.int32(0),
+            )
+            self._compiled_memory = compiled_memory_stats(
+                lowered.compile()
+            )
+        except Exception as e:  # noqa: BLE001 — accounting is telemetry
+            logger.debug("compiled memory plan unavailable: %s", e)
+            self._compiled_memory = {}
+        return dict(self._compiled_memory)
+
+    # -- integrity -------------------------------------------------------
+
+    def _integrity_stats(self, state, h_seen: int):
+        if self._sentinel is None:
+
+            @jax.jit
+            def sentinel(state, h_seen):
+                mij = state["mij"]
+                iij = state["iij"]
+                range_bad = jnp.sum(
+                    ((mij < 0) | (mij > iij[None, :])).astype(jnp.int32)
+                )
+                bound_bad = jnp.sum(
+                    ((iij < 0) | (iij > h_seen)).astype(jnp.int32)
+                )
+                return {"range_bad": range_bad, "bound_bad": bound_bad}
+
+            self._sentinel = sentinel
+        return self._sentinel(state, jnp.int32(h_seen))
+
+    def _flip_state_bits(self, state, nbits: int, block: int):
+        """The ``accumulator`` bitflip fault at pair shape (test-path
+        only — reached when a fault plan armed the point)."""
+        mij = np.array(state["mij"])
+        flip_array_bits(mij, nbits, seed=block)
+        corrupted = dict(state)
+        corrupted["mij"] = jnp.asarray(mij)
+        return corrupted
+
+    # -- state -----------------------------------------------------------
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return self._init()
+
+    def pairs_for_seed(self, seed: int):
+        """The (pair_i, pair_j) sample for a run seed — deterministic,
+        device-resident; exposed for the validation harness and tests."""
+        return self._sample(pair_key(seed))
+
+    def warmup(self, x: Optional[np.ndarray] = None) -> float:
+        """Compile the block program (one all-masked block); returns
+        the wall-clock it took."""
+        cfg = self.config
+        if x is None:
+            x = np.zeros(
+                (cfg.n_samples, cfg.n_features), np.dtype(cfg.dtype)
+            )
+        xj = jnp.asarray(x, jnp.dtype(cfg.dtype))
+        t0 = time.perf_counter()
+        pair_i, pair_j = self.pairs_for_seed(0)
+        state = self.init_state()
+        state, counts = self._step(
+            state, xj, pair_i, pair_j, jax.random.PRNGKey(0),
+            jnp.int32(0), jnp.int32(0),
+        )
+        np.asarray(counts)  # completion barrier
+        del state
+        return time.perf_counter() - t0
+
+    # -- driver ----------------------------------------------------------
+
+    def run(
+        self,
+        x: np.ndarray,
+        seed: int,
+        n_iterations: int,
+        block_callback: Optional[
+            Callable[[int, int, List[float]], None]
+        ] = None,
+        adaptive_tol: Optional[float] = None,
+        adaptive_patience: Optional[int] = None,
+        adaptive_min_h: Optional[int] = None,
+        checkpointer: Optional["StreamCheckpointer"] = None,
+        integrity_check_every: Optional[int] = None,
+        tracer=None,
+        return_state: bool = False,
+    ) -> Dict[str, Any]:
+        """Stream the estimator; returns curves + stats, the dense
+        streaming engine's result schema plus an ``estimator`` block
+        (pair count, confidence, and the disclosed CDF/PAC error
+        bounds — :func:`~consensus_clustering_tpu.estimator.bounds.
+        bound_disclosure`).
+
+        The knob contract mirrors :meth:`~consensus_clustering_tpu.
+        parallel.streaming.StreamingSweep.run` — H and the adaptive
+        settings are runtime arguments of the warm engine; a
+        ``checkpointer`` makes the run preemption-safe at block
+        granularity under the estimator's own fingerprint scheme (same
+        (config, seed, data, H, knobs, n_pairs) resumes bit-identically
+        — the pair sample is a pure function of the seed, so it needs
+        no checkpointing of its own); ``integrity_check_every`` runs
+        the O(M) pair-count sentinel (collapsing to every-block under
+        adaptive early stop, the dense engine's rule, because any block
+        can become the answer).
+        """
+        if n_iterations < 1:
+            raise ValueError(
+                f"n_iterations must be >= 1, got {n_iterations}"
+            )
+        config = self.config
+        if adaptive_tol is None:
+            adaptive_tol = config.adaptive_tol
+        if adaptive_patience is None:
+            adaptive_patience = config.adaptive_patience
+        if adaptive_min_h is None:
+            adaptive_min_h = config.adaptive_min_h
+        if integrity_check_every is None:
+            integrity_check_every = config.integrity_check_every
+        integrity_check_every = int(integrity_check_every)
+        if integrity_check_every < 0:
+            raise ValueError(
+                f"integrity_check_every must be >= 0, got "
+                f"{integrity_check_every}"
+            )
+        adaptive = adaptive_tol is not None
+        lo, hi = config.pac_idx
+        n = config.n_samples
+        xj = jnp.asarray(x, jnp.dtype(config.dtype))
+        key = jax.random.PRNGKey(seed)
+        pair_i, pair_j = self.pairs_for_seed(seed)
+        h_total = jnp.int32(n_iterations)
+        n_blocks = -(-n_iterations // self._hb)
+
+        t0 = time.perf_counter()
+        trajectory: List[List[float]] = []
+        prev_pac: Optional[np.ndarray] = None
+        quiet = 0
+        stopped_early = False
+        result_curves: Optional[Dict[str, np.ndarray]] = None
+        h_effective = 0
+        start_block = 0
+        resumed_from_block = 0
+        resume_terminal = False
+        ckpt_fp = None
+        ckpt_writes_before = 0
+        state = None
+        if checkpointer is not None:
+            ckpt_fp = estimator_stream_fingerprint(
+                config, seed, data_fingerprint(np.asarray(x)),
+                n_pairs=self.n_pairs,
+                n_iterations=n_iterations,
+                adaptive_tol=adaptive_tol,
+                adaptive_patience=adaptive_patience,
+                adaptive_min_h=adaptive_min_h,
+            )
+            ckpt_writes_before = checkpointer.writes_total
+            t_resume = time.perf_counter()
+            resume = checkpointer.latest(
+                ckpt_fp, verify=verify_pair_state_frame
+            )
+            if resume is not None:
+                header, arrays = resume
+                state = {
+                    name: jnp.asarray(arrays[f"state_{name}"])
+                    for name in ("mij", "iij")
+                }
+                trajectory = [
+                    [float(v) for v in row]
+                    for row in header["trajectory"]
+                ]
+                if trajectory:
+                    prev_pac = np.asarray(
+                        trajectory[-1], dtype=np.float32
+                    )
+                quiet = int(header["quiet"])
+                h_effective = int(header["h_done"])
+                result_curves = {
+                    name[len("curve_"):]: arrays[name]
+                    for name in arrays
+                    if name.startswith("curve_")
+                }
+                start_block = int(header["block_index"]) + 1
+                resumed_from_block = start_block
+                checkpointer.resumes_total += 1
+                stopped_early = bool(header.get("stopped", False))
+                resume_terminal = (
+                    stopped_early or h_effective >= n_iterations
+                )
+                logger.info(
+                    "resuming pair estimator from checkpoint: block %d "
+                    "(h_done=%d of %d%s)",
+                    start_block - 1, h_effective, n_iterations,
+                    ", terminal" if resume_terminal else "",
+                )
+                if tracer is not None:
+                    tracer.record(
+                        "resume_restore",
+                        time.perf_counter() - t_resume,
+                        resumed_from_block=start_block,
+                        h_done=h_effective,
+                        terminal=resume_terminal,
+                    )
+        if state is None:
+            state = self.init_state()
+        integrity_checks = 0
+        last_eval_done = [time.perf_counter()]
+
+        def h_done(b: int) -> int:
+            return min((b + 1) * self._hb, n_iterations)
+
+        def check_due(b: int) -> bool:
+            if integrity_check_every <= 0:
+                return False
+            if adaptive:
+                # Any block can become the answer under adaptive early
+                # stop (the dense engine's rule).
+                return True
+            return (
+                b % integrity_check_every == integrity_check_every - 1
+                or b == n_blocks - 1
+            )
+
+        try:
+            for b in range(
+                start_block, 0 if resume_terminal else n_blocks
+            ):
+                faults.fire("block_start", index=b)
+                block_wall_start = last_eval_done[0]
+                state, counts = self._step(
+                    state, xj, pair_i, pair_j, key,
+                    jnp.int32(b * self._hb), h_total,
+                )
+                nbits = faults.corrupt("accumulator", index=b)
+                if nbits:
+                    state = self._flip_state_bits(state, nbits, b)
+                if check_due(b):
+                    # The np.asarray(counts) host copy below is the
+                    # block's completion barrier, and the h_block span
+                    # is the evaluate-to-evaluate wall BY DESIGN (the
+                    # dense engine's documented rule) — not isolated
+                    # device time.
+                    t_check = time.perf_counter()  # jaxlint: disable=JL007 -- barrier is the np.asarray(counts) copy below; spans are evaluate-to-evaluate walls by design
+                    integrity_checks += 1
+                    check = self._integrity_stats(state, h_done(b))
+                    bad = {
+                        name: int(v)
+                        for name, v in check.items()
+                        if int(v)
+                    }
+                    if tracer is not None:
+                        tracer.record(
+                            "integrity_check",
+                            time.perf_counter() - t_check,
+                            block=b, violations=len(bad),
+                        )
+                    if bad:
+                        raise IntegrityError(
+                            "accumulator",
+                            f"pair-count sentinel: block {b} state "
+                            f"violates the count invariants ({bad}) — "
+                            "corrupt accumulator; retry from the last "
+                            "verified checkpoint",
+                            block=b,
+                            details=bad,
+                            checks_run=integrity_checks,
+                        )
+                t_eval = time.perf_counter()
+                counts_host = np.asarray(counts)  # completion barrier
+                if tracer is not None:
+                    tracer.record(
+                        "host_evaluate",
+                        time.perf_counter() - t_eval,
+                        block=b,
+                    )
+                hist, cdf, pac = estimate_curves_from_pair_counts(
+                    counts_host, self.n_pairs, n, lo, hi,
+                    parity_zeros=config.parity_zeros,
+                )
+                result_curves = {
+                    "hist": hist, "cdf": cdf, "pac_area": pac,
+                }
+                h_effective = h_done(b)
+                trajectory.append([float(v) for v in pac])
+                if block_callback is not None:
+                    block_callback(b, h_effective, trajectory[-1])
+                stop = False
+                if adaptive:
+                    if prev_pac is not None:
+                        if (
+                            np.max(np.abs(pac - prev_pac))
+                            < adaptive_tol
+                        ):
+                            quiet += 1
+                        else:
+                            quiet = 0
+                    stop = (
+                        quiet >= adaptive_patience
+                        and h_effective >= adaptive_min_h
+                        and h_effective < n_iterations
+                    )
+                prev_pac = pac
+                if checkpointer is not None and checkpointer.due(
+                    b, n_blocks
+                ):
+                    arrays = {
+                        # O(M) host copies: no device-residency games
+                        # needed at this state size.
+                        f"state_{name}": np.asarray(v)
+                        for name, v in state.items()
+                    }
+                    arrays.update(
+                        {
+                            f"curve_{name}": v
+                            for name, v in result_curves.items()
+                        }
+                    )
+                    checkpointer.write_async(
+                        {
+                            "fingerprint": ckpt_fp,
+                            "block_index": int(b),
+                            "h_done": int(h_effective),
+                            "n_iterations": int(n_iterations),
+                            "trajectory": [
+                                list(row) for row in trajectory
+                            ],
+                            "quiet": int(quiet),
+                            "stopped": bool(stop),
+                            "written_at": round(time.time(), 3),
+                        },
+                        arrays,
+                    )
+                if tracer is not None:
+                    tracer.record(
+                        "h_block",
+                        time.perf_counter() - block_wall_start,
+                        block=b, h_done=h_effective,
+                    )
+                last_eval_done[0] = time.perf_counter()
+                if stop:
+                    stopped_early = True
+                    break
+        except BaseException as e:
+            try:
+                # Sentinel accounting rides the failure (the dense
+                # driver's rule): failed attempts' checks still count.
+                e.integrity_checks_run = integrity_checks
+            except Exception:  # noqa: BLE001 — never mask the failure
+                pass
+            raise
+        finally:
+            if checkpointer is not None:
+                checkpointer.flush()
+
+        out: Dict[str, Any] = dict(result_curves)
+        if return_state:
+            # The validation harness's hook: the final O(M) pair counts
+            # plus the pairs they belong to, for gather-and-compare
+            # against the dense engine's matrices (estimator/validate.py
+            # proves them bit-identical at exact-feasible shapes).
+            out["pair_state"] = {
+                "pair_i": np.asarray(pair_i),
+                "pair_j": np.asarray(pair_j),
+                "mij": np.asarray(state["mij"]),
+                "iij": np.asarray(state["iij"]),
+            }
+        del state
+        run_seconds = time.perf_counter() - t0
+        total_resamples = h_effective * self._n_ks
+
+        from consensus_clustering_tpu.utils.metrics import (
+            device_memory_stats,
+        )
+
+        out["streaming"] = {
+            "h_block": int(self._hb),
+            "h_block_padded": int(self._hb),
+            "h_requested": int(n_iterations),
+            "h_effective": int(h_effective),
+            "n_blocks_run": len(trajectory),
+            "stopped_early": stopped_early,
+            "pac_trajectory": trajectory,
+            "resumed_from_block": int(resumed_from_block),
+            "checkpoint_writes": (
+                checkpointer.writes_total - ckpt_writes_before
+                if checkpointer is not None else 0
+            ),
+            "integrity_checks": int(integrity_checks),
+            "integrity_check_every": int(integrity_check_every),
+        }
+        out["estimator"] = bound_disclosure(
+            self.n_pairs, n,
+            parity_zeros=config.parity_zeros,
+            delta=DEFAULT_DELTA,
+        )
+        out["timing"] = {
+            "run_seconds": run_seconds,
+            "resamples_per_second": total_resamples / max(
+                run_seconds, 1e-9
+            ),
+            "device_memory": device_memory_stats(),
+            "compiled_memory": dict(self._compiled_memory or {}),
+        }
+        return out
+
+
+def run_pair_estimate(
+    clusterer: JaxClusterer,
+    config: SweepConfig,
+    x: np.ndarray,
+    seed: int,
+    n_pairs: Optional[int] = None,
+    block_callback=None,
+    checkpointer: Optional["StreamCheckpointer"] = None,
+) -> Dict[str, Any]:
+    """Build, warm and drive a pair estimator; the estimator twin of
+    :func:`~consensus_clustering_tpu.parallel.streaming.
+    run_streaming_sweep` (``timing`` gains ``compile_seconds``)."""
+    engine = PairConsensusEngine(clusterer, config, n_pairs=n_pairs)
+    compile_seconds = engine.warmup(x)
+    engine.compiled_memory_stats()
+    out = engine.run(
+        x, seed, config.n_iterations,
+        block_callback=block_callback,
+        checkpointer=checkpointer,
+    )
+    out["timing"]["compile_seconds"] = compile_seconds
+    return out

@@ -1,0 +1,82 @@
+"""Deterministic device-side pair sampler for the consensus estimator.
+
+PAC model selection needs only the CDF of the consensus matrix's
+*upper triangle* — a population of ``T = N(N-1)/2`` pair values — not
+the matrix (Monti et al. 2003; Senbabaoglu et al. 2014).  This module
+draws the ``M`` pairs that population is estimated from:
+
+- **Uniform over unordered pairs, with replacement.**  Each draw picks
+  ``i ~ U[0, N)`` and an offset ``k ~ U[0, N-1)``, sets ``j = (i + 1 +
+  k) mod N`` — the classic rejection-free distinct-pair construction:
+  every ORDERED pair (i, j), i != j, has probability ``1/(N(N-1))``,
+  so every UNORDERED pair has exactly ``2/(N(N-1))`` and the returned
+  ``(min, max)`` draw is uniform over the upper triangle.  Sampling
+  WITH replacement is deliberate: it makes the M draws i.i.d. from the
+  pair population, which is exactly the hypothesis the DKW confidence
+  band (:mod:`~consensus_clustering_tpu.estimator.bounds`) needs —
+  without-replacement sampling would only tighten the bound, so the
+  disclosed band stays valid (conservative) either way.
+- **No int64 anywhere.**  ``T`` itself overflows int32 at N ~ 2^16.5
+  (5·10^9 pairs at N = 10^5), so the textbook "draw a linear index in
+  [0, T), invert the triangular number" construction needs 64-bit
+  arithmetic the TPU default config doesn't enable.  The offset
+  construction stays entirely in int32 for any N < 2^31.
+- **Deterministic and stream-isolated.**  The pair key derives from
+  the run seed through :func:`pair_key` — a ``fold_in`` with a tag no
+  other consumer uses — so pairs are a pure function of (seed, N, M),
+  bit-identical across runs, resumes and processes, and uncorrelated
+  with the resample plan and the clusterer init streams (which fold
+  the SAME root key with resample/cluster indices).
+
+All state downstream of this module is O(M): the engine
+(:mod:`~consensus_clustering_tpu.estimator.engine`) accumulates one
+co-membership count per (K, pair) and one co-sampling count per pair.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: fold_in tag for the pair-sampling stream ("pair" in ASCII).  The
+#: engine's resample/cluster streams fold the root key with small
+#: indices via jax.random.split + fold_in(i); this tag keeps the pair
+#: stream out of their way without a second seed knob.
+_PAIR_STREAM_TAG = 0x70616972
+
+
+def pair_key(seed: int) -> jax.Array:
+    """The PRNG key the pair sample derives from, for a run seed."""
+    return jax.random.fold_in(
+        jax.random.PRNGKey(int(seed)), _PAIR_STREAM_TAG
+    )
+
+
+def sample_pairs(
+    key: jax.Array, n: int, m: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Draw ``m`` i.i.d. uniform upper-triangle pairs of ``range(n)``.
+
+    Returns ``(pair_i, pair_j)`` int32 arrays of shape (m,) with
+    ``pair_i < pair_j`` elementwise.  Pure function of (key, n, m):
+    the determinism every resume/dedup property of the estimator rests
+    on (tests/test_estimator.py pins bit-identity across calls).
+    """
+    if n < 2:
+        raise ValueError(f"need n >= 2 to form a pair, got {n}")
+    if m < 1:
+        raise ValueError(f"need m >= 1 pairs, got {m}")
+    k_i, k_off = jax.random.split(key)
+    i = jax.random.randint(k_i, (m,), 0, n, dtype=jnp.int32)
+    off = jax.random.randint(k_off, (m,), 0, n - 1, dtype=jnp.int32)
+    j = (i + 1 + off) % n
+    return jnp.minimum(i, j), jnp.maximum(i, j)
+
+
+def n_pairs_total(n: int) -> int:
+    """``T = N(N-1)/2``, the upper-triangle pair population size
+    (Python int — exact at any N)."""
+    n = int(n)
+    return n * (n - 1) // 2

@@ -1,0 +1,219 @@
+"""Row-tiled EXACT consensus curves for one K — no N×N residency.
+
+The estimator's contract is "estimated PAC with a disclosed band";
+model selection then picks ``best_k``, and best-K *reporting* should
+not inherit the band when exactness is still affordable in TIME (it is
+never again affordable in MEMORY at N >= 10^5 — that is the wall the
+estimator removes).  This module recomputes the exact CDF/PAC for a
+single chosen K by streaming row tiles of the consensus matrix:
+
+1. **Collect once, O(H·n_sub).**  The per-resample subsample indices
+   and labels for the chosen K are computed blockwise through the SAME
+   shared helpers as every engine (``resample_indices`` global-index
+   folding, ``resample_lane_keys``/``fit_resample_lanes``), so they
+   are bit-identical to what the dense sweep would have clustered.
+2. **Tile, O(H·N + tile_rows·N) peak.**  For each row tile, the exact
+   ``Mij``/``Iij`` counts are one f32 indicator GEMM per cluster
+   ((R, H) × (H, N); 0/1 entries, partial sums <= H < 2^24, so the f32
+   accumulation is exact — the ops/resample.py argument), the tile's
+   consensus values bin into the shared f32 bin edges, and the tile is
+   DISCARDED.  Peak residency is the (H, N) label/sample indicators
+   (ONE cluster's indicator alive at a time — never K of them) plus
+   one (tile_rows, N) consensus block; with H ≪ N that is linear-in-N
+   where the dense path is quadratic — the whole point, and the
+   ``estimator`` lint pack holds this module to it too.
+
+Cost honesty: the FLOPs are still O(N²·H) — this is the exactness
+refinement for the FINAL chosen K (one K, one pass), not a way to run
+the whole sweep exactly.  The estimator answers "which K"; this
+answers "the chosen K's exact curve" at whatever N the time budget
+affords (tests pin bit-equality against the dense sweep at small N).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.protocol import JaxClusterer
+
+
+def collect_resample_labels(
+    clusterer: JaxClusterer,
+    config: SweepConfig,
+    x: np.ndarray,
+    seed: int,
+    k: int,
+    h_block: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(indices, labels) for ONE K over all H resamples — (H, n_sub)
+    int32 host arrays, computed blockwise with the shared engine
+    helpers so every draw and every label matches what any engine
+    derives for the same (config, seed).  Rows are GLOBAL resample
+    order; invalid entries (none at full H) would carry -1."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_clustering_tpu.ops.resample import resample_indices
+    from consensus_clustering_tpu.parallel.sweep import (
+        fit_resample_lanes,
+        resample_lane_keys,
+    )
+
+    n = config.n_samples
+    n_sub = config.n_sub
+    k_max = config.k_max
+    h_total = int(config.n_iterations)
+    hb = int(h_block or config.stream_h_block or max(1, h_total))
+
+    @jax.jit
+    def block(x, key, h_start):
+        x = x.astype(jnp.dtype(config.dtype))
+        key_resample, key_cluster = jax.random.split(key)
+        block_rows = h_start + jnp.arange(hb, dtype=jnp.int32)
+        h_valid = block_rows < jnp.int32(h_total)
+        indices = resample_indices(
+            key_resample, n, hb, n_sub, h_start=h_start
+        )
+        indices = jnp.where(h_valid[:, None], indices, -1)
+        x_sub = x[jnp.where(indices >= 0, indices, 0)]
+        keys = resample_lane_keys(
+            config, key_cluster, jnp.int32(k), block_rows
+        )
+        labels = fit_resample_lanes(
+            clusterer, config, keys, x_sub, jnp.int32(k), k_max
+        )
+        labels = jnp.where(h_valid[:, None], labels, -1)
+        return indices, labels
+
+    xj = jnp.asarray(x, jnp.dtype(config.dtype))
+    key = jax.random.PRNGKey(seed)
+    idx_blocks = []
+    lab_blocks = []
+    for h_start in range(0, h_total, hb):
+        indices, labels = block(xj, key, jnp.int32(h_start))
+        take = min(hb, h_total - h_start)
+        idx_blocks.append(np.asarray(indices)[:take])
+        lab_blocks.append(np.asarray(labels)[:take])
+    return (
+        np.concatenate(idx_blocks, axis=0).astype(np.int32),
+        np.concatenate(lab_blocks, axis=0).astype(np.int32),
+    )
+
+
+def _cdf_pac_from_counts_host(
+    counts: np.ndarray,
+    n: int,
+    pac_lo_idx: int,
+    pac_hi_idx: int,
+    parity_zeros: bool,
+) -> Dict[str, np.ndarray]:
+    """Host int64 mirror of :func:`~consensus_clustering_tpu.ops.
+    analysis.cdf_pac_from_counts` — same arithmetic, but the raw bin
+    counts reach N² ~ 10^10 at the shapes this module exists for,
+    past int32 (the device twin never runs there)."""
+    counts = np.asarray(counts, dtype=np.int64).copy()
+    bins = counts.shape[0]
+    if parity_zeros:
+        counts[0] += n * (n + 1) // 2
+        total = float(n) * float(n)
+    else:
+        total = float(n) * (n - 1) / 2.0
+    dbin = 1.0 / bins
+    hist = (counts.astype(np.float32) / np.float32(total * dbin))
+    cdf = (np.cumsum(counts).astype(np.float32) / np.float32(total))
+    pac = np.float32(cdf[pac_hi_idx - 1] - cdf[pac_lo_idx])
+    return {"hist": hist, "cdf": cdf, "pac_area": pac}
+
+
+def tiled_exact_curves(
+    indices: np.ndarray,
+    labels: np.ndarray,
+    n: int,
+    bins: int,
+    pac_lo_idx: int,
+    pac_hi_idx: int,
+    parity_zeros: bool = True,
+    tile_rows: int = 2048,
+) -> Dict[str, np.ndarray]:
+    """Exact (hist, cdf, pac_area) for one K from its per-resample
+    (indices, labels), streaming (tile_rows, N) consensus tiles.
+
+    Counts are exact integers (0/1 indicator GEMMs, f32 accumulation
+    below 2^24) and the consensus/bin arithmetic mirrors the device
+    path (f32 divide with the 1e-6 regulariser, f32 bin edges,
+    last-bin-right-closed), so at shapes where the dense sweep still
+    runs, the curves are bit-identical to its output
+    (tests/test_estimator.py).
+    """
+    indices = np.asarray(indices)
+    labels = np.asarray(labels)
+    h = indices.shape[0]
+    if h >= 2**24:
+        raise ValueError(
+            f"H={h} exceeds the f32-exact indicator GEMM range (2^24)"
+        )
+    if tile_rows < 1:
+        raise ValueError(f"tile_rows must be >= 1, got {tile_rows}")
+    valid = indices >= 0
+    r_idx, c_idx = np.nonzero(valid)
+    # label+1 scatter: 0 = not sampled; indices are unique per row (a
+    # permutation slice), so plain assignment cannot collide.
+    labmat = np.zeros((h, n), dtype=np.int32)
+    labmat[r_idx, indices[r_idx, c_idx]] = labels[r_idx, c_idx] + 1
+    samp = (labmat > 0).astype(np.float32)
+    k_ids = np.unique(labmat[labmat > 0])
+
+    edges = np.linspace(0.0, 1.0, bins + 1).astype(np.float32)
+    counts = np.zeros(bins, dtype=np.int64)
+    cols = np.arange(n, dtype=np.int64)[None, :]
+    for r0 in range(0, n, tile_rows):
+        r1 = min(n, r0 + tile_rows)
+        iij_tile = samp[:, r0:r1].T @ samp  # (R, N), exact ints in f32
+        mij_tile = np.zeros_like(iij_tile)
+        for c in k_ids:
+            # ONE (H, N) indicator alive at a time: materialising all
+            # K of them up front would make the peak O(K·H·N) — at the
+            # very N this refinement targets, that is host OOM, not a
+            # constant factor.  Rebuilding per (tile, cluster) costs
+            # O(H·N) elementwise work per GEMM of O(R·H·N) — noise.
+            onehot = (labmat == c).astype(np.float32)
+            mij_tile += onehot[:, r0:r1].T @ onehot
+            del onehot
+        cons = mij_tile / (iij_tile + np.float32(1e-6))
+        # Strict upper triangle in GLOBAL coordinates (the diagonal is
+        # excluded, so the dense path's forced diag=1.0 never enters).
+        mask = cols > np.arange(r0, r1, dtype=np.int64)[:, None]
+        vals = cons[mask]
+        # searchsorted against the f32 edges == the device path's
+        # per-bin edge comparisons (same f32 operands, same ordering);
+        # clip folds the right-closed last bin (v == 1.0) back in.
+        idx = np.clip(
+            np.searchsorted(edges, vals, side="right") - 1, 0, bins - 1
+        )
+        counts += np.bincount(idx, minlength=bins).astype(np.int64)
+    return _cdf_pac_from_counts_host(
+        counts, n, pac_lo_idx, pac_hi_idx, parity_zeros
+    )
+
+
+def exact_curves_for_k(
+    clusterer: JaxClusterer,
+    config: SweepConfig,
+    x: np.ndarray,
+    seed: int,
+    k: int,
+    tile_rows: int = 2048,
+) -> Dict[str, np.ndarray]:
+    """Collect labels for one K and stream the tiled exact curves —
+    the estimator's best-K exactness refinement, end to end."""
+    indices, labels = collect_resample_labels(
+        clusterer, config, x, seed, k
+    )
+    lo, hi = config.pac_idx
+    return tiled_exact_curves(
+        indices, labels, config.n_samples, config.bins, lo, hi,
+        parity_zeros=config.parity_zeros, tile_rows=tile_rows,
+    )

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Set, Type
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Type
 
 from consensus_clustering_tpu.lint.findings import Finding
 
@@ -385,6 +385,34 @@ def tainted_names(ctx: ModuleContext, func: FunctionInfo) -> Set[str]:
                 for t in targets:
                     tainted |= assigned_names(t)
     return tainted
+
+
+# -- rule packs -------------------------------------------------------------
+
+#: Directory-scoped rule packs: rules that guard an INVARIANT OF ONE
+#: SUBSYSTEM rather than a universal JAX hazard.  A pack's rules check
+#: :func:`in_pack_scope` themselves (the runner lints whole trees, so
+#: scoping lives in the rule) and this table is the one place the
+#: pack -> rules mapping is registered — docs/LINT.md renders it, and
+#: tests/test_lint.py asserts every packed rule id exists.
+#:
+#: ``estimator``: the sampled-pair estimator's whole reason to exist
+#: is O(M) state — a dense N×N allocation inside
+#: ``consensus_clustering_tpu/estimator/`` silently re-erects the
+#: memory wall the subsystem removes, which no unit test at small N
+#: would ever notice.
+RULE_PACKS: Dict[str, Tuple[str, ...]] = {
+    "estimator": ("JL009",),
+}
+
+
+def in_pack_scope(path: str, pack: str) -> bool:
+    """Whether a file path lies inside a pack's subsystem directory
+    (any path component equal to the pack name — matching works for
+    repo-relative and absolute spellings alike)."""
+    import re as _re
+
+    return pack in _re.split(r"[\\/]+", path)
 
 
 # -- rule registry ----------------------------------------------------------

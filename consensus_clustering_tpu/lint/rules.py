@@ -26,6 +26,7 @@ from consensus_clustering_tpu.lint.registry import (
     Rule,
     assigned_names,
     function_params,
+    in_pack_scope,
     register,
     tainted_names,
     walk_in_order,
@@ -926,3 +927,90 @@ class ShardMapAxisMismatch(Rule):
                 for s in self._spec_strings(node, consts):
                     out.append((s, node))
         return out
+
+
+# ---------------------------------------------------------------------------
+# The `estimator` rule pack (registry.RULE_PACKS): subsystem-invariant
+# rules scoped to consensus_clustering_tpu/estimator/.
+
+# Array allocators whose shape argument JL009 inspects.
+_ALLOCATOR_CALLS = frozenset({
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty",
+    "jax.numpy.full", "jax.numpy.zeros_like",
+    "numpy.zeros", "numpy.ones", "numpy.empty", "numpy.full",
+})
+
+# Dense-matrix builders from the exact engines: any call to one of
+# these inside estimator/ materialises (a row block of) an N x N
+# object, which is exactly what the subsystem exists to never do.
+_DENSE_BUILDERS = frozenset({
+    "consensus_clustering_tpu.ops.coassoc.coassociation_counts",
+    "consensus_clustering_tpu.ops.resample.cosample_counts",
+    "consensus_clustering_tpu.ops.resample.indicator_matrix",
+    "consensus_clustering_tpu.ops.analysis.consensus_matrix",
+    "coassociation_counts", "cosample_counts", "indicator_matrix",
+    "consensus_matrix",
+})
+
+
+@register
+class EstimatorDenseAlloc(Rule):
+    id = "JL009"
+    name = "estimator-dense-alloc"
+    summary = (
+        "dense N x N allocation (or dense-builder call) inside "
+        "estimator/: silently re-erects the O(N^2) memory wall the "
+        "sampled-pair subsystem exists to remove"
+    )
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        if not in_pack_scope(ctx.path, "estimator"):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.resolve_call(node)
+            if qual is None:
+                continue
+            if qual in _DENSE_BUILDERS:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"{qual.rsplit('.', 1)[-1]}() builds (a row block "
+                    "of) a dense N x N matrix — estimator/ code must "
+                    "stay O(M); gather per-pair values instead "
+                    "(docs/LINT.md JL009)",
+                ))
+                continue
+            if qual in _ALLOCATOR_CALLS and self._square_shape(node):
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "allocation with a repeated symbolic dimension "
+                    "(shape like (n, n)) inside estimator/ — the "
+                    "subsystem's contract is O(M) state, never "
+                    "O(N^2); if the repeated dimension is not N, "
+                    "rename one of them or suppress with a reason "
+                    "(docs/LINT.md JL009)",
+                ))
+        return findings
+
+    @staticmethod
+    def _square_shape(call: ast.Call) -> bool:
+        """Whether the allocator's shape argument repeats the SAME
+        non-constant expression in two dimensions — the (n, n) /
+        (n_pad, n_pad) smell.  Constant repeats like (20, 20) are
+        fine (bins-sized temporaries), and unequal symbolic dims like
+        (h_block, n) are the subsystem's bread and butter."""
+        shape = None
+        if call.args:
+            shape = call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "shape":
+                shape = kw.value
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return False
+        rendered = [
+            ast.dump(e) for e in shape.elts
+            if not isinstance(e, ast.Constant)
+        ]
+        return len(rendered) != len(set(rendered))

@@ -47,6 +47,7 @@ _EXPORTS = {
     "JobStore": "consensus_clustering_tpu.serve.jobstore",
     "PreflightReject": "consensus_clustering_tpu.serve.preflight",
     "estimate_job_bytes": "consensus_clustering_tpu.serve.preflight",
+    "estimate_estimator_bytes": "consensus_clustering_tpu.serve.preflight",
     "JobTimeout": "consensus_clustering_tpu.serve.scheduler",
     "QueueFull": "consensus_clustering_tpu.serve.scheduler",
     "QueueShed": "consensus_clustering_tpu.serve.scheduler",

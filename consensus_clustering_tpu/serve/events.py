@@ -49,6 +49,12 @@ Hostile-path events (docs/SERVING.md "Overload & wedge runbook"):
 - ``job_shed``        — admission refused by the overload shed policy
   (fingerprint, priority, reason, queue_depth, worker_id); HTTP 429 +
   Retry-After
+- ``estimator_selected`` — a ``mode=auto`` admission resolved onto the
+  sampled-pair estimator because only its O(M) footprint fit the
+  memory budget (shape, exact_bytes, estimator_bytes, budget_bytes,
+  n_pairs, pac_error_bound, worker_id); the job runs in estimate mode
+  and its result carries the disclosed error bound — docs/SERVING.md
+  "The 413 -> mode=estimate admission path"
 
 Multi-worker lease events (docs/SERVING.md "Multi-worker runbook"):
 

@@ -62,7 +62,7 @@ _CONFIG_KEYS = frozenset(
         "clusterer_options", "bins", "pac_interval", "parity_zeros",
         "analysis", "delta_k_threshold", "dtype", "chunk_size",
         "stream_h_block", "adaptive_tol", "adaptive_patience",
-        "adaptive_min_h", "priority",
+        "adaptive_min_h", "priority", "mode", "n_pairs",
     }
 )
 
@@ -135,6 +135,19 @@ class JobSpec:
     # hint, never part of the result: excluded from the fingerprint (a
     # resubmission at another priority must dedup) and from the bucket.
     priority: str = "normal"
+    # Consensus execution mode (config.ESTIMATOR_MODES): "exact" (the
+    # dense engine), "estimate" (the sampled-pair estimator —
+    # consensus_clustering_tpu.estimator — O(M) state, disclosed PAC
+    # error bound), or "auto" (resolved at admission against the
+    # memory budget; a persisted spec always carries the CONCRETE mode
+    # — the scheduler resolves before fingerprinting, so identity and
+    # dedup are never budget-dependent after the fact).  Both mode and
+    # n_pairs change the statistic, so they stay in the fingerprint
+    # AND the bucket (they shape the compiled program).
+    mode: str = "exact"
+    # Pair-sample size for estimate mode (None: the deterministic
+    # default, estimator.bounds.default_n_pairs(N)).
+    n_pairs: Optional[int] = None
 
     def fingerprint_payload(self) -> Dict[str, Any]:
         """The JSON payload hashed into the job fingerprint.
@@ -187,6 +200,12 @@ class JobSpec:
             adaptive_tol=payload.get("adaptive_tol"),
             adaptive_patience=int(payload["adaptive_patience"]),
             adaptive_min_h=int(payload["adaptive_min_h"]),
+            # Pre-estimator payloads (old stores) load as exact jobs.
+            mode=payload.get("mode", "exact"),
+            n_pairs=(
+                None if payload.get("n_pairs") is None
+                else int(payload["n_pairs"])
+            ),
         )
 
     def bucket(self, n: int, d: int, h_block: Optional[int] = None) -> str:
@@ -355,6 +374,30 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
             f"config.priority must be one of {list(PRIORITIES)}, got "
             f"{priority!r}"
         )
+    from consensus_clustering_tpu.config import ESTIMATOR_MODES
+
+    mode = cfg.get("mode", "exact")
+    if mode not in ESTIMATOR_MODES:
+        raise JobSpecError(
+            f"config.mode must be one of {list(ESTIMATOR_MODES)}, got "
+            f"{mode!r}"
+        )
+    n_pairs = cfg.get("n_pairs")
+    if n_pairs is not None:
+        if mode == "exact":
+            raise JobSpecError(
+                "config.n_pairs only applies to mode 'estimate' or "
+                "'auto' (the exact engine has no pair sample)"
+            )
+        if (
+            not isinstance(n_pairs, int)
+            or isinstance(n_pairs, bool)
+            or not 16 <= n_pairs <= 2**24
+        ):
+            raise JobSpecError(
+                f"config.n_pairs must be an integer in [16, {2**24}], "
+                f"got {n_pairs!r}"
+            )
     spec = JobSpec(
         k_values=tuple(int(k) for k in k_values),
         n_iterations=_int("iterations", 25, 2, 100_000),
@@ -376,6 +419,8 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         adaptive_patience=_int("adaptive_patience", 2, 1, 1000),
         adaptive_min_h=_int("adaptive_min_h", 0, 0, 100_000),
         priority=priority,
+        mode=mode,
+        n_pairs=n_pairs,
     )
     return spec, x
 
@@ -486,6 +531,13 @@ class SweepExecutor:
         self.executable_cache_misses = 0
         self.h_requested_total = 0
         self.h_effective_total = 0
+        # Sampled-pair estimator accounting (docs/SERVING.md "The 413
+        # -> mode=estimate admission path"): successful estimate-mode
+        # executions, and the cumulative pair count they sampled (the
+        # /metrics pair-count gauge feed — pairs ARE the estimator's
+        # working-set unit the way resamples are the sweep's).
+        self.estimator_runs_total = 0
+        self.estimator_pairs_total = 0
         self.checkpoint_writes_total = 0
         self.checkpoint_resume_total = 0
         # Generations the verified-resume gate REFUSED (digest mismatch
@@ -653,15 +705,30 @@ class SweepExecutor:
                 with self._lock:
                     self.executable_cache_hits += 1
                 return hit, 0.0, True, resolution
-            from consensus_clustering_tpu.parallel.streaming import (
-                StreamingSweep,
-            )
-
             t0 = time.perf_counter()
-            engine = StreamingSweep(
-                self._clusterer_for(spec),
-                self._config_for(spec, n, d, resolution.value),
-            )
+            if spec.mode == "estimate":
+                # The O(M) sampled-pair engine (consensus_clustering_
+                # tpu.estimator): same bucket discipline — mode and
+                # n_pairs are in the bucket string, so estimator and
+                # dense engines never collide in this cache.
+                from consensus_clustering_tpu.estimator.engine import (
+                    PairConsensusEngine,
+                )
+
+                engine = PairConsensusEngine(
+                    self._clusterer_for(spec),
+                    self._config_for(spec, n, d, resolution.value),
+                    n_pairs=spec.n_pairs,
+                )
+            else:
+                from consensus_clustering_tpu.parallel.streaming import (
+                    StreamingSweep,
+                )
+
+                engine = StreamingSweep(
+                    self._clusterer_for(spec),
+                    self._config_for(spec, n, d, resolution.value),
+                )
             # warmup() runs one all-masked block on zeros: trace + XLA
             # compile + a trivial execution, the cheapest way to
             # populate the engine's jit cache with the exact program
@@ -885,8 +952,17 @@ class SweepExecutor:
         from consensus_clustering_tpu.autotune.store import shape_bucket
 
         drift_bucket = shape_bucket(n, d, spec.n_iterations, spec.k_values)
+        if spec.mode == "estimate":
+            # Estimate-mode traffic gets its own ledger bucket: its
+            # throughput anchors and its preflight model are DIFFERENT
+            # quantities from the dense engine's at the same shape, and
+            # sharing the key would corrupt the exact gate's correction
+            # EWMA and fire false drift against dense calibration.
+            drift_bucket = f"{drift_bucket}-estimate"
         calibrated_rate = None
-        if resolution.provenance == PROVENANCE_CALIBRATED and (
+        if spec.mode != "estimate" and (
+            resolution.provenance == PROVENANCE_CALIBRATED
+        ) and (
             resolution.record or {}
         ).get("rate"):
             try:
@@ -1023,6 +1099,7 @@ class SweepExecutor:
         # per-bucket accountant, whose correction flows back into the
         # admission 413 gate, and disclosed per result below.
         from consensus_clustering_tpu.serve.preflight import (
+            estimate_estimator_bytes,
             estimate_job_bytes,
         )
 
@@ -1036,13 +1113,28 @@ class SweepExecutor:
         else:
             mem_after = {}
             compiled_mem = {}
-        estimate = estimate_job_bytes(
-            n, d, spec.k_values,
-            dtype=spec.dtype,
-            h_block=int(resolution.value),
-            subsampling=spec.subsampling,
-            checkpoints=checkpointer is not None,
-        )
+        if spec.mode == "estimate":
+            # The model the admission gate priced THIS job with: the
+            # estimator's O(M) footprint, not the dense O(N²) one —
+            # the accountant's accuracy judgement must compare like
+            # with like or every estimate-mode job would read as a
+            # massive model over-count and pollute the correction EWMA.
+            estimate = estimate_estimator_bytes(
+                n, d, spec.k_values,
+                n_pairs=spec.n_pairs,
+                dtype=spec.dtype,
+                h_block=int(resolution.value),
+                subsampling=spec.subsampling,
+                checkpoints=checkpointer is not None,
+            )
+        else:
+            estimate = estimate_job_bytes(
+                n, d, spec.k_values,
+                dtype=spec.dtype,
+                h_block=int(resolution.value),
+                subsampling=spec.subsampling,
+                checkpoints=checkpointer is not None,
+            )
         # High-water minus occupancy at start, attributable to THIS
         # attempt only when the high-water advanced during it — a
         # masked reading (an earlier larger job's peak) is disclosed
@@ -1076,6 +1168,14 @@ class SweepExecutor:
             self.autotune_provenance[resolution.provenance] = (
                 self.autotune_provenance.get(resolution.provenance, 0) + 1
             )
+            if spec.mode == "estimate":
+                # Estimator accounting, successful executions only
+                # like the H totals: runs, and the cumulative pair
+                # count (the /metrics pair gauge).
+                self.estimator_runs_total += 1
+                self.estimator_pairs_total += int(
+                    host["estimator"]["n_pairs"]
+                )
 
         ks = list(spec.k_values)
         pac = [float(v) for v in host["pac_area"]]
@@ -1106,11 +1206,29 @@ class SweepExecutor:
             "analysis": spec.analysis,
             "h_effective": int(streaming["h_effective"]),
         }
+        if spec.mode == "estimate":
+            # Mode and pair count are part of WHAT was computed — a
+            # resumed estimate must reproduce both (exact-mode
+            # fingerprints keep their historical field set).
+            semantic["mode"] = "estimate"
+            semantic["n_pairs"] = int(host["estimator"]["n_pairs"])
         result_fingerprint = hashlib.sha256(
             json.dumps(semantic, sort_keys=True).encode()
         ).hexdigest()[:16]
+        result_mode = (
+            "estimate" if spec.mode == "estimate" else "exact"
+        )
         return {
             **semantic,
+            # Which engine produced this result — "exact" or
+            # "estimate"; estimate results ALSO carry the "estimator"
+            # error-bound block (never an estimated PAC without its
+            # band in the same payload).
+            "mode": result_mode,
+            **(
+                {"estimator": dict(host["estimator"])}
+                if spec.mode == "estimate" else {}
+            ),
             "backend": self.backend(),
             "result_fingerprint": result_fingerprint,
             # How the block size was chosen (ROADMAP's never-silent
@@ -1132,12 +1250,12 @@ class SweepExecutor:
             # temps being the part the model ignores).
             "memory": {
                 "estimated_bytes": int(estimate["total_bytes"]),
+                # The gating model's breakdown — keys differ by mode
+                # (the estimator model has pair terms, no N² workspace).
                 "estimate": {
-                    key: estimate[key]
-                    for key in (
-                        "state_bytes", "pinned_state_generations",
-                        "workspace_bytes", "data_bytes", "lane_bytes",
-                    )
+                    key: value
+                    for key, value in estimate.items()
+                    if key not in ("total_bytes", "model")
                 },
                 "compiled": compiled_mem,
                 "device_before": mem_before,

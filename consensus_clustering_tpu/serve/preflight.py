@@ -102,6 +102,66 @@ def estimate_job_bytes(
     }
 
 
+def estimate_estimator_bytes(
+    n: int,
+    d: int,
+    k_values: Sequence[int],
+    n_pairs: Optional[int] = None,
+    dtype: str = "float32",
+    h_block: int = 16,
+    subsampling: float = 0.8,
+    checkpoints: bool = True,
+) -> Dict[str, Any]:
+    """Estimated device footprint of the SAMPLED-PAIR estimator for the
+    same job — the O(M) twin of :func:`estimate_job_bytes`, and the
+    number the 413 admission path discloses so a client can decide to
+    resubmit with ``mode=estimate`` without a second round-trip.
+
+    The model mirrors ``estimator/engine.py``'s block step: per-K pair
+    counts (``4·(nK+1)·M`` — state, the only thing that persists),
+    the pair index arrays, the per-block (h_block, N) label/sample
+    scatters (the ONLY N-proportional term, linear not quadratic),
+    the per-block (h_block, M) gather workspace, plus the same data +
+    clustering-lane terms as the exact model (the lanes are shared
+    code and dominate the estimator's actual footprint at large N).
+    Monotonic in N, M, |K| and h_block by construction.
+    """
+    from consensus_clustering_tpu.estimator.bounds import (
+        default_n_pairs,  # stdlib-only module: safe at admission time
+    )
+
+    n = int(n)
+    nk = len(tuple(k_values))
+    k_max = max(int(k) for k in k_values)
+    itemsize = 8 if dtype == "float64" else 4
+    n_sub = max(1, int(round(n * float(subsampling))))
+    m = int(n_pairs) if n_pairs else default_n_pairs(n)
+
+    state = 4 * (nk + 1) * m
+    pin = 1 + (_CHECKPOINT_PIN_GENERATIONS if checkpoints else 0)
+    pairs = 2 * 4 * m
+    # labmat + sampled-indicator scatters, int32, doubled for XLA temps.
+    scatter = 2 * int(h_block) * n * (4 + 4)
+    # li/lj gathers + the co-membership comparison, per block.
+    pair_workspace = 12 * int(h_block) * m
+    data = n * d * itemsize
+    lanes = 2 * int(h_block) * n_sub * (d + k_max) * itemsize
+    total = state * pin + pairs + scatter + pair_workspace + data + lanes
+    return {
+        "state_bytes": int(state),
+        "pinned_state_generations": int(pin),
+        "pair_bytes": int(pairs),
+        "scatter_bytes": int(scatter),
+        "pair_workspace_bytes": int(pair_workspace),
+        "data_bytes": int(data),
+        "lane_bytes": int(lanes),
+        "n_pairs": int(m),
+        "total_bytes": int(total),
+        "model": "O(M) pair-count state + per-block (h_block, N) "
+        "scatters + data + clustering lanes; see serve/preflight.py",
+    }
+
+
 def resolve_memory_budget(explicit: Optional[int] = None) -> Optional[int]:
     """The budget the preflight gate compares against, in bytes.
 
@@ -138,30 +198,64 @@ def resolve_memory_budget(explicit: Optional[int] = None) -> Optional[int]:
 
 
 def check_admission(
-    estimate: Dict[str, Any], budget_bytes: int, shape: Sequence[int]
+    estimate: Dict[str, Any],
+    budget_bytes: int,
+    shape: Sequence[int],
+    estimator: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Raise :class:`PreflightReject` when the estimate exceeds the
     budget; no-op otherwise.  Split from the estimate so the scheduler
-    can count/emit on the reject path with the payload in hand."""
+    can count/emit on the reject path with the payload in hand.
+
+    ``estimator`` (the scheduler passes it for exact/auto-mode jobs)
+    is the sampled-pair admission path's disclosure — the estimator's
+    own predicted footprint, pair count and PAC error bound — attached
+    to the 413 body so the refusal carries the resubmission decision's
+    whole basis: a client reads one response and either shrinks the
+    job or retries with ``config.mode = "estimate"``, no second
+    round-trip (docs/SERVING.md "The 413 -> mode=estimate admission
+    path").
+    """
     total = int(estimate["total_bytes"])
     if total <= budget_bytes:
         return
-    raise PreflightReject(
-        {
-            "error": (
-                f"memory preflight: job at shape {list(shape)} needs an "
-                f"estimated {total} bytes but the backend budget is "
-                f"{budget_bytes} bytes — admitting it would OOM every "
-                "in-flight job"
-            ),
-            "estimated_bytes": total,
-            "budget_bytes": int(budget_bytes),
-            "estimate": dict(estimate),
-            "hint": (
-                "shrink N (the N² accumulator term dominates), the K "
-                "list, or stream_h_block; or raise the budget "
-                "(--memory-budget / CCTPU_MEMORY_BUDGET) if the model "
-                "is wrong for your backend"
-            ),
-        }
-    )
+    if "n_pairs" in estimate:
+        # The gating model is the estimator's O(M) one — there is no
+        # N² term to shrink, and pointing at the wrong knobs would
+        # have the operator tuning parameters this model ignores.
+        hint = (
+            "shrink n_pairs (the O(M) pair-count state with its "
+            "checkpoint pinning dominates this model), stream_h_block "
+            "or the K list; or raise the budget (--memory-budget / "
+            "CCTPU_MEMORY_BUDGET) if the model is wrong for your "
+            "backend"
+        )
+    else:
+        hint = (
+            "shrink N (the N² accumulator term dominates), the K "
+            "list, or stream_h_block; or raise the budget "
+            "(--memory-budget / CCTPU_MEMORY_BUDGET) if the model "
+            "is wrong for your backend"
+        )
+    if estimator is not None and estimator.get("fits_budget"):
+        hint = (
+            "resubmit with config.mode = 'estimate' (or 'auto'): the "
+            "sampled-pair estimator fits this budget and returns PAC "
+            "with the disclosed error bound in the 'estimator' field "
+            "— or " + hint
+        )
+    payload = {
+        "error": (
+            f"memory preflight: job at shape {list(shape)} needs an "
+            f"estimated {total} bytes but the backend budget is "
+            f"{budget_bytes} bytes — admitting it would OOM every "
+            "in-flight job"
+        ),
+        "estimated_bytes": total,
+        "budget_bytes": int(budget_bytes),
+        "estimate": dict(estimate),
+        "hint": hint,
+    }
+    if estimator is not None:
+        payload["estimator"] = dict(estimator)
+    raise PreflightReject(payload)

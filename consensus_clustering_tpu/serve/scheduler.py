@@ -80,6 +80,7 @@ every transition, so ``GET /jobs/<id>`` survives a restart.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import queue
@@ -118,6 +119,7 @@ from consensus_clustering_tpu.serve.leases import (
 from consensus_clustering_tpu.serve.preflight import (
     PreflightReject,
     check_admission,
+    estimate_estimator_bytes,
     estimate_job_bytes,
 )
 from consensus_clustering_tpu.serve.watchdog import (
@@ -216,6 +218,11 @@ _EXECUTOR_COUNTER_ATTRS = {
     "checkpoint_writes_total": "checkpoint_writes_total",
     "checkpoint_resume_total": "checkpoint_resume_total",
     "checkpoint_verify_rejects_total": "checkpoint_verify_rejects_total",
+    # Sampled-pair estimator (docs/SERVING.md "The 413 -> mode=estimate
+    # admission path"): successful estimate-mode executions, and the
+    # cumulative pair-sample gauge.
+    "estimator_runs_total": "estimator_runs_total",
+    "estimator_pairs_total": "estimator_pairs_total",
 }
 
 # Executor-owned observability OBJECTS metrics() snapshots (same
@@ -363,6 +370,11 @@ class Scheduler:
         self.jobs_wedged_total = 0
         self.jobs_quarantined = 0
         self.preflight_rejects_total = 0
+        # Auto-mode admissions resolved onto the sampled-pair
+        # estimator because the dense footprint was over budget — the
+        # admission-path half of the estimator story (the executor
+        # counts the execution half).
+        self.estimator_selected_total = 0
         self.jobs_shed_total: Dict[str, int] = {p: 0 for p in PRIORITIES}
         # Lease-layer counters (docs/SERVING.md "Multi-worker runbook"),
         # pre-seeded like everything /metrics dict-copies: orphan leases
@@ -449,8 +461,15 @@ class Scheduler:
     def _job_bucket(spec: JobSpec, n: int, d: int) -> str:
         """The calibration-store bucket string for a job — the key the
         drift watchdog, SLO monitor, and memory accountant all share,
-        so one bucket name means the same traffic on every surface."""
-        return shape_bucket(n, d, spec.n_iterations, spec.k_values)
+        so one bucket name means the same traffic on every surface.
+        Estimate-mode jobs get a ``-estimate`` suffix: their latency,
+        throughput and footprint are different quantities from the
+        dense engine's at the same shape, and one bucket name must
+        keep meaning one kind of traffic."""
+        bucket = shape_bucket(n, d, spec.n_iterations, spec.k_values)
+        if getattr(spec, "mode", "exact") == "estimate":
+            bucket = f"{bucket}-estimate"
+        return bucket
 
     def _span_sink(self, payload: Dict[str, Any]) -> None:
         self.events.emit("span", **payload)
@@ -860,6 +879,12 @@ class Scheduler:
         that order, after the dedup check — a stored result is served
         whatever the pressure, it costs one disk read.
         """
+        # Resolve mode=auto FIRST: the fingerprint (identity, dedup,
+        # checkpoint ring key) must always be taken over a CONCRETE
+        # mode — an "auto" that resolved differently under a different
+        # budget must be a different job, not the same fingerprint
+        # with two possible answers.
+        spec = self._resolve_mode(spec, x)
         fp = self.store.fingerprint(spec.fingerprint_payload(), x)
         job_id = uuid.uuid4().hex
         record: Dict[str, Any] = {
@@ -964,13 +989,7 @@ class Scheduler:
         )
         return snapshot
 
-    def _preflight(self, spec: JobSpec, x: np.ndarray, fp: str) -> None:
-        """Reject an over-budget job with a structured 413 BEFORE it
-        can compile/admit and OOM every in-flight job.  No-op without a
-        configured budget."""
-        if self.memory_budget_bytes is None:
-            return
-        n, d = (int(v) for v in x.shape)
+    def _resolved_h_block(self, spec: JobSpec, n: int, d: int) -> int:
         h_block = 16
         if hasattr(self.executor, "_resolve_h_block"):
             try:
@@ -979,6 +998,13 @@ class Scheduler:
                 )
             except Exception:  # noqa: BLE001 — the estimate survives a
                 pass  # resolution hiccup; 16 is the heuristic floor
+        return h_block
+
+    def _exact_estimate(
+        self, spec: JobSpec, n: int, d: int, h_block: int
+    ) -> Dict[str, Any]:
+        """The (correction-tightened) dense-engine footprint model —
+        the admission gate for exact-mode jobs."""
         estimate = estimate_job_bytes(
             n, d, spec.k_values,
             dtype=spec.dtype,
@@ -991,12 +1017,18 @@ class Scheduler:
         # model under-counting, scale the estimate UP by the observed
         # correction before judging the budget.  The factor is >= 1 by
         # construction — live evidence only ever tightens the gate, it
-        # never relaxes the model's own lower bound.
+        # never relaxes the model's own lower bound.  (The bucket key
+        # is the EXACT-mode one: estimate-mode jobs feed a separate
+        # suffixed ledger and never touch this correction.)
         accountant = getattr(self.executor, "memory_accounting", None)
         if accountant is not None and hasattr(accountant, "correction"):
             try:
                 correction = float(
-                    accountant.correction(self._job_bucket(spec, n, d))
+                    accountant.correction(
+                        shape_bucket(
+                            n, d, spec.n_iterations, spec.k_values
+                        )
+                    )
                 )
             except Exception:  # noqa: BLE001 — the gate survives an
                 correction = 1.0  # accounting hiccup; the model stands
@@ -1007,8 +1039,117 @@ class Scheduler:
                 estimate["total_bytes"] = int(
                     estimate["total_bytes"] * correction
                 )
+        return estimate
+
+    def _estimator_estimate(
+        self, spec: JobSpec, n: int, d: int, h_block: int
+    ) -> Dict[str, Any]:
+        return estimate_estimator_bytes(
+            n, d, spec.k_values,
+            n_pairs=spec.n_pairs,
+            dtype=spec.dtype,
+            h_block=h_block,
+            subsampling=spec.subsampling,
+            checkpoints=self.checkpoints,
+        )
+
+    def _resolve_mode(self, spec: JobSpec, x: np.ndarray) -> JobSpec:
+        """Resolve ``mode=auto`` to a concrete engine at admission:
+        exact when the dense footprint fits the budget (or no budget
+        is configured), the sampled-pair estimator when only IT fits —
+        the 413-becomes-admission path, taken silently for auto jobs
+        and disclosed via the ``estimator_selected`` event + counter.
+        An auto job neither engine can fit stays exact, so the 413 the
+        preflight then raises discloses both footprints honestly."""
+        if getattr(spec, "mode", "exact") != "auto":
+            return spec
+        if self.memory_budget_bytes is None:
+            return dataclasses.replace(spec, mode="exact", n_pairs=None)
+        n, d = (int(v) for v in x.shape)
+        h_block = self._resolved_h_block(spec, n, d)
+        exact = self._exact_estimate(spec, n, d, h_block)
+        if int(exact["total_bytes"]) <= self.memory_budget_bytes:
+            return dataclasses.replace(spec, mode="exact", n_pairs=None)
+        estimator = self._estimator_estimate(spec, n, d, h_block)
+        if int(estimator["total_bytes"]) > self.memory_budget_bytes:
+            # Neither engine fits: stay exact so the preflight's 413
+            # tells the whole story — and KEEP the user's n_pairs pin,
+            # so the 413's estimator block prices the configuration
+            # they actually asked for (advertising the default pair
+            # count's fits_budget for a discarded pin would send the
+            # client into the second round-trip this body exists to
+            # prevent).
+            return dataclasses.replace(spec, mode="exact")
+        resolved = dataclasses.replace(spec, mode="estimate")
+        with self._lock:
+            self.estimator_selected_total += 1
+        from consensus_clustering_tpu.estimator.bounds import (
+            pac_error_bound,
+        )
+
+        self.events.emit(
+            "estimator_selected",
+            shape=[n, d],
+            exact_bytes=int(exact["total_bytes"]),
+            estimator_bytes=int(estimator["total_bytes"]),
+            budget_bytes=int(self.memory_budget_bytes),
+            n_pairs=int(estimator["n_pairs"]),
+            pac_error_bound=pac_error_bound(
+                int(estimator["n_pairs"]), n, spec.parity_zeros
+            ),
+            worker_id=self.worker_id,
+        )
+        return resolved
+
+    def _preflight(self, spec: JobSpec, x: np.ndarray, fp: str) -> None:
+        """Reject an over-budget job with a structured 413 BEFORE it
+        can compile/admit and OOM every in-flight job.  No-op without
+        a configured budget.  The 413 body carries BOTH footprint
+        models — the dense one that gated (or would gate) the job and
+        the estimator's O(M) one — plus the error bound a
+        ``mode=estimate`` resubmission would disclose, so the client
+        decides without a second round-trip."""
+        if self.memory_budget_bytes is None:
+            return
+        n, d = (int(v) for v in x.shape)
+        h_block = self._resolved_h_block(spec, n, d)
+        estimator_est = self._estimator_estimate(spec, n, d, h_block)
+        if getattr(spec, "mode", "exact") == "estimate":
+            # Estimate-mode jobs are gated on their own O(M) model
+            # (uncorrected: the correction EWMA belongs to the dense
+            # model's bucket).  A reject here has no cheaper mode to
+            # point at — the estimator IS the cheap mode.
+            estimate = dict(estimator_est)
+            estimator_info = None
+        else:
+            estimate = self._exact_estimate(spec, n, d, h_block)
+            from consensus_clustering_tpu.estimator.bounds import (
+                pac_error_bound,
+            )
+
+            estimator_info = {
+                "estimated_bytes": int(estimator_est["total_bytes"]),
+                "n_pairs": int(estimator_est["n_pairs"]),
+                "fits_budget": (
+                    int(estimator_est["total_bytes"])
+                    <= self.memory_budget_bytes
+                ),
+                "pac_error_bound": pac_error_bound(
+                    int(estimator_est["n_pairs"]), n, spec.parity_zeros
+                ),
+                "estimate": dict(estimator_est),
+                "hint": (
+                    "resubmit with config.mode = 'estimate' (or "
+                    "'auto') to run the sampled-pair estimator at "
+                    "this footprint with the disclosed PAC error "
+                    "bound"
+                ),
+            }
         try:
-            check_admission(estimate, self.memory_budget_bytes, x.shape)
+            check_admission(
+                estimate, self.memory_budget_bytes, x.shape,
+                estimator=estimator_info,
+            )
         except PreflightReject as e:
             with self._lock:
                 self.preflight_rejects_total += 1
@@ -1108,6 +1249,11 @@ class Scheduler:
                 "jobs_quarantined": self.jobs_quarantined,
                 "jobs_shed_total": dict(self.jobs_shed_total),
                 "preflight_rejects_total": self.preflight_rejects_total,
+                # Sampled-pair admission path (docs/SERVING.md "The
+                # 413 -> mode=estimate admission path"): auto jobs the
+                # resolver routed onto the estimator because only its
+                # O(M) footprint fit the budget.
+                "estimator_selected_total": self.estimator_selected_total,
                 "memory_budget_bytes": self.memory_budget_bytes,
                 # Fenced-lease layer (docs/SERVING.md "Multi-worker
                 # runbook"): who this worker is, how many leases it

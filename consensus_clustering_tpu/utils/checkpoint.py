@@ -133,6 +133,47 @@ def stream_fingerprint(
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def estimator_stream_fingerprint(
+    config: SweepConfig,
+    seed: int,
+    data_sha: str,
+    *,
+    n_pairs: int,
+    n_iterations: Optional[int] = None,
+    adaptive_tol: Optional[float] = None,
+    adaptive_patience: Optional[int] = None,
+    adaptive_min_h: Optional[int] = None,
+) -> str:
+    """Identity of a sampled-pair estimator's block-resume state.
+
+    The :func:`stream_fingerprint` scheme under its own version tag,
+    extended with ``n_pairs``: pair-count state at a different sample
+    size has a different layout AND a different statistic, and the tag
+    keeps estimator generations and dense-sweep generations mutually
+    unresumable even at coincidentally matching shapes (the dense state
+    is (nK, N, N); a ring shared between modes must refuse to cross).
+    The pair sample itself needs no checkpointing — it is a pure
+    function of the seed (estimator/sampler.py), which this fingerprint
+    already covers.
+    """
+    base = stream_fingerprint(
+        config, seed, data_sha,
+        n_iterations=n_iterations,
+        adaptive_tol=adaptive_tol,
+        adaptive_patience=adaptive_patience,
+        adaptive_min_h=adaptive_min_h,
+    )
+    blob = json.dumps(
+        {
+            "scheme": "estimator-v1",
+            "stream": base,
+            "n_pairs": int(n_pairs),
+        },
+        sort_keys=True,
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
 def job_fingerprint(payload: Dict, x: np.ndarray) -> str:
     """Fingerprint of a serving job: the sweep-checkpoint scheme extended
     with the data content.

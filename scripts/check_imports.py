@@ -34,6 +34,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # still dies.  Keep in sync when adding a subpackage.
 EXPECTED_SUBPACKAGES = (
     "consensus_clustering_tpu.autotune",
+    "consensus_clustering_tpu.estimator",
     "consensus_clustering_tpu.lint",
     "consensus_clustering_tpu.models",
     "consensus_clustering_tpu.obs",

@@ -1,0 +1,879 @@
+"""Sampled-pair consensus estimator (consensus_clustering_tpu/estimator/).
+
+Fast lane: stdlib/host-only pieces — the DKW bound math, the pair
+sampler's determinism contract, the host curve estimation arithmetic,
+checkpoint-frame verification, fingerprint schemes, job-spec parsing,
+the preflight footprint model, and the scheduler's auto-mode resolver
+(stub executor, no compiles).
+
+Slow lane (the tier-1 budget rule: every compile-bearing case is
+slow-marked; the estimator-smoke CI job runs them all): engine
+determinism across runs AND across resume-from-checkpoint
+(bit-identical pairs and PAC — the ISSUE's determinism satellite),
+pair-exactness against the dense engine, tiled-exact bit-parity,
+adaptive early stop, the integrity sentinel under an injected bitflip,
+and the serve e2e 413 → auto=estimate path.
+"""
+
+import dataclasses
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.estimator.bounds import (
+    DEFAULT_DELTA,
+    DEFAULT_MAX_PAIRS,
+    bound_disclosure,
+    cdf_error_bound,
+    default_n_pairs,
+    dkw_epsilon,
+    pac_error_bound,
+    pair_cdf_scale,
+)
+
+# ---------------------------------------------------------------------------
+# bounds (stdlib-only)
+
+
+def test_dkw_epsilon_formula_and_monotonicity():
+    m, delta = 4096, 1e-3
+    assert dkw_epsilon(m, delta) == pytest.approx(
+        math.sqrt(math.log(2.0 / delta) / (2.0 * m))
+    )
+    assert dkw_epsilon(4 * m, delta) == pytest.approx(
+        dkw_epsilon(m, delta) / 2.0
+    )
+    assert dkw_epsilon(m, 1e-6) > dkw_epsilon(m, 1e-3)
+
+
+@pytest.mark.parametrize("bad_m", [0, -1])
+def test_dkw_epsilon_rejects_bad_m(bad_m):
+    with pytest.raises(ValueError):
+        dkw_epsilon(bad_m)
+
+
+@pytest.mark.parametrize("bad_delta", [0.0, 1.0, -0.5, 2.0])
+def test_dkw_epsilon_rejects_bad_delta(bad_delta):
+    with pytest.raises(ValueError):
+        dkw_epsilon(100, bad_delta)
+
+
+def test_pair_cdf_scale_parity_dilution():
+    n = 100
+    # Parity mode dilutes by T/N^2 < 1/2; corrected mode reports the
+    # pair CDF directly.
+    assert pair_cdf_scale(n, True) == pytest.approx(
+        (n * (n - 1) / 2) / n**2
+    )
+    assert pair_cdf_scale(n, False) == 1.0
+    assert pair_cdf_scale(n, True) < 0.5
+
+
+def test_pac_bound_is_twice_the_cdf_bound():
+    assert pac_error_bound(1000, 50, True) == pytest.approx(
+        2.0 * cdf_error_bound(1000, 50, True)
+    )
+
+
+def test_default_n_pairs_cap_and_population():
+    # Small N: the whole population; large N: the cap.
+    assert default_n_pairs(10) == 45
+    assert default_n_pairs(100_000) == DEFAULT_MAX_PAIRS
+
+
+def test_bound_disclosure_payload():
+    d = bound_disclosure(2048, 500)
+    assert d["n_pairs"] == 2048
+    assert d["pair_population"] == 500 * 499 // 2
+    assert 0 < d["pair_coverage"] < 1
+    assert d["confidence"] == pytest.approx(1.0 - DEFAULT_DELTA)
+    assert d["pac_error_bound"] == pytest.approx(
+        pac_error_bound(2048, 500, True)
+    )
+    json.dumps(d)  # JSON-able: it travels in every result payload
+
+
+# ---------------------------------------------------------------------------
+# sampler (eager jax, tiny)
+
+
+def test_sample_pairs_deterministic_and_strict_upper():
+    from consensus_clustering_tpu.estimator.sampler import (
+        pair_key,
+        sample_pairs,
+    )
+
+    key = pair_key(23)
+    i1, j1 = sample_pairs(key, 200, 1000)
+    i2, j2 = sample_pairs(key, 200, 1000)
+    i1, j1 = np.asarray(i1), np.asarray(j1)
+    assert np.array_equal(i1, np.asarray(i2))
+    assert np.array_equal(j1, np.asarray(j2))
+    assert (i1 < j1).all()
+    assert i1.min() >= 0 and j1.max() < 200
+    # A different seed draws a different sample.
+    i3, _ = sample_pairs(pair_key(24), 200, 1000)
+    assert not np.array_equal(i1, np.asarray(i3))
+
+
+def test_sample_pairs_validation():
+    from consensus_clustering_tpu.estimator.sampler import (
+        pair_key,
+        sample_pairs,
+    )
+
+    with pytest.raises(ValueError):
+        sample_pairs(pair_key(0), 1, 10)
+    with pytest.raises(ValueError):
+        sample_pairs(pair_key(0), 10, 0)
+
+
+# ---------------------------------------------------------------------------
+# host curve estimation
+
+
+def test_estimate_curves_full_population_is_exact():
+    """With M == the population and counts == the true bin counts, the
+    estimate IS the exact parity-mode CDF (the affine map is exact)."""
+    from consensus_clustering_tpu.estimator.engine import (
+        estimate_curves_from_pair_counts,
+    )
+
+    n, bins = 5, 4
+    t = n * (n - 1) // 2  # 10 pairs
+    counts = np.array([[4, 3, 2, 1]], dtype=np.int64)  # sums to 10
+    hist, cdf, pac = estimate_curves_from_pair_counts(
+        counts, t, n, 1, 3, parity_zeros=True
+    )
+    z = n * (n + 1) / 2
+    total = n * n
+    expect_cdf = (np.cumsum(counts[0]) + z) / total
+    assert cdf[0] == pytest.approx(expect_cdf, abs=1e-6)
+    assert cdf.dtype == np.float32 and hist.dtype == np.float32
+    assert pac[0] == pytest.approx(cdf[0][2] - cdf[0][1])
+    # Corrected mode: the pair CDF directly.
+    _, cdf_c, _ = estimate_curves_from_pair_counts(
+        counts, t, n, 1, 3, parity_zeros=False
+    )
+    assert cdf_c[0][-1] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-frame verification + fingerprints
+
+
+def _pair_frame(nk=2, m=8, h_done=5):
+    from consensus_clustering_tpu.resilience.integrity import frame_digest
+
+    iij = np.full((m,), h_done - 1, np.int32)
+    mij = np.tile(iij[None, :] - 1, (nk, 1))
+    arrays = {"state_mij": mij, "state_iij": iij}
+    header = {"h_done": h_done, "digest": frame_digest(arrays)}
+    return header, arrays
+
+
+def test_verify_pair_frame_accepts_valid():
+    from consensus_clustering_tpu.estimator.engine import (
+        verify_pair_state_frame,
+    )
+
+    header, arrays = _pair_frame()
+    assert verify_pair_state_frame(header, arrays) is None
+
+
+def test_verify_pair_frame_refuses_digest_mismatch():
+    from consensus_clustering_tpu.estimator.engine import (
+        verify_pair_state_frame,
+    )
+
+    header, arrays = _pair_frame()
+    arrays["state_mij"] = arrays["state_mij"].copy()
+    arrays["state_mij"][0, 0] += 1  # corrupted after digest
+    reason = verify_pair_state_frame(header, arrays)
+    assert reason is not None and "digest" in reason
+
+
+@pytest.mark.parametrize(
+    "mutate,expect",
+    [
+        (lambda m, i: m.__setitem__((0, 0), 99), "mij"),
+        (lambda m, i: i.__setitem__(0, 99), "iij"),
+        (lambda m, i: m.__setitem__((0, 0), -1), "mij"),
+    ],
+)
+def test_verify_pair_frame_refuses_invariant_breaches(mutate, expect):
+    from consensus_clustering_tpu.estimator.engine import (
+        verify_pair_state_frame,
+    )
+    from consensus_clustering_tpu.resilience.integrity import frame_digest
+
+    header, arrays = _pair_frame(h_done=5)
+    mutate(arrays["state_mij"], arrays["state_iij"])
+    # Re-digest so ONLY the invariant layer can refuse: this is the
+    # "faithfully recorded already-corrupt state" class.
+    header["digest"] = frame_digest(arrays)
+    reason = verify_pair_state_frame(header, arrays)
+    assert reason is not None and expect in reason
+
+
+def test_estimator_fingerprint_scheme_is_isolated():
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.utils.checkpoint import (
+        estimator_stream_fingerprint,
+        stream_fingerprint,
+    )
+
+    config = SweepConfig(
+        n_samples=60, n_features=4, k_values=(2, 3),
+        n_iterations=8, store_matrices=False, stream_h_block=4,
+    )
+    base = stream_fingerprint(config, 23, "abcd")
+    est = estimator_stream_fingerprint(
+        config, 23, "abcd", n_pairs=1024
+    )
+    est2 = estimator_stream_fingerprint(
+        config, 23, "abcd", n_pairs=1024
+    )
+    other_m = estimator_stream_fingerprint(
+        config, 23, "abcd", n_pairs=2048
+    )
+    assert est == est2  # stable
+    assert est != base  # estimator state can never resume dense state
+    assert est != other_m  # a different sample size is a different run
+
+
+# ---------------------------------------------------------------------------
+# job-spec surface
+
+
+def test_parse_job_spec_mode_and_n_pairs():
+    from consensus_clustering_tpu.serve.executor import (
+        JobSpecError,
+        parse_job_spec,
+    )
+
+    data = [[0.0, 1.0], [1.0, 0.0], [2.0, 1.0], [3.0, 0.0]]
+    spec, _ = parse_job_spec(
+        {"data": data, "config": {"mode": "estimate", "n_pairs": 64}}
+    )
+    assert spec.mode == "estimate" and spec.n_pairs == 64
+    spec, _ = parse_job_spec({"data": data, "config": {}})
+    assert spec.mode == "exact" and spec.n_pairs is None
+    with pytest.raises(JobSpecError):
+        parse_job_spec({"data": data, "config": {"mode": "guess"}})
+    with pytest.raises(JobSpecError):
+        # n_pairs without an estimator mode is a contradiction, not a
+        # silently ignored knob.
+        parse_job_spec({"data": data, "config": {"n_pairs": 64}})
+    with pytest.raises(JobSpecError):
+        parse_job_spec(
+            {"data": data,
+             "config": {"mode": "estimate", "n_pairs": 1}}
+        )
+
+
+def test_jobspec_mode_in_fingerprint_and_bucket():
+    from consensus_clustering_tpu.serve.executor import JobSpec
+
+    exact = JobSpec(k_values=(2, 3))
+    est = dataclasses.replace(exact, mode="estimate", n_pairs=256)
+    assert exact.fingerprint_payload() != est.fingerprint_payload()
+    assert exact.bucket(40, 3, 16) != est.bucket(40, 3, 16)
+    est2 = dataclasses.replace(est, n_pairs=512)
+    assert est.bucket(40, 3, 16) != est2.bucket(40, 3, 16)
+
+
+def test_jobspec_payload_roundtrip_and_back_compat():
+    from consensus_clustering_tpu.serve.executor import JobSpec
+
+    est = JobSpec(k_values=(2,), mode="estimate", n_pairs=256)
+    rebuilt = JobSpec.from_payload(est.fingerprint_payload())
+    assert rebuilt.mode == "estimate" and rebuilt.n_pairs == 256
+    # Pre-estimator payloads (old stores): no mode/n_pairs keys.
+    legacy = JobSpec(k_values=(2,)).fingerprint_payload()
+    legacy.pop("mode")
+    legacy.pop("n_pairs")
+    rebuilt = JobSpec.from_payload(legacy)
+    assert rebuilt.mode == "exact" and rebuilt.n_pairs is None
+
+
+# ---------------------------------------------------------------------------
+# preflight footprint model
+
+
+def test_estimator_bytes_monotonic_and_o_m():
+    from consensus_clustering_tpu.serve.preflight import (
+        estimate_estimator_bytes,
+        estimate_job_bytes,
+    )
+
+    base = estimate_estimator_bytes(10_000, 8, (2, 3), n_pairs=4096)
+    assert estimate_estimator_bytes(
+        20_000, 8, (2, 3), n_pairs=4096
+    )["total_bytes"] > base["total_bytes"]
+    assert estimate_estimator_bytes(
+        10_000, 8, (2, 3), n_pairs=8192
+    )["total_bytes"] > base["total_bytes"]
+    assert estimate_estimator_bytes(
+        10_000, 8, (2, 3, 4), n_pairs=4096
+    )["total_bytes"] > base["total_bytes"]
+    # The wall point: at N = 1e5 the dense model wants ~3 orders of
+    # magnitude more than the estimator — the subsystem's reason to
+    # exist, pinned as a number.
+    exact = estimate_job_bytes(100_000, 8, (2,))
+    est = estimate_estimator_bytes(100_000, 8, (2,))
+    assert exact["total_bytes"] > 100 * est["total_bytes"]
+    assert est["n_pairs"] == default_n_pairs(100_000)
+
+
+def test_check_admission_attaches_estimator_path():
+    from consensus_clustering_tpu.serve.preflight import (
+        PreflightReject,
+        check_admission,
+    )
+
+    estimate = {"total_bytes": 100}
+    # Fits: no raise, estimator block irrelevant.
+    check_admission(estimate, 200, (10, 2), estimator={"fits_budget": True})
+    with pytest.raises(PreflightReject) as e:
+        check_admission(
+            {"total_bytes": 300}, 200, (10, 2),
+            estimator={
+                "fits_budget": True, "estimated_bytes": 50,
+                "n_pairs": 64, "pac_error_bound": 0.01,
+            },
+        )
+    payload = e.value.payload
+    assert payload["estimator"]["fits_budget"] is True
+    assert "mode = 'estimate'" in payload["hint"]
+    # When the estimator does NOT fit either, the hint must not
+    # advertise an admission path that would also 413.
+    with pytest.raises(PreflightReject) as e:
+        check_admission(
+            {"total_bytes": 300}, 200, (10, 2),
+            estimator={"fits_budget": False, "estimated_bytes": 250},
+        )
+    assert "mode = 'estimate'" not in e.value.payload["hint"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler auto-mode resolution (stub executor, no compiles)
+
+
+class _StubExecutor:
+    run_count = 0
+
+    def backend(self):
+        return "cpu-fallback"
+
+
+def _scheduler(tmp_path, budget):
+    from consensus_clustering_tpu.serve.jobstore import JobStore
+    from consensus_clustering_tpu.serve.scheduler import Scheduler
+
+    return Scheduler(
+        _StubExecutor(), JobStore(str(tmp_path)),
+        memory_budget_bytes=budget, leases=False,
+    )
+
+
+def _spec(mode="auto", n=None, k=(2,)):
+    from consensus_clustering_tpu.serve.executor import JobSpec
+
+    return JobSpec(k_values=k, n_iterations=8, mode=mode, n_pairs=n)
+
+
+def test_resolve_mode_no_budget_is_exact(tmp_path):
+    s = _scheduler(tmp_path, None)
+    x = np.zeros((50, 3), np.float32)
+    resolved = s._resolve_mode(_spec(), x)
+    assert resolved.mode == "exact" and resolved.n_pairs is None
+
+
+def test_resolve_mode_fitting_exact_stays_exact(tmp_path):
+    s = _scheduler(tmp_path, 10 * 2**30)
+    x = np.zeros((50, 3), np.float32)
+    resolved = s._resolve_mode(_spec(), x)
+    assert resolved.mode == "exact"
+    assert s.estimator_selected_total == 0
+
+
+def test_resolve_mode_over_budget_selects_estimator(tmp_path):
+    from consensus_clustering_tpu.serve.preflight import (
+        estimate_estimator_bytes,
+        estimate_job_bytes,
+    )
+
+    n = 5000
+    exact = estimate_job_bytes(n, 3, (2,))["total_bytes"]
+    est = estimate_estimator_bytes(n, 3, (2,))["total_bytes"]
+    assert est < exact
+    events = []
+    s = _scheduler(tmp_path, (exact + est) // 2)
+    s.events.emit = lambda name, **f: events.append((name, f))
+    x = np.zeros((n, 3), np.float32)
+    resolved = s._resolve_mode(_spec(), x)
+    assert resolved.mode == "estimate"
+    assert s.estimator_selected_total == 1
+    names = [name for name, _ in events]
+    assert "estimator_selected" in names
+    fields = dict(events)[
+        "estimator_selected"
+    ]
+    assert fields["n_pairs"] == default_n_pairs(n)
+    assert fields["pac_error_bound"] > 0
+
+
+def test_resolve_mode_neither_fits_stays_exact_for_the_413(tmp_path):
+    s = _scheduler(tmp_path, 1024)  # nothing fits
+    x = np.zeros((5000, 3), np.float32)
+    resolved = s._resolve_mode(_spec(), x)
+    assert resolved.mode == "exact"
+    assert s.estimator_selected_total == 0
+
+
+def test_resolve_mode_neither_fits_keeps_the_n_pairs_pin(tmp_path):
+    """The 413's estimator block must price the configuration the
+    client actually pinned — a silently-discarded pin would advertise
+    the default's fits_budget and send the client into exactly the
+    second round-trip the body exists to prevent."""
+    from consensus_clustering_tpu.serve.preflight import PreflightReject
+
+    s = _scheduler(tmp_path, 1024)
+    x = np.zeros((5000, 3), np.float32)
+    resolved = s._resolve_mode(_spec(mode="auto", n=2**20), x)
+    assert resolved.mode == "exact"
+    assert resolved.n_pairs == 2**20  # the pin survives for the 413
+    with pytest.raises(PreflightReject) as e:
+        s._preflight(resolved, x, "fp")
+    assert e.value.payload["estimator"]["n_pairs"] == 2**20
+
+
+def test_estimate_mode_413_hint_names_the_right_knobs(tmp_path):
+    """An estimate-gated reject's hint must point at n_pairs, not at
+    an N² term its model does not have."""
+    from consensus_clustering_tpu.serve.preflight import PreflightReject
+
+    s = _scheduler(tmp_path, 1024)
+    x = np.zeros((5000, 3), np.float32)
+    with pytest.raises(PreflightReject) as e:
+        s._preflight(_spec(mode="estimate"), x, "fp")
+    assert "n_pairs" in e.value.payload["hint"]
+    assert "N² accumulator" not in e.value.payload["hint"]
+
+
+def test_preflight_413_payload_carries_both_footprints(tmp_path):
+    from consensus_clustering_tpu.serve.preflight import PreflightReject
+
+    n = 5000
+    s = _scheduler(tmp_path, 1024)
+    x = np.zeros((n, 3), np.float32)
+    with pytest.raises(PreflightReject) as e:
+        s._preflight(_spec(mode="exact"), x, "fp")
+    payload = e.value.payload
+    assert payload["estimator"]["estimated_bytes"] > 0
+    assert payload["estimator"]["fits_budget"] is False
+    assert payload["estimator"]["pac_error_bound"] > 0
+    assert payload["estimated_bytes"] > payload["estimator"][
+        "estimated_bytes"
+    ]
+    assert s.preflight_rejects_total == 1
+
+
+def test_preflight_gates_estimate_mode_on_its_own_model(tmp_path):
+    """An estimate-mode job under a budget the ESTIMATOR fits must
+    pass preflight even where exact would 413."""
+    from consensus_clustering_tpu.serve.preflight import (
+        PreflightReject,
+        estimate_estimator_bytes,
+        estimate_job_bytes,
+    )
+
+    n = 5000
+    exact = estimate_job_bytes(n, 3, (2,))["total_bytes"]
+    est = estimate_estimator_bytes(n, 3, (2,))["total_bytes"]
+    s = _scheduler(tmp_path, (exact + est) // 2)
+    x = np.zeros((n, 3), np.float32)
+    with pytest.raises(PreflightReject):
+        s._preflight(_spec(mode="exact"), x, "fp")
+    s._preflight(_spec(mode="estimate"), x, "fp")  # no raise
+
+
+def test_job_bucket_suffixes_estimate_mode(tmp_path):
+    from consensus_clustering_tpu.serve.scheduler import Scheduler
+
+    exact_bucket = Scheduler._job_bucket(_spec(mode="exact"), 40, 3)
+    est_bucket = Scheduler._job_bucket(_spec(mode="estimate"), 40, 3)
+    assert est_bucket == exact_bucket + "-estimate"
+
+
+# ---------------------------------------------------------------------------
+# tiled exact (host numpy vs brute force — no compiles)
+
+
+def test_tiled_exact_matches_bruteforce():
+    from consensus_clustering_tpu.estimator.tiled import (
+        tiled_exact_curves,
+    )
+
+    rng = np.random.default_rng(5)
+    n, h, n_sub, k = 30, 12, 24, 3
+    indices = np.stack(
+        [rng.permutation(n)[:n_sub] for _ in range(h)]
+    ).astype(np.int32)
+    labels = rng.integers(0, k, size=(h, n_sub)).astype(np.int32)
+
+    # Brute force dense counts.
+    mij = np.zeros((n, n), np.int64)
+    iij = np.zeros((n, n), np.int64)
+    for hh in range(h):
+        lab = np.full(n, -1, np.int64)
+        lab[indices[hh]] = labels[hh]
+        samp = lab >= 0
+        iij += samp[:, None] & samp[None, :]
+        same = (lab[:, None] == lab[None, :]) & samp[:, None] & samp[None, :]
+        mij += same
+    cons = (mij / (iij + np.float32(1e-6))).astype(np.float32)
+    edges = np.linspace(0.0, 1.0, 21).astype(np.float32)
+    upper = np.triu(np.ones((n, n), bool), k=1)
+    vals = cons[upper]
+    idx = np.clip(
+        np.searchsorted(edges, vals, side="right") - 1, 0, 19
+    )
+    counts = np.bincount(idx, minlength=20)
+    counts[0] += n * (n + 1) // 2
+    expect_cdf = np.cumsum(counts).astype(np.float32) / np.float32(n * n)
+
+    out = tiled_exact_curves(
+        indices, labels, n, 20, 2, 18, parity_zeros=True, tile_rows=7
+    )
+    assert np.array_equal(out["cdf"], expect_cdf)
+    assert out["pac_area"] == np.float32(
+        expect_cdf[17] - expect_cdf[2]
+    )
+
+
+def test_tiled_exact_validation():
+    from consensus_clustering_tpu.estimator.tiled import (
+        tiled_exact_curves,
+    )
+
+    with pytest.raises(ValueError):
+        tiled_exact_curves(
+            np.zeros((2, 2), np.int32), np.zeros((2, 2), np.int32),
+            4, 20, 2, 18, tile_rows=0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# api surface validation (no compiles)
+
+
+def test_api_mode_validation():
+    from consensus_clustering_tpu.api import ConsensusClustering
+
+    with pytest.raises(ValueError):
+        ConsensusClustering(mode="guess")
+    with pytest.raises(ValueError):
+        ConsensusClustering(mode="estimate", n_pairs=0)
+    with pytest.raises(ValueError, match="n_pairs"):
+        # All three surfaces (api / CLI / serving parser) reject the
+        # same contradiction the same way.
+        ConsensusClustering(mode="exact", n_pairs=4096)
+
+
+def test_api_auto_degrades_to_exact_when_estimate_infeasible(
+    monkeypatch,
+):
+    """mode='auto' with an estimate-infeasible configuration must
+    resolve to an exact ATTEMPT (the serving resolver's rule), never
+    into a guaranteed estimate-path ValueError."""
+    from consensus_clustering_tpu.api import ConsensusClustering
+
+    cc = ConsensusClustering(
+        random_state=1, mode="auto", store_matrices=True,
+        plot_cdf=False,
+    )
+    assert cc._resolve_mode(10_000, 4) == "exact"
+    pytest.importorskip("sklearn")
+    from sklearn.cluster import KMeans as SkKMeans
+
+    cc = ConsensusClustering(
+        clusterer=SkKMeans(n_init=1), random_state=1, mode="auto",
+        plot_cdf=False,
+    )
+    assert cc._resolve_mode(10_000, 4) == "exact"
+
+
+def test_api_estimate_rejects_matrix_consumers():
+    from consensus_clustering_tpu.api import ConsensusClustering
+
+    x = np.random.default_rng(0).normal(size=(40, 3))
+    cc = ConsensusClustering(
+        random_state=1, mode="estimate", store_matrices=True,
+        plot_cdf=False,
+    )
+    with pytest.raises(ValueError, match="store_matrices"):
+        cc.fit(x)
+    cc = ConsensusClustering(
+        random_state=1, mode="estimate",
+        compute_consensus_labels=True, plot_cdf=False,
+    )
+    with pytest.raises(ValueError, match="consensus"):
+        cc.fit(x)
+
+
+def test_api_estimate_rejects_host_backend():
+    pytest.importorskip("sklearn")
+    from sklearn.cluster import KMeans as SkKMeans
+
+    from consensus_clustering_tpu.api import ConsensusClustering
+
+    x = np.random.default_rng(0).normal(size=(40, 3))
+    cc = ConsensusClustering(
+        clusterer=SkKMeans(n_init=1), random_state=1,
+        mode="estimate", plot_cdf=False,
+    )
+    with pytest.raises(ValueError, match="device-path"):
+        cc.fit(x)
+
+
+# ---------------------------------------------------------------------------
+# slow lane: compile-bearing engine proofs (estimator-smoke CI runs
+# these; the tier-1 fast lane stays host-only)
+
+
+def _blobs(n, d, seed):
+    from consensus_clustering_tpu.estimator.validate import blobs
+
+    return blobs(n, d, seed)
+
+
+def _engine(n=90, d=4, k=(2, 3), h=9, hb=3, m=512):
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.estimator.engine import (
+        PairConsensusEngine,
+    )
+    from consensus_clustering_tpu.models.kmeans import KMeans
+
+    config = SweepConfig(
+        n_samples=n, n_features=d, k_values=k, n_iterations=h,
+        store_matrices=False, stream_h_block=hb,
+    )
+    return PairConsensusEngine(KMeans(), config, n_pairs=m), config
+
+
+@pytest.mark.slow
+def test_determinism_across_runs_and_resume(tmp_path):
+    """The ISSUE's determinism satellite: same seed => bit-identical
+    sampled pairs AND bit-identical PAC, across fresh runs and across
+    resume-from-checkpoint."""
+    from consensus_clustering_tpu.resilience.blocks import (
+        StreamCheckpointer,
+    )
+
+    engine, _ = _engine()
+    x = _blobs(90, 4, seed=7)
+    a = engine.run(x, 23, 9, return_state=True)
+    b = engine.run(x, 23, 9, return_state=True)
+    for name in ("pair_i", "pair_j", "mij", "iij"):
+        assert np.array_equal(
+            a["pair_state"][name], b["pair_state"][name]
+        ), name
+    assert np.array_equal(a["pac_area"], b["pac_area"])
+    assert np.array_equal(a["cdf"], b["cdf"])
+
+    ring = str(tmp_path / "ring")
+    ck = StreamCheckpointer(ring, every=1)
+    c = engine.run(x, 23, 9, checkpointer=ck, return_state=True)
+    ck.close()
+    # Drop the newest generation and resume from the previous one.
+    gens = sorted(
+        f for f in os.listdir(ring) if f.startswith("gen-")
+    )
+    os.remove(os.path.join(ring, gens[-1]))
+    ck2 = StreamCheckpointer(ring, every=1)
+    d = engine.run(x, 23, 9, checkpointer=ck2, return_state=True)
+    ck2.close()
+    assert d["streaming"]["resumed_from_block"] > 0
+    assert np.array_equal(c["pac_area"], d["pac_area"])
+    assert np.array_equal(
+        c["pair_state"]["mij"], d["pair_state"]["mij"]
+    )
+    assert np.array_equal(
+        c["pair_state"]["iij"], d["pair_state"]["iij"]
+    )
+    assert np.array_equal(a["pac_area"], c["pac_area"])
+
+
+@pytest.mark.slow
+def test_pair_exactness_and_bound_vs_dense():
+    """The validation harness's two gates at a tiny shape: sampled-pair
+    counts ARE the dense matrix entries, and the disclosed bound covers
+    the observed error."""
+    from consensus_clustering_tpu.estimator.validate import (
+        validate_shape,
+    )
+
+    record = validate_shape("tiny", 120, 5, 12, (2, 3), 1024, seed=23)
+    parity = record["parity"]
+    assert parity["pair_counts_bit_identical"] is True
+    assert parity["max_pac_error"] <= parity["pac_error_bound"]
+    assert parity["max_cdf_error"] <= parity["cdf_error_bound"]
+    assert parity["passed"] is True
+
+
+@pytest.mark.slow
+def test_tiled_exact_bit_matches_dense_sweep():
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.estimator.tiled import (
+        exact_curves_for_k,
+    )
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+    x = _blobs(100, 4, seed=9)
+    config = SweepConfig(
+        n_samples=100, n_features=4, k_values=(2, 3),
+        n_iterations=8, store_matrices=True,
+    )
+    dense = run_sweep(KMeans(), config, x, 23)
+    for i, k in enumerate((2, 3)):
+        tiled = exact_curves_for_k(
+            KMeans(), config, x, 23, k, tile_rows=17
+        )
+        assert np.array_equal(
+            tiled["cdf"], np.asarray(dense["cdf"][i])
+        ), k
+        assert tiled["pac_area"] == np.float32(dense["pac_area"][i]), k
+
+
+@pytest.mark.slow
+def test_adaptive_early_stop_on_pair_engine():
+    engine, _ = _engine(h=30, hb=3)
+    x = _blobs(90, 4, seed=7)
+    out = engine.run(
+        x, 23, 30, adaptive_tol=1.0, adaptive_patience=2,
+        adaptive_min_h=6,
+    )
+    assert out["streaming"]["stopped_early"] is True
+    assert out["streaming"]["h_effective"] < 30
+    assert out["estimator"]["pac_error_bound"] > 0
+
+
+@pytest.mark.slow
+def test_exact_best_k_refines_at_h_effective():
+    """With adaptive early stop, the exact_best_k refinement must be
+    the exact twin of what was ESTIMATED — consensus over h_effective
+    resamples — not a different full-H statistic the disclosed band
+    does not cover."""
+    from consensus_clustering_tpu.api import ConsensusClustering
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+    x = _blobs(120, 4, seed=3)
+    cc = ConsensusClustering(
+        K_range=(2, 3), n_iterations=30, random_state=23,
+        plot_cdf=False, progress=False, mode="estimate",
+        n_pairs=2048, exact_best_k=True, stream_h_block=3,
+        adaptive_tol=1.0, adaptive_patience=2, adaptive_min_h=6,
+    )
+    cc.fit(x)
+    h_eff = cc.metrics_["streaming"]["h_effective"]
+    assert cc.metrics_["streaming"]["stopped_early"] is True
+    assert h_eff < 30
+    dense = run_sweep(
+        KMeans(),
+        SweepConfig(
+            n_samples=120, n_features=4, k_values=(cc.best_k_,),
+            n_iterations=h_eff, store_matrices=True,
+        ),
+        x, 23,
+    )
+    assert float(
+        cc.cdf_at_K_data[cc.best_k_]["pac_area"]
+    ) == float(dense["pac_area"][0])
+
+
+@pytest.mark.slow
+def test_integrity_sentinel_catches_bitflip():
+    from consensus_clustering_tpu.resilience.faults import (
+        IntegrityError,
+        faults,
+    )
+
+    engine, _ = _engine()
+    x = _blobs(90, 4, seed=7)
+    faults.clear()
+    try:
+        faults.configure("accumulator=1:bitflip")
+        with pytest.raises(IntegrityError) as e:
+            engine.run(x, 23, 9, integrity_check_every=1)
+        assert e.value.point == "accumulator"
+        assert getattr(e.value, "integrity_checks_run", 0) >= 1
+    finally:
+        faults.clear()
+
+
+@pytest.mark.slow
+def test_serve_estimate_mode_end_to_end(tmp_path):
+    """The admission path live: exact 413s with the estimator block,
+    the identical auto job is admitted, resolves to estimate, and
+    completes with the bound in the result."""
+    import time
+
+    from consensus_clustering_tpu.serve.executor import (
+        JobSpec,
+        SweepExecutor,
+    )
+    from consensus_clustering_tpu.serve.jobstore import JobStore
+    from consensus_clustering_tpu.serve.preflight import (
+        PreflightReject,
+        estimate_estimator_bytes,
+        estimate_job_bytes,
+    )
+    from consensus_clustering_tpu.serve.scheduler import Scheduler
+
+    n = 3000
+    x = _blobs(n, 4, seed=11)
+    exact = estimate_job_bytes(n, 4, (2,))["total_bytes"]
+    est = estimate_estimator_bytes(n, 4, (2,), n_pairs=4096)[
+        "total_bytes"
+    ]
+    budget = (exact + est) // 2
+    base = dict(k_values=(2,), n_iterations=6, seed=23)
+    executor = SweepExecutor(use_compilation_cache=False)
+    scheduler = Scheduler(
+        executor, JobStore(str(tmp_path)),
+        memory_budget_bytes=budget, leases=False,
+    )
+    scheduler.start()
+    try:
+        with pytest.raises(PreflightReject) as e:
+            scheduler.submit(JobSpec(mode="exact", **base), x)
+        assert e.value.payload["estimator"]["fits_budget"] is True
+        rec = scheduler.submit(
+            JobSpec(mode="auto", n_pairs=4096, **base), x
+        )
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            rec = scheduler.get(rec["job_id"])
+            if rec["status"] in ("done", "failed", "timeout"):
+                break
+            time.sleep(0.5)
+        assert rec["status"] == "done", rec.get("error")
+        result = rec["result"]
+        assert result["mode"] == "estimate"
+        assert result["estimator"]["n_pairs"] == 4096
+        assert result["estimator"]["pac_error_bound"] > 0
+        assert result["streaming"]["h_effective"] == 6
+        metrics = scheduler.metrics()
+        assert metrics["estimator_selected_total"] == 1
+        assert metrics["estimator_runs_total"] == 1
+        assert metrics["estimator_pairs_total"] == 4096
+    finally:
+        scheduler.stop()

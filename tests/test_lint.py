@@ -230,9 +230,66 @@ def test_rule_does_not_fire(tmp_path, rule_id):
     ]
 
 
-def test_all_eight_rules_registered():
+def test_all_nine_rules_registered():
     ids = sorted(r.id for r in all_rules())
-    assert ids == [f"JL{i:03d}" for i in range(1, 9)]
+    assert ids == [f"JL{i:03d}" for i in range(1, 10)]
+
+
+def test_rule_packs_name_registered_rules():
+    from consensus_clustering_tpu.lint.registry import RULE_PACKS
+
+    ids = {r.id for r in all_rules()}
+    for pack, rule_ids_ in RULE_PACKS.items():
+        assert set(rule_ids_) <= ids, pack
+    assert RULE_PACKS["estimator"] == ("JL009",)
+
+
+# JL009 is directory-scoped (the estimator rule pack), so its fixtures
+# cannot ride the CASES table — lint_source writes to tmp_path, which
+# has no estimator/ path component.
+_JL009_FIRES = """
+from consensus_clustering_tpu.ops.resample import cosample_counts
+
+def bad(n, indices):
+    acc = jnp.zeros((n, n), jnp.int32)       # square symbolic alloc
+    return acc + cosample_counts(indices, n)  # dense builder
+"""
+
+_JL009_CLEAN = """
+def good(hb, n, m):
+    labmat = jnp.zeros((hb, n), jnp.int32)  # linear in N: fine
+    mij = jnp.zeros((2, m), jnp.int32)      # O(M) state: the point
+    edges = jnp.zeros((20, 20))             # repeated CONSTANT: fine
+    return labmat, mij, edges
+"""
+
+
+def _lint_in_pack(tmp_path, source, subdir):
+    pkg = tmp_path / "consensus_clustering_tpu" / subdir
+    pkg.mkdir(parents=True)
+    path = pkg / "snippet.py"
+    path.write_text(_PRELUDE + source)
+    active, suppressed, error = lint_file(str(path))
+    assert error is None, error
+    return active
+
+
+def test_jl009_fires_inside_estimator(tmp_path):
+    active = _lint_in_pack(tmp_path, _JL009_FIRES, "estimator")
+    lines = [f for f in active if f.rule == "JL009"]
+    assert len(lines) == 2, [(f.line, f.message) for f in active]
+
+
+def test_jl009_clean_inside_estimator(tmp_path):
+    active = _lint_in_pack(tmp_path, _JL009_CLEAN, "estimator")
+    assert "JL009" not in rule_ids(active)
+
+
+def test_jl009_silent_outside_estimator(tmp_path):
+    # The same hazard source outside the pack directory: JL009 is a
+    # subsystem invariant, not a universal rule.
+    active = _lint_in_pack(tmp_path, _JL009_FIRES, "parallel")
+    assert "JL009" not in rule_ids(active)
 
 
 def test_finding_names_file_line_and_rule(tmp_path):

@@ -183,6 +183,11 @@ EXPECTED_METRICS_KEYS = frozenset(
         "checkpoint_verify_rejects_total", "retry_total",
         "autotune_provenance_total", "jobs_wedged_total",
         "jobs_quarantined", "jobs_shed_total", "preflight_rejects_total",
+        # Sampled-pair estimator (docs/SERVING.md "The 413 ->
+        # mode=estimate admission path"): admissions auto-routed onto
+        # the estimator, successful estimate executions, pair gauge.
+        "estimator_selected_total", "estimator_runs_total",
+        "estimator_pairs_total",
         "memory_budget_bytes", "integrity_checks_total",
         "integrity_violations_total", "latency_histograms", "perf_drift",
         "perf_drift_events_total", "profile_requests_total",
