@@ -598,9 +598,255 @@ def phase_memory_slo(root, report):
         svc.stop()
 
 
+def _fair_body(seed, n, iters, priority, tenant):
+    body = _body(seed, n=n, iters=iters)
+    body["config"]["priority"] = priority
+    body["config"]["tenant"] = tenant
+    return body
+
+
+def _sse_frames(resp_fp):
+    """Yield (event_name, data dict) SSE frames from a response file
+    object, skipping keepalive comments (stdlib mirror of the wire
+    format in serve/sched/stream.py)."""
+    name, data = None, None
+    while True:
+        line = resp_fp.readline()
+        if not line:
+            return
+        line = line.decode().rstrip("\n")
+        if line.startswith(":"):
+            continue
+        if line.startswith("event: "):
+            name = line[len("event: "):]
+        elif line.startswith("data: "):
+            data = json.loads(line[len("data: "):])
+        elif line == "" and name is not None:
+            yield name, data
+            name, data = None, None
+
+
+def _fair_arm(root, label, sched_args, threshold, n_low, n_high,
+              high_n, low_n, low_iters, high_iters):
+    """One arm of the fairness A/B: flood low-priority jobs, then
+    trickle high-priority ones at a DIFFERENT shape bucket (so the SLO
+    judge sees them separately); returns (metrics, slo_breach events,
+    per-job wall for the high jobs)."""
+    store = os.path.join(root, f"fair_{label}_store")
+    events_path = os.path.join(root, f"fair_{label}_events.jsonl")
+    svc = ServiceProc(
+        store,
+        extra_args=[
+            "--queue-size", "64", "--no-shed",
+            # Both buckets pre-warmed: compile must not masquerade as
+            # queueing.
+            "--warmup", f"{low_n},3,2;3,{low_iters}",
+            "--warmup", f"{high_n},3,2;3,{high_iters}",
+            # The judge: p90 queue wait per bucket, breach on ONE bad
+            # sample over both windows — exactly the fairness
+            # acceptance criterion, graded by the SLO layer.
+            "--slo-objective", f"queue_wait_seconds:{threshold}:0.9",
+            "--slo-min-count", "1",
+            "--slo-windows", "60:600",
+            "--slo-burn", "1",
+            "--wedge-floor", "30",
+            *sched_args,
+        ],
+        events_path=events_path,
+    )
+    try:
+        if label == "fair":
+            # Pre-warm the FUSED program too (its one-time vmap
+            # compile must not ride inside the measured flood): one
+            # throwaway same-bucket trio, drained before the clock.
+            # Below-width batches pad to the same compiled program
+            # (pad_to=fusion_max), so this one warm covers every batch
+            # size the flood produces.
+            warm_ids = [
+                svc.post(
+                    "/jobs",
+                    _fair_body(
+                        8000 + i, low_n, low_iters, "low", "bulk"
+                    ),
+                )[1]["job_id"]
+                for i in range(3)
+            ]
+            for job_id in warm_ids:
+                svc.poll_job(job_id, budget=600)
+            if svc.get("/metrics")["fused_executions_total"] < 1:
+                raise Violation(
+                    "warmup trio did not fuse — the planner never "
+                    "engaged"
+                )
+        low_ids = [
+            svc.post(
+                "/jobs",
+                _fair_body(8100 + i, low_n, low_iters, "low", "bulk"),
+            )[1]["job_id"]
+            for i in range(n_low)
+        ]
+        t_high = time.time()
+        high_ids = [
+            svc.post(
+                "/jobs",
+                _fair_body(8200 + i, high_n, high_iters, "high",
+                           "interactive"),
+            )[1]["job_id"]
+            for i in range(n_high)
+        ]
+        high_walls = []
+        for job_id in high_ids:
+            record = svc.poll_job(job_id, budget=600)
+            if record["status"] != "done":
+                raise Violation(
+                    f"high job {job_id} ended {record['status']}"
+                )
+            high_walls.append(round(time.time() - t_high, 1))
+        for job_id in low_ids:
+            record = svc.poll_job(job_id, budget=600)
+            if record["status"] != "done":
+                raise Violation(
+                    f"low job {job_id} ended {record['status']}"
+                )
+        metrics = svc.get("/metrics")
+        breaches = [
+            e for e in _events(events_path)
+            if e["event"] == "slo_breach"
+            and e.get("signal", "queue_wait_seconds")
+            == "queue_wait_seconds"
+        ]
+        if label == "fair":
+            _fair_sse_cancel(svc, high_n, high_iters)
+            metrics = svc.get("/metrics")
+        return metrics, breaches, high_walls
+    finally:
+        svc.stop()
+
+
+def _fair_sse_cancel(svc, n, iters):
+    """The streamed-partial-results leg: an SSE client watches a long
+    job's PAC trajectory, hangs up with cancel_on_disconnect, the job
+    terminalises as cancelled, and the freed slot runs the next job."""
+    import http.client
+
+    code, rec, _ = svc.post(
+        "/jobs", _fair_body(8900, n, 400, "high", "interactive")
+    )
+    if code != 202:
+        raise Violation(f"sse job admission got {code}")
+    host = svc.base[len("http://"):]
+    conn = http.client.HTTPConnection(host, timeout=60)
+    conn.request(
+        "GET", f"/jobs/{rec['job_id']}/events?cancel_on_disconnect=1"
+    )
+    resp = conn.getresponse()
+    if resp.status != 200:
+        raise Violation(f"SSE stream got {resp.status}")
+    saw_blocks = 0
+    for name, data in _sse_frames(resp.fp):
+        if name == "h_block_complete":
+            saw_blocks += 1
+            if saw_blocks >= 2:
+                break
+    if saw_blocks < 2:
+        raise Violation("SSE stream never delivered block events")
+    # Hang up mid-run: the response's file object holds the fd, so
+    # close both — the service detects the EOF and cancels.
+    resp.close()
+    conn.close()
+    record = svc.poll_job(
+        rec["job_id"], budget=120,
+        terminal=("done", "failed", "timeout", "quarantined",
+                  "cancelled"),
+    )
+    if record["status"] != "cancelled":
+        raise Violation(
+            f"disconnected SSE job ended {record['status']}, expected "
+            "cancelled"
+        )
+    m = svc.get("/metrics")
+    if m["sse_cancels_total"] < 1 or m["jobs_cancelled_total"] < 1:
+        raise Violation("SSE cancel not counted in /metrics")
+    # The freed slot runs the next job to completion.
+    _, nxt, _ = svc.post(
+        "/jobs", _fair_body(8901, n, 16, "high", "interactive")
+    )
+    record = svc.poll_job(nxt["job_id"], budget=600)
+    if record["status"] != "done":
+        raise Violation(
+            f"post-cancel job ended {record['status']} — the slot was "
+            "not reusable"
+        )
+
+
+def phase_fair(root, report):
+    """The fairness A/B (docs/SERVING.md "Fair-share & fusion
+    runbook"), judged by the SLO layer, not eyeballs: under a
+    low-priority flood with a high-priority trickle behind it, the
+    fair schedule keeps the high bucket's p90 queue wait in-SLO (zero
+    slo_breach burn) while the identical traffic under FIFO breaches
+    it; the fair arm also proves >= 1 fused execution and one SSE
+    client cancelling early with its slot reused."""
+    threshold = 5.0
+    # The discriminator's arithmetic: a warm 16-block low job costs c
+    # seconds, the fair arm's worst high wait is one in-flight fused
+    # batch (~fusion_max × c — non-preemptive pickup), the FIFO arm's
+    # is the whole flood (~n_low × c).  n_low = 40 puts the two sides
+    # a decade apart around the 5 s threshold, so the A/B discriminates
+    # across CI-box speed variance instead of riding a knife edge.
+    n_low, n_high = 40, 3
+    low_iters, high_iters = 64, 16
+    low_n, high_n = 40, 56
+    high_bucket = f"n{high_n}_d3_h{high_iters}_k2-3"
+
+    m_fair, b_fair, fair_walls = _fair_arm(
+        root, "fair", ["--schedule", "fair", "--fusion-max", "3"],
+        threshold, n_low, n_high, high_n, low_n, low_iters, high_iters,
+    )
+    fair_high_breaches = [
+        e for e in b_fair if e.get("bucket") == high_bucket
+    ]
+    if fair_high_breaches:
+        raise Violation(
+            "fair schedule breached the high lane's queue-wait SLO: "
+            f"{fair_high_breaches[:2]}"
+        )
+    if m_fair["fused_executions_total"] < 1:
+        raise Violation("no fused execution under the fair flood")
+    if m_fair["schedule"] != "fair":
+        raise Violation(f"schedule label {m_fair['schedule']!r}")
+
+    m_fifo, b_fifo, fifo_walls = _fair_arm(
+        root, "fifo", ["--schedule", "fifo"],
+        threshold, n_low, n_high, high_n, low_n, low_iters, high_iters,
+    )
+    fifo_high_breaches = [
+        e for e in b_fifo if e.get("bucket") == high_bucket
+    ]
+    if not fifo_high_breaches:
+        raise Violation(
+            "the FIFO control arm did NOT breach the high lane — the "
+            "flood is too light to discriminate, and the fair arm's "
+            "zero-breach proves nothing"
+        )
+    report["fair"] = {
+        "threshold_seconds": threshold,
+        "high_bucket": high_bucket,
+        "fair_high_breaches": 0,
+        "fifo_high_breaches": len(fifo_high_breaches),
+        "fair_high_walls": fair_walls,
+        "fifo_high_walls": fifo_walls,
+        "fused_executions_total": m_fair["fused_executions_total"],
+        "fused_jobs_total": m_fair["fused_jobs_total"],
+        "sse_cancels_total": m_fair["sse_cancels_total"],
+        "jobs_cancelled_total": m_fair["jobs_cancelled_total"],
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--schedule", choices=["smoke", "load"], default="smoke")
+    p.add_argument("--schedule", choices=["smoke", "load", "fair"],
+                   default="smoke")
     p.add_argument("--out", default=None, help="write the JSON report here")
     p.add_argument("--root", default=None,
                    help="work directory (default: a fresh temp dir)")
@@ -612,12 +858,18 @@ def main(argv=None):
     violations = []
     n_jobs, buckets = (12, 1) if args.schedule == "smoke" else (40, 2)
 
-    phases = [
-        ("load", lambda: phase_load(root, report, n_jobs, buckets)),
-        ("drift", lambda: phase_drift(root, report)),
-        ("profile", lambda: phase_profile(root, report)),
-        ("memory_slo", lambda: phase_memory_slo(root, report)),
-    ]
+    if args.schedule == "fair":
+        # The fairness A/B is its own lane (sched-smoke CI): two full
+        # service lifecycles with a deliberate backlog each — stacking
+        # it under the obs phases would blow their budget.
+        phases = [("fair", lambda: phase_fair(root, report))]
+    else:
+        phases = [
+            ("load", lambda: phase_load(root, report, n_jobs, buckets)),
+            ("drift", lambda: phase_drift(root, report)),
+            ("profile", lambda: phase_profile(root, report)),
+            ("memory_slo", lambda: phase_memory_slo(root, report)),
+        ]
     for name, fn in phases:
         t0 = time.time()
         try:

@@ -343,6 +343,16 @@ def cmd_serve(args):
         await_backend_init(executor.backend, args.backend_init_timeout)
     except BackendInitTimeout as e:
         raise SystemExit(f"serve: {e}")
+    from consensus_clustering_tpu.serve.sched.fairshare import (
+        parse_priority_weights,
+        parse_tenant_weights,
+    )
+
+    try:
+        priority_weights = parse_priority_weights(args.priority_weights)
+        tenant_weights = parse_tenant_weights(args.tenant_weight)
+    except ValueError as e:
+        raise SystemExit(f"serve: {e}")
     memory_budget = None
     if args.memory_budget != "off":
         from consensus_clustering_tpu.serve.preflight import (
@@ -393,6 +403,13 @@ def cmd_serve(args):
         worker_id=args.worker_id,
         leases=not args.no_leases,
         lease_ttl=args.lease_ttl,
+        schedule=args.schedule,
+        fusion_max=args.fusion_max,
+        priority_weights=priority_weights,
+        tenant_weights=tenant_weights,
+        starvation_seconds=args.starvation_seconds,
+        tenant_header=args.tenant_header or None,
+        sse_keepalive_seconds=args.sse_keepalive,
     )
     if args.port_file:
         # The orchestration handshake for --port 0 (ephemeral): whoever
@@ -729,7 +746,50 @@ def main(argv=None):
                          help="queue fraction at which normal-priority "
                          "admissions shed")
     serve_p.add_argument("--shed-retry-after", type=float, default=15.0,
-                         help="Retry-After seconds on shed responses")
+                         help="FLOOR for the Retry-After on shed "
+                         "responses; the actual hint derives from the "
+                         "live queue drain rate (backlog / measured "
+                         "drain, capped at 600 s), disclosed in the "
+                         "429 body as retry_after_basis")
+    serve_p.add_argument("--schedule", choices=["fair", "fifo"],
+                         default="fair",
+                         help="admission queue discipline "
+                         "(docs/SERVING.md 'Fair-share & fusion "
+                         "runbook'): weighted-fair DRR lanes over "
+                         "tenant x priority (default), or the "
+                         "historical bounded FIFO as the measurable "
+                         "control arm")
+    serve_p.add_argument("--fusion-max", type=int, default=1,
+                         help=">= 2 enables same-bucket job fusion: up "
+                         "to this many runnable jobs sharing one shape "
+                         "bucket ride ONE fused device program per "
+                         "block (bit-identical to solo — the parity "
+                         "gate; degrades to solo on any mismatch). "
+                         "1 = off (the default; requires --schedule "
+                         "fair)")
+    serve_p.add_argument("--priority-weights", default=None,
+                         metavar="HIGH:NORMAL:LOW",
+                         help="DRR weights per priority lane "
+                         "(default 4:2:1)")
+    serve_p.add_argument("--tenant-weight", action="append",
+                         default=None, metavar="TENANT=W",
+                         help="per-tenant DRR weight multiplier "
+                         "(repeatable; unlisted tenants weigh 1)")
+    serve_p.add_argument("--starvation-seconds", type=float,
+                         default=30.0,
+                         help="fair-share starvation clock: a lane "
+                         "whose head job has waited longer than this "
+                         "is served next regardless of weights")
+    serve_p.add_argument("--tenant-header", default="X-Tenant",
+                         help="HTTP header carrying the tenant "
+                         "identity (an auth proxy stamps it; overrides "
+                         "config.tenant when present; empty string "
+                         "disables)")
+    serve_p.add_argument("--sse-keepalive", type=float, default=5.0,
+                         help="seconds between SSE keepalive comment "
+                         "frames on GET /jobs/<id>/events (also the "
+                         "client-disconnect detection latency while "
+                         "no blocks complete)")
     serve_p.add_argument("--worker-id", default=None,
                          help="restart-stable identity of this worker "
                          "over a SHARED jobstore (docs/SERVING.md "

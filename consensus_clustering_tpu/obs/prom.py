@@ -66,6 +66,9 @@ _LABEL_NAMES = {
     "jobs_shed_total": "priority",
     "integrity_violations_total": "point",
     "autotune_provenance_total": "provenance",
+    # Fair-share lanes (docs/SERVING.md "Fair-share & fusion
+    # runbook"): per-lane queue depth, labelled "tenant|priority".
+    "fair_lanes": "lane",
 }
 
 def _escape_label(value: str) -> str:
@@ -351,6 +354,15 @@ def render_prometheus(metrics: Dict[str, Any]) -> str:
             )
             lines.append(
                 _sample(f"{name}_info", {"backend": value}, 1)
+            )
+            continue
+        if key == "schedule":
+            _family(
+                lines, f"{name}_info", "gauge",
+                "active admission schedule (fair | fifo)",
+            )
+            lines.append(
+                _sample(f"{name}_info", {"schedule": value}, 1)
             )
             continue
         if key == "worker_id":

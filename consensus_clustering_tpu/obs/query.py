@@ -203,6 +203,14 @@ def render_trace(
 # report: per-bucket percentiles + incident breakdowns
 
 
+def _lane_row() -> Dict[str, Any]:
+    """A fresh per-priority / per-tenant accumulator row."""
+    return {
+        "done": 0, "failed": 0, "cancelled": 0, "shed": 0,
+        "queue_wait": [],
+    }
+
+
 def summarize(
     events: Iterable[Dict[str, Any]],
     since: Optional[float] = None,
@@ -223,6 +231,22 @@ def summarize(
     job_seconds: Dict[str, List[float]] = {}
     bucket_of: Dict[str, str] = {}
     queue_wait_raw: List[Tuple[str, float]] = []  # (trace_id, seconds)
+    # Fair-share lane identity per job (docs/SERVING.md "Fair-share &
+    # fusion runbook"): job_submitted carries priority + tenant, and
+    # the per-priority / per-tenant report rows join everything else
+    # through the job_id.  Jobs whose admission predates the log slice
+    # (or the lane fields) file under "unknown".
+    lane_of: Dict[str, Tuple[str, str]] = {}
+    per_priority: Dict[str, Dict[str, Any]] = {}
+    per_tenant: Dict[str, Dict[str, Any]] = {}
+
+    def lane_rows(job_id: Optional[str]) -> List[Dict[str, Any]]:
+        priority, tenant = lane_of.get(job_id, ("unknown", "unknown"))
+        return [
+            per_priority.setdefault(priority, _lane_row()),
+            per_tenant.setdefault(tenant, _lane_row()),
+        ]
+
     retries: Dict[str, int] = {}
     wedges = 0
     drift: Dict[str, int] = {}
@@ -259,9 +283,15 @@ def summarize(
         if name in (
             "job_submitted", "job_done", "job_failed", "job_retry",
             "job_wedged", "job_requeued", "job_quarantined", "job_shed",
-            "job_preflight_reject",
+            "job_preflight_reject", "job_cancelled",
         ):
             statuses[name] = statuses.get(name, 0) + 1
+        if name == "job_submitted":
+            if e.get("job_id") and e.get("priority"):
+                lane_of[e["job_id"]] = (
+                    str(e["priority"]),
+                    str(e.get("tenant") or "default"),
+                )
         if name == "job_done":
             bucket = e.get("bucket") or "unknown"
             if e.get("job_id"):
@@ -273,6 +303,8 @@ def summarize(
             row = worker_row(e)
             if row is not None:
                 row["done"] += 1
+            for lane in lane_rows(e.get("job_id")):
+                lane["done"] += 1
         elif name == "job_failed":
             # Failed jobs join their queue waits through the bucket
             # too (carried since the job reached worker pickup): an
@@ -283,6 +315,20 @@ def summarize(
             row = worker_row(e)
             if row is not None:
                 row["failed"] += 1
+            for lane in lane_rows(e.get("job_id")):
+                lane["failed"] += 1
+        elif name == "job_cancelled":
+            for lane in lane_rows(e.get("job_id")):
+                lane["cancelled"] += 1
+        elif name == "job_shed":
+            # Sheds have no job_id (nothing was admitted): the event's
+            # own lane fields are the row keys.
+            per_priority.setdefault(
+                str(e.get("priority") or "unknown"), _lane_row()
+            )["shed"] += 1
+            per_tenant.setdefault(
+                str(e.get("tenant") or "unknown"), _lane_row()
+            )["shed"] += 1
         elif name == "job_retry":
             reason = e.get("reason", "unknown")
             retries[reason] = retries.get(reason, 0) + 1
@@ -325,6 +371,8 @@ def summarize(
         # part of the backlog story, filed under "unknown".
         bucket = bucket_of.get(trace_id) or "unknown"
         queue_wait.setdefault(bucket, []).append(seconds)
+        for lane in lane_rows(trace_id):
+            lane["queue_wait"].append(seconds)
 
     def stats(values: List[float]) -> Dict[str, Any]:
         return {
@@ -345,12 +393,32 @@ def summarize(
         }
         for bucket in sorted(set(job_seconds) | set(queue_wait))
     }
+    def lane_section(
+        rows: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Dict[str, Any]]:
+        # The fair-share report rows (docs/SERVING.md "Fair-share &
+        # fusion runbook"): done/failed/cancelled/shed counts plus the
+        # p95 queue wait — the number weighted queues exist to move.
+        return {
+            key: {
+                "done": row["done"],
+                "failed": row["failed"],
+                "cancelled": row["cancelled"],
+                "shed": row["shed"],
+                "queue_wait_count": len(row["queue_wait"]),
+                "queue_wait_p95": percentile(row["queue_wait"], 0.95),
+            }
+            for key, row in sorted(rows.items())
+        }
+
     return {
         "events": len(events),
         "first_ts": ts_lo,
         "last_ts": ts_hi,
         "jobs": statuses,
         "per_bucket": per_bucket,
+        "per_priority": lane_section(per_priority),
+        "per_tenant": lane_section(per_tenant),
         "per_worker": {k: per_worker[k] for k in sorted(per_worker)},
         "retries": retries,
         "wedges": wedges,
@@ -394,6 +462,24 @@ def render_report(report: Dict[str, Any]) -> str:
             f" p99={fmt(js['p99'])} max={fmt(js['max'])}"
             f"  queue p95={fmt(qs['p95'])}"
         )
+    def fmt_opt(v):
+        return "-" if v is None else f"{v:.3f}"
+
+    for title, key in (
+        ("per-priority", "per_priority"), ("per-tenant", "per_tenant")
+    ):
+        rows = report.get(key) or {}
+        if not rows:
+            continue
+        lines.append("")
+        lines.append(f"{title} (docs/SERVING.md fair-share runbook):")
+        for name, row in rows.items():
+            lines.append(
+                f"  {name}  done={row['done']} failed={row['failed']}"
+                f" cancelled={row['cancelled']} shed={row['shed']}"
+                f" queue p95={fmt_opt(row['queue_wait_p95'])}"
+                f" (n={row['queue_wait_count']})"
+            )
     per_worker = report.get("per_worker") or {}
     if per_worker:
         lines.append("")

@@ -396,6 +396,16 @@ class StreamingSweep:
         self._init = jax.jit(
             init_state_fn, out_shardings=dict(self._state_shardings)
         )
+        # Fused (batch-axis) block programs, keyed by batch width k:
+        # jit(vmap(step)) over a leading job axis — the serve fusion
+        # path (serve/sched/fusion.py) streams k same-bucket datasets
+        # through ONE device program per block, amortizing dispatch
+        # exactly like cluster_batch amortizes resamples.  Compiled
+        # lazily per width; bit-identity with solo execution is the
+        # parity gate (tests/test_sched.py) — vmap batches the same
+        # integer-count accumulation, so each job's lane is the solo
+        # program's arithmetic unchanged.
+        self._fused_steps: Dict[int, Any] = {}
         # The accumulator invariant sentinel (resilience.integrity),
         # compiled lazily on the first checked block so runs with
         # integrity_check_every=0 never pay its trace/compile.
@@ -986,6 +996,316 @@ class StreamingSweep:
             "compiled_memory": dict(self._compiled_memory or {}),
         }
         return out
+
+    # -- fused (batch-axis) driver ---------------------------------------
+
+    def _get_fused_step(self, k: int):
+        """``jit(vmap(step))`` over a leading job axis of width ``k``,
+        cached per width.  The vmapped operand is the SAME bound step
+        the solo driver dispatches — one implementation, so the fused
+        program cannot drift from the solo one it must match bit for
+        bit.  No state donation on the fused path (the per-job
+        checkpoint slices below read the carried state after the next
+        dispatch is built)."""
+        fused = self._fused_steps.get(k)
+        if fused is None:
+            fused = jax.jit(jax.vmap(
+                self._step,
+                in_axes=({"mij": 0, "iij": 0}, 0, 0, None, None),
+            ))
+            self._fused_steps[k] = fused
+        return fused
+
+    def run_fused(
+        self,
+        xs: List[np.ndarray],
+        seeds: List[int],
+        n_iterations: int,
+        block_callback: Optional[
+            Callable[[int, int, int, List[float]], None]
+        ] = None,
+        checkpointers: Optional[List[Optional["StreamCheckpointer"]]] = None,
+        integrity_check_every: int = 0,
+        pad_to: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Stream k same-shape sweeps through ONE fused block program.
+
+        The serve fusion path (docs/SERVING.md "Fair-share & fusion
+        runbook"): ``xs``/``seeds`` are k independent jobs at the SAME
+        shape bucket and the SAME ``n_iterations``; each block
+        dispatches a single ``jit(vmap(step))`` program over a leading
+        job axis, so k datasets pay one device dispatch per block
+        instead of k.  Per-job outputs are the solo driver's, bit for
+        bit — the vmapped lanes run the identical integer-count
+        arithmetic — which is the PARITY GATE fusion rests on
+        (tests/test_sched.py pins fused-vs-solo ``result_fingerprint``
+        byte-identity, including resume from fused-written frames).
+
+        Deliberately narrower than :meth:`run` (the fusion planner only
+        fuses jobs inside these bounds; anything else degrades to solo):
+
+        - no adaptive early stop (per-job stop decisions would desync
+          the shared block loop);
+        - no resume (``checkpointers`` are write-only here: each job's
+          ring gets the same per-block frames a solo run would write —
+          verified resume of those frames happens in a SOLO retry);
+        - per-job sentinel checks at ``integrity_check_every`` (each
+          job's state slice through the same compiled sentinel); a
+          breach aborts the whole batch — the solo retry isolates it.
+
+        ``block_callback(job_idx, block, h_done, pac_list)`` fires per
+        job per evaluated block.  Returns one :meth:`run`-shaped host
+        dict per job (curves + ``streaming`` + ``timing``).
+
+        ``pad_to`` (the serve executor passes its ``fusion_max``) pads
+        the batch to ONE canonical width with copies of job 0's data:
+        each distinct width is a distinct ``jit(vmap)`` compile — a
+        near-solo-sized cost — and without padding a service would pay
+        it once per batch size the queue happens to produce.  Padded
+        lanes' outputs are discarded (their compute had no other
+        customer: a below-width batch means the queue lacked mates),
+        and padding cannot affect parity — each lane's arithmetic is
+        independent under vmap.
+        """
+        k = len(xs)
+        if k < 2:
+            raise ValueError(f"run_fused needs >= 2 jobs, got {k}")
+        if len(seeds) != k:
+            raise ValueError("xs and seeds must align")
+        if checkpointers is not None and len(checkpointers) != k:
+            raise ValueError("checkpointers must align with xs")
+        if n_iterations < 1:
+            raise ValueError(
+                f"n_iterations must be >= 1, got {n_iterations}"
+            )
+        config = self.config
+        shape = (config.n_samples, config.n_features)
+        for x in xs:
+            if tuple(x.shape) != shape:
+                raise ValueError(
+                    f"fused job shape {tuple(x.shape)} != engine shape "
+                    f"{shape}"
+                )
+        integrity_check_every = int(integrity_check_every)
+        checkpointers = checkpointers or [None] * k
+
+        # One compiled width per bucket (see the docstring): pad the
+        # batch with copies of job 0; lanes >= k are pure ballast.
+        kp = max(k, int(pad_to)) if pad_to else k
+        pad_xs = list(xs) + [xs[0]] * (kp - k)
+        pad_seeds = list(seeds) + [seeds[0]] * (kp - k)
+        fused_step = self._get_fused_step(kp)
+        xb = jnp.stack([
+            jnp.asarray(x, jnp.dtype(config.dtype)) for x in pad_xs
+        ])
+        keys = jnp.stack([
+            jax.random.PRNGKey(int(s)) for s in pad_seeds
+        ])
+        h_total = jnp.int32(n_iterations)
+        n_blocks = -(-n_iterations // self._hb_pad)
+        state = {
+            "mij": jnp.zeros(
+                (kp, self._nk_pad, self._n_pad, self._n_pad), jnp.int32
+            ),
+            "iij": jnp.zeros((kp, self._n_pad, self._n_pad), jnp.int32),
+        }
+
+        ckpt_fps: List[Optional[str]] = []
+        for i in range(k):
+            if checkpointers[i] is None:
+                ckpt_fps.append(None)
+                continue
+            # The same per-job fingerprint a solo run would write under
+            # (no adaptive on the fused path; the knobs hash at their
+            # off values) — so a solo retry resumes these frames.
+            ckpt_fps.append(stream_fingerprint(
+                config, int(seeds[i]),
+                data_fingerprint(np.asarray(xs[i])),
+                n_iterations=int(n_iterations),
+                adaptive_tol=None,
+                adaptive_patience=config.adaptive_patience,
+                adaptive_min_h=config.adaptive_min_h,
+            ))
+
+        t0 = time.perf_counter()
+        trajectories: List[List[List[float]]] = [[] for _ in range(k)]
+        result_curves: List[Optional[Dict[str, np.ndarray]]] = (
+            [None] * k
+        )
+        # integrity_checks counts EVALUATIONS (k per checked block —
+        # the exception-path accounting for the whole batch);
+        # checked_blocks is the per-job count each result reports, so
+        # the scheduler summing per-job values recovers the total.
+        integrity_checks = 0
+        checked_blocks = 0
+
+        def check_due(b: int) -> bool:
+            if integrity_check_every <= 0:
+                return False
+            return (
+                b % integrity_check_every == integrity_check_every - 1
+                or b == n_blocks - 1
+            )
+
+        def evaluate(b: int, curves, snap, checks) -> None:
+            nonlocal integrity_checks, checked_blocks
+            if checks is not None:
+                checked_blocks += 1
+            h_done = min((b + 1) * self._hb_pad, n_iterations)
+            for i in range(k):
+                if checks is not None:
+                    integrity_checks += 1
+                    bad = {
+                        name: int(v)
+                        for name, v in checks[i].items()
+                        if int(v)
+                    }
+                    if bad:
+                        raise IntegrityError(
+                            "accumulator",
+                            f"integrity sentinel: fused job {i} block "
+                            f"{b} state violates the count invariants "
+                            f"({bad}) — corrupt accumulator; the batch "
+                            "aborts and every job retries solo from "
+                            "its last verified checkpoint",
+                            block=b,
+                            details=bad,
+                            checks_run=integrity_checks,
+                        )
+                host = {
+                    name: np.asarray(v[i])
+                    for name, v in curves.items()
+                }
+                result_curves[i] = host
+                trajectories[i].append(
+                    [float(v) for v in host["pac_area"]]
+                )
+                if block_callback is not None:
+                    block_callback(i, b, h_done, trajectories[i][-1])
+                if (
+                    checkpointers[i] is not None
+                    and snap is not None
+                    and checkpointers[i].due(b, n_blocks)
+                ):
+                    arrays = {
+                        name: v for name, v in snap[i].items()
+                    }
+                    arrays.update({
+                        f"curve_{name}": v for name, v in host.items()
+                    })
+                    checkpointers[i].write_async(
+                        {
+                            "fingerprint": ckpt_fps[i],
+                            "block_index": int(b),
+                            "h_done": int(h_done),
+                            "n_iterations": int(n_iterations),
+                            "trajectory": [
+                                list(row) for row in trajectories[i]
+                            ],
+                            "quiet": 0,
+                            "stopped": False,
+                            "written_at": round(time.time(), 3),
+                        },
+                        arrays,
+                    )
+
+        # Same double-buffered shape as the solo driver: dispatch block
+        # b+1, then evaluate block b's curves while it computes.
+        pending = None
+        try:
+            for b in range(n_blocks):
+                faults.fire("block_start", index=b)
+                state, curves = fused_step(
+                    state, xb, keys, jnp.int32(b * self._hb_pad), h_total
+                )
+                checks = None
+                if check_due(b):
+                    # Per-job sentinel on each state slice — the slices
+                    # are solo-shaped, so this reuses the one compiled
+                    # sentinel program.
+                    checks = [
+                        self._integrity_stats(
+                            {
+                                "mij": state["mij"][i],
+                                "iij": state["iij"][i],
+                            },
+                            min((b + 1) * self._hb_pad, n_iterations),
+                            b,
+                        )
+                        for i in range(k)
+                    ]
+                snap = None
+                if any(
+                    c is not None and c.due(b, n_blocks)
+                    for c in checkpointers
+                ):
+                    # Un-donated fused state: hand per-job device
+                    # slices straight to each writer thread, whose
+                    # np.asarray waits off the driver's critical path
+                    # (the solo driver's non-donate rule).
+                    snap = [
+                        {
+                            "state_mij": state["mij"][i],
+                            "state_iij": state["iij"][i],
+                        }
+                        for i in range(k)
+                    ]
+                if pending is not None:
+                    evaluate(*pending)
+                pending = (b, curves, snap, checks)
+            if pending is not None:
+                evaluate(*pending)
+        except BaseException as e:
+            try:
+                e.integrity_checks_run = integrity_checks
+            except Exception:  # noqa: BLE001 — accounting must never
+                pass  # mask the real failure
+            raise
+        finally:
+            for ckpt in checkpointers:
+                if ckpt is not None:
+                    ckpt.flush()
+
+        run_seconds = time.perf_counter() - t0  # jaxlint: disable=JL007 -- the barrier is evaluate()'s per-job np.asarray curves pull on the final pending block, same as the solo driver's
+        from consensus_clustering_tpu.utils.metrics import (
+            device_memory_stats,
+        )
+
+        device_mem = device_memory_stats()
+        outs: List[Dict[str, Any]] = []
+        for i in range(k):
+            out: Dict[str, Any] = dict(result_curves[i])
+            out["streaming"] = {
+                "h_block": int(config.stream_h_block),
+                "h_block_padded": int(self._hb_pad),
+                "h_requested": int(n_iterations),
+                "h_effective": int(n_iterations),
+                "n_blocks_run": len(trajectories[i]),
+                "stopped_early": False,
+                "pac_trajectory": trajectories[i],
+                "resumed_from_block": 0,
+                "checkpoint_writes": (
+                    checkpointers[i].writes_total
+                    if checkpointers[i] is not None else 0
+                ),
+                "integrity_checks": int(checked_blocks),
+                "integrity_check_every": int(integrity_check_every),
+            }
+            out["timing"] = {
+                # The fused wall covers all k jobs; per-job rate is
+                # reported over the SHARED wall (honest: that is what
+                # each job actually waited), with the batch width
+                # disclosed so consumers can compute amortized cost.
+                "run_seconds": run_seconds,
+                "resamples_per_second": (
+                    n_iterations * self._n_ks / max(run_seconds, 1e-9)
+                ),
+                "fused_batch": k,
+                "device_memory": device_mem,
+                "compiled_memory": dict(self._compiled_memory or {}),
+            }
+            outs.append(out)
+        return outs
 
 
 def run_streaming_sweep(

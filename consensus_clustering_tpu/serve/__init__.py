@@ -4,8 +4,14 @@ The serving subsystem over the batch API — see docs/SERVING.md:
 
 - :mod:`.jobstore`  — persistent dedup-by-fingerprint result store
 - :mod:`.executor`  — compile-cache-aware sweep executor (warm path)
-- :mod:`.scheduler` — bounded FIFO queue, timeout, retry/backoff, hang
+- :mod:`.scheduler` — bounded admission queue (weighted-fair DRR lanes
+  by default, FIFO control arm), timeout, retry/backoff, hang
   watchdog, crash-loop quarantine, memory preflight, overload shedding
+- :mod:`.sched`     — the fair-share subsystem (docs/SERVING.md
+  "Fair-share & fusion runbook"): tenant × priority DRR lanes with a
+  starvation clock, same-bucket job fusion (one device program for k
+  jobs, bit-identical to solo), and the SSE event bus behind
+  ``GET /jobs/<id>/events`` with client cancel
 - :mod:`.service`   — stdlib HTTP JSON API (POST /jobs, GET /jobs/<id>,
   /healthz, /metrics)
 - :mod:`.events`    — structured JSONL lifecycle events

@@ -31,7 +31,9 @@ while one worker of a shared-store fleet is wedged.
 (:mod:`consensus_clustering_tpu.obs.query`, docs/OBSERVABILITY.md
 "Query engine") over the service's JSONL event log: ``trace`` renders
 one job's lifecycle + span tree, ``report`` aggregates per-bucket
-p50/p95/p99 latency and retry/wedge/drift/SLO breakdowns over a time
+p50/p95/p99 latency, per-priority and per-tenant fair-share rows
+(docs/SERVING.md "Fair-share & fusion runbook"), and
+retry/wedge/drift/SLO breakdowns over a time
 range, and ``bundle`` cuts a shareable tar.gz capsule for one job
 (record, events slice, spans, rendered trace, optional live /metrics
 snapshot, environment fingerprint — NEVER the data matrix).  All three
@@ -285,7 +287,9 @@ def add_arguments(parser) -> None:
     )
     report = sub.add_parser(
         "report",
-        help="per-bucket p50/p95/p99 latency + retry/wedge/drift/SLO "
+        help="per-bucket p50/p95/p99 latency, per-priority and "
+        "per-tenant rows (done/failed/cancelled/shed/p95 queue-wait "
+        "— the fair-share lanes), and retry/wedge/drift/SLO "
         "breakdowns over a time range of the JSONL event log",
     )
     report.add_argument(

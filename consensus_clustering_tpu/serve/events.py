@@ -14,19 +14,25 @@ runbook": a merged log from several workers over one shared store must
 still attribute every attempt):
 
 - ``job_submitted``   — admission accepted (fields: job_id, fingerprint,
-  shape, cached, worker_id)
+  shape, cached, worker_id; non-cached admissions also carry
+  ``priority`` and ``tenant`` — the fair-share lane identity, which is
+  what lets ``serve-admin report`` aggregate per priority and per
+  tenant from the log alone)
 - ``job_started``     — worker picked the job up (job_id, attempt,
-  worker_id)
+  worker_id; ``fused=True`` when the job rides a fused device program)
 - ``h_block_complete``— a streamed H-block's curves landed (job_id,
-  block, h_done, pac_area): the per-block progress of the streaming
-  sweep engine, the signs-of-life signal for a long job
+  block, h_done, pac_area; ``fused=True`` on fused executions): the
+  per-block progress of the streaming sweep engine, the signs-of-life
+  signal for a long job — also streamed live to SSE subscribers of
+  ``GET /jobs/<id>/events``
 - ``k_batch_complete``— per-K PAC at sweep completion (job_id, k, pac);
   emitted host-side by the executor once per K (the streaming driver
   owns the final curves, so no staged debug callback is involved)
 - ``job_done``        — result stored (job_id, fingerprint, seconds,
   worker_id, bucket — the calibration shape-bucket string, so the
   offline query engine can group latency per bucket; ``cached=True``
-  instead of seconds when served by late dedup)
+  instead of seconds when served by late dedup; ``fused=True`` +
+  ``fusion_k`` when the result rode a fused device program)
 - ``job_retry``       — transient failure, will re-run (job_id, attempt,
   backoff_seconds, error, worker_id)
 - ``job_failed``      — permanent failure / retries exhausted / timeout
@@ -47,8 +53,23 @@ Hostile-path events (docs/SERVING.md "Overload & wedge runbook"):
   (fingerprint, shape, estimated_bytes, budget_bytes, worker_id);
   HTTP 413
 - ``job_shed``        — admission refused by the overload shed policy
-  (fingerprint, priority, reason, queue_depth, worker_id); HTTP 429 +
-  Retry-After
+  (fingerprint, priority, tenant, reason, queue_depth,
+  retry_after_seconds — derived from the live queue drain rate,
+  worker_id); HTTP 429 + Retry-After
+
+Fair-share / fusion / streamed-results events (docs/SERVING.md
+"Fair-share & fusion runbook"):
+
+- ``fusion_executed`` — k same-bucket jobs ran through ONE fused
+  device program (job_ids, bucket, k, seconds, worker_id); each job
+  still gets its own ``job_done`` with ``fused=True`` + ``fusion_k``,
+  and per-job results are bit-identical to solo execution (the parity
+  gate)
+- ``job_cancelled``   — the client cancelled the job (job_id, reason:
+  client_cancel | sse_disconnect, stage: queued | running, worker_id;
+  bucket + ``fused=True`` when it was already running): terminal like
+  ``done`` — lease released, checkpoint ring cleared, payload dropped,
+  the worker slot freed at the next block boundary
 - ``estimator_selected`` — a ``mode=auto`` admission resolved onto the
   sampled-pair estimator because only its O(M) footprint fit the
   memory budget (shape, exact_bytes, estimator_bytes, budget_bytes,

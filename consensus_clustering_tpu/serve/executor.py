@@ -36,9 +36,10 @@ import contextlib
 import dataclasses
 import hashlib
 import json
+import re
 import threading
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -62,9 +63,13 @@ _CONFIG_KEYS = frozenset(
         "clusterer_options", "bins", "pac_interval", "parity_zeros",
         "analysis", "delta_k_threshold", "dtype", "chunk_size",
         "stream_h_block", "adaptive_tol", "adaptive_patience",
-        "adaptive_min_h", "priority", "mode", "n_pairs",
+        "adaptive_min_h", "priority", "mode", "n_pairs", "tenant",
     }
 )
+
+# Tenant names are lane keys, /metrics labels and JSONL fields; keep
+# them to a filename-and-label-safe alphabet.
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 #: Admission priorities, highest first — the overload shed policy's
 #: vocabulary (docs/SERVING.md "Overload & wedge runbook").
@@ -135,6 +140,13 @@ class JobSpec:
     # hint, never part of the result: excluded from the fingerprint (a
     # resubmission at another priority must dedup) and from the bucket.
     priority: str = "normal"
+    # Fair-share lane identity (docs/SERVING.md "Fair-share & fusion
+    # runbook"): which tenant's queue lane this job rides.  Excluded
+    # from the fingerprint AND the bucket exactly like priority — the
+    # same job submitted by two tenants is the same result and must
+    # dedup as such.  The HTTP layer can also inject it from a header
+    # (serve --tenant-header), overriding the config field.
+    tenant: str = "default"
     # Consensus execution mode (config.ESTIMATOR_MODES): "exact" (the
     # dense engine), "estimate" (the sampled-pair estimator —
     # consensus_clustering_tpu.estimator — O(M) state, disclosed PAC
@@ -162,6 +174,7 @@ class JobSpec:
         payload = dataclasses.asdict(self)
         payload.pop("chunk_size")
         payload.pop("priority")
+        payload.pop("tenant")
         payload["k_values"] = list(self.k_values)
         payload["pac_interval"] = list(self.pac_interval)
         payload["clusterer_options"] = dict(self.clusterer_options)
@@ -374,6 +387,12 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
             f"config.priority must be one of {list(PRIORITIES)}, got "
             f"{priority!r}"
         )
+    tenant = cfg.get("tenant", "default")
+    if not isinstance(tenant, str) or not _TENANT_RE.match(tenant):
+        raise JobSpecError(
+            "config.tenant must be 1-64 chars of [A-Za-z0-9._-], got "
+            f"{tenant!r}"
+        )
     from consensus_clustering_tpu.config import ESTIMATOR_MODES
 
     mode = cfg.get("mode", "exact")
@@ -419,6 +438,7 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         adaptive_patience=_int("adaptive_patience", 2, 1, 1000),
         adaptive_min_h=_int("adaptive_min_h", 0, 0, 100_000),
         priority=priority,
+        tenant=tenant,
         mode=mode,
         n_pairs=n_pairs,
     )
@@ -850,11 +870,6 @@ class SweepExecutor:
         ``jax.profiler`` trace (the ``serve-admin profile-next``
         one-shot).
         """
-        from consensus_clustering_tpu.ops.analysis import (
-            area_under_cdf,
-            delta_k,
-            select_best_k,
-        )
         from consensus_clustering_tpu.serve.watchdog import (
             PHASE_ENGINE_READY,
         )
@@ -1177,11 +1192,66 @@ class SweepExecutor:
                     host["estimator"]["n_pairs"]
                 )
 
+        memory_block = {
+            "estimated_bytes": int(estimate["total_bytes"]),
+            # The gating model's breakdown — keys differ by mode
+            # (the estimator model has pair terms, no N² workspace).
+            "estimate": {
+                key: value
+                for key, value in estimate.items()
+                if key not in ("total_bytes", "model")
+            },
+            "compiled": compiled_mem,
+            "device_before": mem_before,
+            "device_after": mem_after,
+            "peak_delta_bytes": peak_delta,
+            "peak_masked": peak_masked,
+            "measured_bytes": measured_bytes,
+            "measurement_source": mem_source,
+            "preflight_accuracy": accuracy,
+        }
+        result = self._shape_result(
+            spec, n, d, host, resolution, compile_seconds, cached,
+            run_seconds, memory_block,
+        )
+        if progress_cb is not None and _live():
+            for k in result["K"]:
+                progress_cb(int(k), float(result["pac_area"][str(k)]))
+        return result
+
+    def _shape_result(
+        self,
+        spec: JobSpec,
+        n: int,
+        d: int,
+        host: Dict[str, Any],
+        resolution,
+        compile_seconds: float,
+        cached: bool,
+        run_seconds: float,
+        memory_block: Dict[str, Any],
+        fused_k: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Shape one engine host dict into the JSON-able job result.
+
+        The ONE implementation for both the solo and the fused paths —
+        fusion's parity gate (per-job results bit-identical to solo,
+        docs/SERVING.md "Fair-share & fusion runbook") rests on the
+        semantic block and its fingerprint being computed by exactly
+        this code whatever the execution vehicle.  ``fused_k`` (the
+        batch width) discloses how the result was produced; it rides
+        OUTSIDE the semantic block, like timings, because fusion never
+        changes an answer.
+        """
+        from consensus_clustering_tpu.ops.analysis import (
+            area_under_cdf,
+            delta_k,
+            select_best_k,
+        )
+
+        streaming = host["streaming"]
         ks = list(spec.k_values)
         pac = [float(v) for v in host["pac_area"]]
-        if progress_cb is not None and _live():
-            for k, p in zip(ks, pac):
-                progress_cb(int(k), float(p))
         areas = np.asarray(
             [float(area_under_cdf(host["cdf"][i])) for i in range(len(ks))]
         )
@@ -1229,6 +1299,13 @@ class SweepExecutor:
                 {"estimator": dict(host["estimator"])}
                 if spec.mode == "estimate" else {}
             ),
+            **(
+                # How the result was produced, never what it is: the
+                # batch width of the fused device program this job rode
+                # (docs/SERVING.md "Fair-share & fusion runbook").
+                {"fused": {"batch": int(fused_k)}}
+                if fused_k else {}
+            ),
             "backend": self.backend(),
             "result_fingerprint": result_fingerprint,
             # How the block size was chosen (ROADMAP's never-silent
@@ -1248,24 +1325,7 @@ class SweepExecutor:
             # deliberately over-counts, so healthy values sit above 1
             # once N² dominates — tiny shapes sit below, XLA's lane
             # temps being the part the model ignores).
-            "memory": {
-                "estimated_bytes": int(estimate["total_bytes"]),
-                # The gating model's breakdown — keys differ by mode
-                # (the estimator model has pair terms, no N² workspace).
-                "estimate": {
-                    key: value
-                    for key, value in estimate.items()
-                    if key not in ("total_bytes", "model")
-                },
-                "compiled": compiled_mem,
-                "device_before": mem_before,
-                "device_after": mem_after,
-                "peak_delta_bytes": peak_delta,
-                "peak_masked": peak_masked,
-                "measured_bytes": measured_bytes,
-                "measurement_source": mem_source,
-                "preflight_accuracy": accuracy,
-            },
+            "memory": memory_block,
             "streaming": {
                 "h_block": int(streaming["h_block"]),
                 "h_requested": int(streaming["h_requested"]),
@@ -1297,3 +1357,200 @@ class SweepExecutor:
                 "executable_cached": cached,
             },
         }
+
+    def run_fused(
+        self,
+        specs: List[JobSpec],
+        xs: List[np.ndarray],
+        block_cbs: Optional[List[Optional[Callable]]] = None,
+        checkpoint_dirs: Optional[List[Optional[str]]] = None,
+        heartbeat=None,
+        pad_to: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Execute k same-bucket jobs through ONE fused device program
+        (docs/SERVING.md "Fair-share & fusion runbook").
+
+        The caller (the scheduler's fusion path, planned by
+        serve/sched/fusion.py) guarantees eligibility: equal buckets,
+        equal ``n_iterations``, exact mode, no adaptive stop, distinct
+        fingerprints, empty checkpoint rings.  This method validates
+        the invariants cheaply and delegates the block loop to
+        :meth:`StreamingSweep.run_fused` on the bucket's warm engine —
+        per-job results are shaped by the SAME ``_shape_result`` the
+        solo path uses, so fused and solo answers cannot drift.
+
+        Per-job checkpoint rings receive the frames a solo run would
+        write (bit-identical state — the parity gate), so any failure
+        degrades to solo retries that resume the fused attempt's
+        progress.  The drift ledger, block-seconds EWMA and memory
+        accountant are deliberately NOT fed from fused blocks: a fused
+        block's wall covers k jobs and would poison every solo-derived
+        expectation keyed by the same bucket; ``hist_block_seconds``
+        observes once per fused block (it measures block completions,
+        and a fused block is one).
+        """
+        k = len(specs)
+        if k < 2:
+            raise ValueError(f"run_fused needs >= 2 jobs, got {k}")
+        if len(xs) != k:
+            raise ValueError("specs and xs must align")
+        if block_cbs is not None and len(block_cbs) != k:
+            raise ValueError("block_cbs must align with specs")
+        if checkpoint_dirs is not None and len(checkpoint_dirs) != k:
+            raise ValueError("checkpoint_dirs must align with specs")
+        n, d = (int(v) for v in xs[0].shape)
+        first = specs[0]
+        resolution = self._resolve_h_block(first, n, d)
+        bucket_key = first.bucket(n, d, resolution.value)
+        for spec, x in zip(specs, xs):
+            if tuple(int(v) for v in x.shape) != (n, d):
+                raise ValueError("fused jobs must share one data shape")
+            if spec.mode != "exact" or spec.adaptive_tol is not None:
+                raise ValueError(
+                    "fused jobs must be exact-mode, non-adaptive"
+                )
+            if spec.n_iterations != first.n_iterations:
+                raise ValueError("fused jobs must share n_iterations")
+            if spec.bucket(n, d, resolution.value) != bucket_key:
+                raise ValueError("fused jobs must share one bucket")
+        engine, compile_seconds, cached, resolution = self._get_engine(
+            first, n, d
+        )
+        if not hasattr(engine, "run_fused"):
+            raise ValueError(
+                "the bucket's engine does not support fusion"
+            )
+        from consensus_clustering_tpu.serve.watchdog import (
+            PHASE_ENGINE_READY,
+        )
+
+        if heartbeat is not None:
+            heartbeat.beat(PHASE_ENGINE_READY)
+
+        with self._lock:
+            self._cb_gen += 1
+            gen = self._cb_gen
+
+        def _live() -> bool:
+            with self._lock:
+                return self._cb_gen == gen
+
+        checkpointers: List[Optional[Any]] = [None] * k
+        if checkpoint_dirs is not None:
+            from consensus_clustering_tpu.resilience.blocks import (
+                StreamCheckpointer,
+            )
+
+            def on_ckpt_write(seconds, block):
+                del block
+                self.hist_checkpoint_write_seconds.observe(seconds)
+
+            for i, ckpt_dir in enumerate(checkpoint_dirs):
+                if ckpt_dir is None:
+                    continue
+                checkpointers[i] = StreamCheckpointer(
+                    ckpt_dir,
+                    every=self.checkpoint_every,
+                    keep=ring_keep(
+                        self.integrity_check_every, self.checkpoint_every
+                    ),
+                    on_write=on_ckpt_write,
+                )
+
+        last_block = [-1]
+        last_block_at = [time.monotonic()]
+
+        def fused_block_cb(job_idx, block, h_done, pac_list):
+            if not _live():
+                return
+            if block != last_block[0]:
+                # Once per FUSED block (k per-job callbacks share it):
+                # heartbeat + the block-latency histogram.  The EWMA
+                # and drift ledger stay unfed — see the docstring.
+                last_block[0] = block
+                now = time.monotonic()
+                self.hist_block_seconds.observe(now - last_block_at[0])
+                last_block_at[0] = now
+                if heartbeat is not None:
+                    heartbeat.beat(f"block:{block}")
+            if block_cbs is not None and block_cbs[job_idx] is not None:
+                block_cbs[job_idx](block, h_done, pac_list)
+
+        try:
+            t0 = time.perf_counter()
+            hosts = engine.run_fused(
+                xs,
+                seeds=[int(spec.seed) for spec in specs],
+                n_iterations=int(first.n_iterations),
+                block_callback=fused_block_cb,
+                checkpointers=checkpointers,
+                integrity_check_every=self.integrity_check_every,
+                # One compiled width per bucket: batches below the
+                # planner's cap pad with ballast lanes instead of
+                # compiling a fresh vmap program per width.
+                pad_to=pad_to,
+            )
+            run_seconds = time.perf_counter() - t0
+        finally:
+            with self._lock:
+                self.run_count += k
+                for ckpt in checkpointers:
+                    if ckpt is None:
+                        continue
+                    self.checkpoint_writes_total += ckpt.writes_total
+                    self.checkpoint_resume_total += ckpt.resumes_total
+                    self.checkpoint_verify_rejects_total += (
+                        ckpt.verify_rejects
+                    )
+            for ckpt in checkpointers:
+                if ckpt is not None:
+                    ckpt.close()
+
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_job_bytes,
+        )
+
+        results: List[Dict[str, Any]] = []
+        for spec, host in zip(specs, hosts):
+            estimate = estimate_job_bytes(
+                n, d, spec.k_values,
+                dtype=spec.dtype,
+                h_block=int(resolution.value),
+                subsampling=spec.subsampling,
+                checkpoints=checkpoint_dirs is not None,
+            )
+            # The model estimate is free; measured fields are null —
+            # a fused attempt's allocator delta covers k jobs, and a
+            # per-job attribution would be invented, not measured.
+            memory_block = {
+                "estimated_bytes": int(estimate["total_bytes"]),
+                "estimate": {
+                    key: value
+                    for key, value in estimate.items()
+                    if key not in ("total_bytes", "model")
+                },
+                "compiled": {},
+                "device_before": {},
+                "device_after": {},
+                "peak_delta_bytes": None,
+                "peak_masked": False,
+                "measured_bytes": None,
+                "measurement_source": None,
+                "preflight_accuracy": None,
+            }
+            results.append(self._shape_result(
+                spec, n, d, host, resolution, compile_seconds, cached,
+                run_seconds, memory_block, fused_k=k,
+            ))
+        with self._lock:
+            for spec, host in zip(specs, hosts):
+                self.h_requested_total += int(spec.n_iterations)
+                self.h_effective_total += int(
+                    host["streaming"]["h_effective"]
+                )
+                self.autotune_provenance[resolution.provenance] = (
+                    self.autotune_provenance.get(
+                        resolution.provenance, 0
+                    ) + 1
+                )
+        return results

@@ -1,10 +1,17 @@
-"""Bounded FIFO job scheduler: admission control, timeout, retry.
+"""Bounded job scheduler: fair-share admission, timeout, retry.
 
 The service's backpressure layer.  A single worker thread drains a
-bounded ``queue.Queue``; a full queue rejects the submission at
+bounded admission queue — weighted-fair DRR lanes over tenant ×
+priority by default (:mod:`~consensus_clustering_tpu.serve.sched.
+fairshare`; ``schedule="fifo"`` keeps the historical FIFO as the
+measurable control arm) — and a full queue rejects the submission at
 admission time (the HTTP layer maps :class:`QueueFull` to 429) instead
 of buffering unboundedly — on a box where one sweep can take minutes,
-an unbounded queue is an OOM with extra steps.
+an unbounded queue is an OOM with extra steps.  With ``fusion_max >=
+2`` the worker fuses runnable same-bucket jobs into one device program
+(docs/SERVING.md "Fair-share & fusion runbook"), and every job's
+per-block progress is fanned out live over the SSE bus with client
+cancel as a terminal state.
 
 Each job runs with:
 
@@ -122,6 +129,19 @@ from consensus_clustering_tpu.serve.preflight import (
     estimate_estimator_bytes,
     estimate_job_bytes,
 )
+from consensus_clustering_tpu.serve.sched.fairshare import (
+    FairShareQueue,
+)
+from consensus_clustering_tpu.serve.sched.fusion import (
+    MAX_FUSE_HARD_CAP,
+    fusion_key,
+    partition_batch,
+    ring_is_empty,
+)
+from consensus_clustering_tpu.serve.sched.stream import (
+    JobCancelled,
+    JobEventBus,
+)
 from consensus_clustering_tpu.serve.watchdog import (
     Heartbeat,
     JobWedged,
@@ -140,10 +160,21 @@ class QueueShed(Exception):
     ``Retry-After``): the service is protecting higher-priority
     traffic, not full — retrying after the hint is expected to land."""
 
-    def __init__(self, priority: str, reason: str, retry_after: float):
+    def __init__(
+        self,
+        priority: str,
+        reason: str,
+        retry_after: float,
+        basis: Optional[Dict[str, Any]] = None,
+    ):
         self.priority = priority
         self.reason = reason
         self.retry_after = retry_after
+        # How the Retry-After was derived (docs/SERVING.md "Fair-share
+        # & fusion runbook"): the live queue-drain arithmetic, disclosed
+        # in the 429 body so a client can see the hint is evidence, not
+        # a constant.
+        self.basis = dict(basis or {})
         super().__init__(
             f"shedding {priority}-priority admission ({reason}); "
             f"retry after {retry_after:.0f}s"
@@ -248,7 +279,9 @@ _ZERO_MEMORY = MemoryAccountant(enabled=False)
 # "quarantined" is terminal for the SCHEDULER (never auto-requeued) but
 # deliberately keeps its payload + checkpoint ring — see _update and
 # the jobstore's orphan-payload sweep.
-_TERMINAL = frozenset({"done", "failed", "timeout", "quarantined"})
+_TERMINAL = frozenset(
+    {"done", "failed", "timeout", "quarantined", "cancelled"}
+)
 
 
 class JobTimeout(Exception):
@@ -288,6 +321,11 @@ class Scheduler:
         leases: bool = True,
         lease_ttl: float = 60.0,
         lease_sweep: Optional[float] = None,
+        schedule: str = "fair",
+        fusion_max: int = 1,
+        priority_weights: Optional[Dict[str, float]] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        starvation_seconds: float = 30.0,
     ):
         if quarantine_after < 1:
             raise ValueError(
@@ -295,6 +333,23 @@ class Scheduler:
             )
         if lease_ttl <= 0:
             raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
+        if schedule not in ("fair", "fifo"):
+            raise ValueError(
+                f"schedule must be 'fair' or 'fifo', got {schedule!r}"
+            )
+        if not 1 <= int(fusion_max) <= MAX_FUSE_HARD_CAP:
+            raise ValueError(
+                f"fusion_max must be in [1, {MAX_FUSE_HARD_CAP}], got "
+                f"{fusion_max}"
+            )
+        if fusion_max > 1 and schedule != "fair":
+            # Fusion plans over the fair queue's take_matching; the
+            # FIFO control arm exists to MEASURE what fair-share buys,
+            # and fusing inside it would blur exactly that comparison.
+            raise ValueError(
+                "fusion requires schedule='fair' (the FIFO arm is the "
+                "unfused control)"
+            )
         self.executor = executor
         self.store = store
         self.events = events or EventLog(None)
@@ -348,7 +403,37 @@ class Scheduler:
         )
         self._lease_thread: Optional[threading.Thread] = None
         self._sleep = sleep  # injectable so retry tests need not wait
-        self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
+        # The admission queue: weighted-fair DRR lanes over tenant ×
+        # priority by default (docs/SERVING.md "Fair-share & fusion
+        # runbook"), or the historical bounded FIFO as the measurable
+        # control arm (--schedule fifo).  Both enforce the same global
+        # capacity at admission.
+        self.schedule = schedule
+        self.fusion_max = int(fusion_max)
+        if schedule == "fair":
+            self._queue: Any = FairShareQueue(
+                maxsize=max_queue,
+                priority_weights=priority_weights,
+                tenant_weights=tenant_weights,
+                starvation_seconds=starvation_seconds,
+            )
+        else:
+            self._queue = queue.Queue(maxsize=max_queue)
+        # Fusion-eligibility keys per queued job (serve/sched/fusion.py)
+        # — computed at admission, popped with the rest of the per-job
+        # state.  Only maintained when fusion can actually trigger.
+        self._fusion_keys: Dict[str, Optional[str]] = {}
+        # Live SSE fan-out (serve/sched/stream.py): per-block progress
+        # + terminal transitions, published from the worker's callback
+        # paths; the HTTP layer subscribes per stream.
+        self.bus = JobEventBus()
+        # Client-cancel state: flags checked from the per-block
+        # callback of a RUNNING attempt (the cancel lands at the next
+        # block boundary — a compiled block cannot be interrupted).
+        self._cancel_flags: Dict[str, threading.Event] = {}
+        # Worker-terminal timestamps inside the drain window — the
+        # evidence the dynamic Retry-After derives from.
+        self._drain_times: List[float] = []
         self._jobs: Dict[str, Dict[str, Any]] = {}
         # Spec + data ride outside the job record: records mirror to the
         # jobstore as JSON and must stay serialisable.
@@ -393,6 +478,17 @@ class Scheduler:
         self.integrity_violations_total: Dict[str, int] = {
             p: 0 for p in INTEGRITY_POINTS
         }
+        # Fair-share / fusion / streamed-results counters (docs/
+        # SERVING.md "Fair-share & fusion runbook"), pre-seeded like
+        # everything /metrics dict-copies: fused device programs run,
+        # jobs completed by riding one, fused attempts degraded to
+        # solo, client cancels, and the SSE surface.
+        self.fused_executions_total = 0
+        self.fused_jobs_total = 0
+        self.fusion_degraded_total = 0
+        self.jobs_cancelled_total = 0
+        self.sse_streams_total = 0
+        self.sse_cancels_total = 0
         self.cache_hits = 0
         # Retries by classify_error reason ({"injected": 1, "oom": 2,
         # ...}) — the /metrics retry_total{reason} satellite.
@@ -474,6 +570,130 @@ class Scheduler:
     def _span_sink(self, payload: Dict[str, Any]) -> None:
         self.events.emit("span", **payload)
 
+    #: Seconds of worker-terminal history the dynamic Retry-After
+    #: derives its drain rate from.
+    _DRAIN_WINDOW_SECONDS = 120.0
+
+    def _enqueue(self, job_id: str, spec: JobSpec) -> None:
+        """Queue a runnable job on its fair-share lane (tenant ×
+        priority) — or the FIFO, under the control schedule."""
+        if self.schedule == "fair":
+            self._queue.put_nowait(
+                job_id,
+                tenant=getattr(spec, "tenant", "default"),
+                priority=spec.priority,
+            )
+        else:
+            self._queue.put_nowait(job_id)
+
+    def _note_drain(self) -> None:
+        """One job left the worker (any terminal outcome): the drain
+        evidence behind the dynamic Retry-After."""
+        now = time.time()
+        with self._lock:
+            self._drain_times.append(now)
+            cutoff = now - self._DRAIN_WINDOW_SECONDS
+            if self._drain_times and self._drain_times[0] < cutoff:
+                self._drain_times = [
+                    t for t in self._drain_times if t >= cutoff
+                ]
+
+    def _retry_after(self) -> tuple:
+        """(seconds, basis) for a shed 429's Retry-After: current
+        backlog over the measured drain rate, floored at the static
+        ``--shed-retry-after`` (the cold-start answer when nothing has
+        drained yet), capped at 600 s.  The basis dict is disclosed in
+        the 429 body — the hint is evidence, not a constant."""
+        floor = (
+            self.shed_policy.retry_after
+            if self.shed_policy is not None else 15.0
+        )
+        now = time.time()
+        with self._lock:
+            drained = [
+                t for t in self._drain_times
+                if now - t <= self._DRAIN_WINDOW_SECONDS
+            ]
+        depth = self._queue.qsize()
+        basis: Dict[str, Any] = {
+            "queue_depth": depth,
+            "floor_seconds": floor,
+            "window_seconds": self._DRAIN_WINDOW_SECONDS,
+            "drained_in_window": len(drained),
+        }
+        if not drained:
+            basis["drain_rate_per_s"] = None
+            basis["derived"] = False
+            return float(floor), basis
+        rate = len(drained) / self._DRAIN_WINDOW_SECONDS
+        value = min(600.0, max(float(floor), depth / rate))
+        basis["drain_rate_per_s"] = round(rate, 4)
+        basis["derived"] = True
+        return value, basis
+
+    def note_sse_stream(self) -> None:
+        with self._lock:
+            self.sse_streams_total += 1
+
+    def cancel(
+        self, job_id: str, reason: str = "client_cancel"
+    ) -> Optional[Dict[str, Any]]:
+        """Client cancel (docs/SERVING.md "Fair-share & fusion
+        runbook"): a QUEUED job terminalises immediately; a RUNNING
+        one gets its cancel flag set and terminalises at the next
+        block boundary (a compiled block cannot be interrupted — one
+        block is the cancel latency).  Terminal like ``done``: lease
+        released, checkpoint ring cleared, payload dropped, the worker
+        slot freed.  Returns the job's record (possibly already
+        terminal), or None for an unknown id."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+            queued = job_id in self._specs
+            if record is not None and not queued:
+                # Picked up: flag the running attempt; the per-block
+                # callback raises JobCancelled at the next boundary.
+                flag = self._cancel_flags.get(job_id)
+                if flag is None:
+                    flag = self._cancel_flags[job_id] = threading.Event()
+                flag.set()
+            if queued:
+                # Take the spec/data now, under the lock: the worker's
+                # pickup pops the same keys, so exactly one of us wins.
+                self._specs.pop(job_id, None)
+                self._data.pop(job_id, None)
+                self._fusion_keys.pop(job_id, None)
+        if record is None:
+            return self.store.load_job(job_id)
+        if queued:
+            # Free the admission slot too: the queue entry would
+            # otherwise keep counting against the global capacity
+            # (429-ing fresh work) until the worker eventually pops
+            # the ghost.  Fair queue only — the FIFO control arm has
+            # no removal primitive, and its worker skips the terminal
+            # ghost at pickup either way.
+            if self.schedule == "fair":
+                self._queue.take_matching(
+                    lambda queued_id: queued_id == job_id, 1
+                )
+            with self._lock:
+                self.jobs_cancelled_total += 1
+                if reason == "sse_disconnect":
+                    self.sse_cancels_total += 1
+            snapshot = self._update(
+                job_id, status="cancelled",
+                error=f"cancelled before execution ({reason})",
+                finished_at=round(time.time(), 3),
+            )
+            self.events.emit(
+                "job_cancelled", job_id=job_id, reason=reason,
+                stage="queued", worker_id=self.worker_id,
+            )
+            return snapshot
+        if reason == "sse_disconnect":
+            with self._lock:
+                self.sse_cancels_total += 1
+        return self.get(job_id)
+
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
@@ -544,6 +764,8 @@ class Scheduler:
                 self._jobs.pop(job_id, None)
                 self._specs.pop(job_id, None)
                 self._data.pop(job_id, None)
+                self._fusion_keys.pop(job_id, None)
+                self._cancel_flags.pop(job_id, None)
             logger.warning(
                 "lease for job %s expired and was taken over by a peer; "
                 "local state dropped (any in-flight attempt will be "
@@ -568,6 +790,8 @@ class Scheduler:
             self._jobs.pop(job_id, None)
             self._specs.pop(job_id, None)
             self._data.pop(job_id, None)
+            self._fusion_keys.pop(job_id, None)
+            self._cancel_flags.pop(job_id, None)
         self.events.emit(
             "lease_refused", job_id=job_id, op=op,
             worker_id=self.worker_id, token=mine, newer_token=newest,
@@ -796,7 +1020,7 @@ class Scheduler:
                     # snapshot must never land after them.
                     self.store.save_job(dict(record))
                     try:
-                        self._queue.put_nowait(job_id)
+                        self._enqueue(job_id, spec)
                         requeued = True
                     except queue.Full:
                         # More orphans than queue slots: the overflow
@@ -895,6 +1119,7 @@ class Scheduler:
             "submitted_at": round(time.time(), 3),
             "attempt": 0,
             "priority": spec.priority,
+            "tenant": getattr(spec, "tenant", "default"),
         }
         cached = self.store.get_result(fp)
         if cached is not None:
@@ -917,10 +1142,20 @@ class Scheduler:
         self._preflight(spec, x, fp)
         self._shed_gate(spec, fp)
         record["from_cache"] = False
+        # Fusion eligibility is decided at admission (serve/sched/
+        # fusion.py): the key is what the worker's planner matches
+        # queued jobs on.  Only computed when fusion can trigger.
+        fuse_key = None
+        if self.fusion_max >= 2 and hasattr(self.executor, "run_fused"):
+            n, d = (int(v) for v in x.shape)
+            fuse_key = fusion_key(
+                spec, n, d, self._resolved_h_block(spec, n, d)
+            )
         with self._lock:
             self._jobs[job_id] = record
             self._specs[job_id] = spec
             self._data[job_id] = x
+            self._fusion_keys[job_id] = fuse_key
         # Persist the payload FIRST: from the moment the record is
         # visible as "queued", a crash must leave everything a restarted
         # process needs to re-queue the job (config + data), or the
@@ -936,6 +1171,7 @@ class Scheduler:
                 del self._jobs[job_id]
                 del self._specs[job_id]
                 del self._data[job_id]
+                self._fusion_keys.pop(job_id, None)
             self.store.delete_payload(job_id)  # any half-written part
             raise
         # Claim the job's lease BEFORE the record is mirrored: from the
@@ -955,6 +1191,7 @@ class Scheduler:
                     del self._jobs[job_id]
                     del self._specs[job_id]
                     del self._data[job_id]
+                    self._fusion_keys.pop(job_id, None)
                 self.store.delete_payload(job_id)
                 raise RuntimeError(
                     f"could not claim a lease for new job {job_id} — "
@@ -969,12 +1206,13 @@ class Scheduler:
         self.store.save_job(record)
         snapshot = dict(record)
         try:
-            self._queue.put_nowait(job_id)
+            self._enqueue(job_id, spec)
         except queue.Full:
             with self._lock:
                 del self._jobs[job_id]
                 del self._specs[job_id]
                 del self._data[job_id]
+                self._fusion_keys.pop(job_id, None)
             self.store.delete_job(job_id)
             self.store.delete_payload(job_id)
             if self.leases is not None:
@@ -985,6 +1223,8 @@ class Scheduler:
         self.events.emit(
             "job_submitted", job_id=job_id, fingerprint=fp,
             shape=record["shape"], cached=False,
+            priority=spec.priority,
+            tenant=getattr(spec, "tenant", "default"),
             worker_id=self.worker_id,
         )
         return snapshot
@@ -1185,14 +1425,18 @@ class Scheduler:
             self.jobs_shed_total[spec.priority] = (
                 self.jobs_shed_total.get(spec.priority, 0) + 1
             )
+        # Retry-After from the LIVE queue drain rate (floored at the
+        # static --shed-retry-after): a hint derived from evidence, and
+        # the basis rides the 429 body so the client can see it.
+        retry_after, basis = self._retry_after()
         self.events.emit(
             "job_shed", fingerprint=fp, priority=spec.priority,
+            tenant=getattr(spec, "tenant", "default"),
             reason=reason, queue_depth=self._queue.qsize(),
+            retry_after_seconds=round(retry_after, 3),
             worker_id=self.worker_id,
         )
-        raise QueueShed(
-            spec.priority, reason, self.shed_policy.retry_after
-        )
+        raise QueueShed(spec.priority, reason, retry_after, basis=basis)
 
     def get(self, job_id: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -1224,10 +1468,40 @@ class Scheduler:
         accountant = getattr(
             self.executor, "memory_accounting", _ZERO_MEMORY
         )
+        # Queue reads BEFORE taking our own lock: the fair queue has
+        # its own condition lock, and the fusion planner's
+        # take_matching holds it while reading pre-captured snapshots —
+        # never calling back into scheduler state — so the only safe
+        # lock order is queue-then-scheduler or neither-nested.
+        queue_depth = self._queue.qsize()
+        fair_lanes = (
+            self._queue.snapshot() if self.schedule == "fair" else {}
+        )
+        starvation_grants = (
+            self._queue.starvation_grants_total
+            if self.schedule == "fair" else 0
+        )
         with self._lock:
             return {
-                "queue_depth": self._queue.qsize(),
+                "queue_depth": queue_depth,
                 "queue_capacity": self._queue.maxsize,
+                # Fair-share scheduling (docs/SERVING.md "Fair-share &
+                # fusion runbook"): the active schedule, per-lane
+                # depths (lane keys are traffic-dynamic like
+                # retry_total), and starvation-clock grants.
+                "schedule": self.schedule,
+                "fair_lanes": fair_lanes,
+                "fair_starvation_grants_total": starvation_grants,
+                # Same-bucket fusion: fused device programs run, jobs
+                # that rode one, and fused attempts degraded to solo.
+                "fused_executions_total": self.fused_executions_total,
+                "fused_jobs_total": self.fused_jobs_total,
+                "fusion_degraded_total": self.fusion_degraded_total,
+                # Streamed partial results: SSE streams opened, client
+                # cancels (disconnect-triggered), jobs cancelled.
+                "jobs_cancelled_total": self.jobs_cancelled_total,
+                "sse_streams_total": self.sse_streams_total,
+                "sse_cancels_total": self.sse_cancels_total,
                 "jobs_completed": self.jobs_completed,
                 "jobs_failed": self.jobs_failed,
                 "jobs_retried": self.jobs_retried,
@@ -1349,8 +1623,13 @@ class Scheduler:
             # released job resume the lost progress.
             if snapshot.get("status") != "quarantined":
                 self.store.delete_payload(job_id)
-            if snapshot.get("status") == "done" and snapshot.get(
-                "fingerprint"
+            # The ring goes on success AND on client cancel (the client
+            # walked away from the partial state — a cancelled job's
+            # ring is dead weight by the cancel contract, docs/
+            # SERVING.md "Fair-share & fusion runbook"); a failed/
+            # timed-out job's ring still survives for resubmission.
+            if snapshot.get("status") in ("done", "cancelled") and (
+                snapshot.get("fingerprint")
             ):
                 self.store.clear_checkpoints(snapshot["fingerprint"])
             # Terminal = release: the lease is tombstoned (token KEPT)
@@ -1358,6 +1637,17 @@ class Scheduler:
             # released token and is refused — released, not deleted.
             if self.leases is not None:
                 self.leases.release(job_id, snapshot["status"])
+            with self._lock:
+                self._cancel_flags.pop(job_id, None)
+                self._fusion_keys.pop(job_id, None)
+            # Live SSE subscribers get the terminal record as their
+            # final frame (best-effort fan-out; the JSONL log is the
+            # durable story).
+            self.bus.publish(job_id, {
+                "event": f"job_{snapshot['status']}",
+                "terminal": True,
+                "record": snapshot,
+            })
         return snapshot
 
     def _run_with_timeout(
@@ -1395,13 +1685,23 @@ class Scheduler:
             kwargs["heartbeat"] = heartbeat
         if self.job_timeout is None and not supervise_wedge:
             return self.executor.run(spec, x, progress_cb, **kwargs)
+
+        def call():
+            return self.executor.run(spec, x, progress_cb, **kwargs)
+
+        return self._supervised_call(call, heartbeat, expected_block_fn)
+
+    def _supervised_call(self, call, heartbeat, expected_block_fn):
+        """The supervision core shared by the solo and fused execution
+        paths: run ``call()`` on an abandonable daemon thread, watching
+        the wall clock (``job_timeout``) and — when the watchdog is on
+        and a heartbeat exists — the per-block liveness deadline."""
+        supervise_wedge = self.watchdog and heartbeat is not None
         box: Dict[str, Any] = {}
 
         def _target():
             try:
-                box["result"] = self.executor.run(
-                    spec, x, progress_cb, **kwargs
-                )
+                box["result"] = call()
             except BaseException as e:  # noqa: BLE001 — reraised below
                 box["error"] = e
 
@@ -1446,13 +1746,38 @@ class Scheduler:
             raise box["error"]
         return box["result"]
 
+    def _plan_fusion_batch(self, job_id: str) -> List[str]:
+        """The worker's fusion raid (serve/sched/fusion.py): after the
+        fair order picked ``job_id``, pull up to ``fusion_max - 1``
+        more queued jobs with the SAME fusion key to ride one device
+        program.  The match predicate is pure over snapshots captured
+        here — it runs under the queue's lock, and must never reach
+        back into scheduler state (lock-order discipline, see
+        ``metrics``)."""
+        if self.fusion_max < 2 or self.schedule != "fair":
+            return [job_id]
+        with self._lock:
+            key = self._fusion_keys.get(job_id)
+            keys = dict(self._fusion_keys)
+        if key is None:
+            return [job_id]
+        mates = self._queue.take_matching(
+            lambda jid: keys.get(jid) == key,
+            self.fusion_max - 1,
+        )
+        return [job_id, *mates]
+
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
             job_id = self._queue.get()
             if job_id is None or self._stop.is_set():
                 break
+            batch = self._plan_fusion_batch(job_id)
             try:
-                self._execute(job_id)
+                if len(batch) >= 2:
+                    self._execute_fused(batch)
+                else:
+                    self._execute(job_id)
             except LeaseLost as e:
                 # A fenced write was refused mid-execution: the job was
                 # taken over and the successor's record is the record.
@@ -1484,53 +1809,81 @@ class Scheduler:
                 # _execute handles job failures itself; anything escaping
                 # is a scheduler bug, and one bad job must not kill the
                 # worker and strand every queued job behind it.
-                with self._lock:
-                    self.jobs_failed += 1
-                try:
-                    self._update(
-                        job_id, status="failed",
-                        error=f"internal scheduler error: {e}",
-                        finished_at=round(time.time(), 3),
-                    )
-                except Exception:  # noqa: BLE001
-                    pass
-                self.events.emit(
-                    "job_failed", job_id=job_id, error=str(e),
-                    kind="internal",
-                )
+                self._fail_internal(job_id, e)
 
-    def _execute(self, job_id: str) -> None:
+    def _fail_internal(self, job_id: str, e: Exception) -> None:
+        """Last-resort terminalisation for a scheduler bug: the job must
+        not stay 'running' forever.  Shared by the worker loop and the
+        fused path's per-job solo fallback — one recovery, no drift."""
         with self._lock:
-            record = self._jobs.get(job_id)
-            spec = self._specs.pop(job_id, None)
-            x = self._data.pop(job_id, None)
+            self.jobs_failed += 1
+        try:
+            self._update(
+                job_id, status="failed",
+                error=f"internal scheduler error: {e}",
+                finished_at=round(time.time(), 3),
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        self.events.emit(
+            "job_failed", job_id=job_id, error=str(e),
+            kind="internal",
+        )
+        self._note_drain()
+
+    def _execute(self, job_id: str, preloaded=None) -> None:
+        if preloaded is not None:
+            # The fused path already popped this job's state and is
+            # falling it back to the solo path (degrade, never block).
+            record, spec, x = preloaded
+        else:
+            with self._lock:
+                record = self._jobs.get(job_id)
+                spec = self._specs.pop(job_id, None)
+                x = self._data.pop(job_id, None)
         if record is None or spec is None or x is None:
+            stored = self.store.load_job(job_id)
+            if stored is not None and stored.get("status") in _TERMINAL:
+                # Cancelled (or otherwise terminalised) while queued:
+                # the queue entry outlived the job — nothing to run.
+                return
             # A lease takeover (note-lost sweep) evicted the job between
             # dequeue and pickup: the successor owns it — stand down.
             raise LeaseLost(job_id, "pickup", None, None)
         with self._lock:
             fp = record["fingerprint"]
             submitted_at = float(record.get("submitted_at") or time.time())
+            # The cancel flag a client may set mid-run; checked at every
+            # block boundary below.
+            cancel_flag = self._cancel_flags.get(job_id)
+            if cancel_flag is None:
+                cancel_flag = self._cancel_flags[job_id] = (
+                    threading.Event()
+                )
 
         # Observability (docs/OBSERVABILITY.md): one trace per job,
         # trace_id = job_id, spans ride the JSONL event stream.  The
         # queue wait — admission to worker pickup — is the span whose
         # start predates this method, so it is recorded retroactively.
         tracer = Tracer(self._span_sink, trace_id=job_id)
-        queue_wait = max(0.0, time.time() - submitted_at)
-        self.hist_queue_wait_seconds.observe(queue_wait)
-        tracer.record("queue_wait", queue_wait)
         # The shared per-bucket key for the SLO ledger and the forensic
         # report's grouping (job_done carries it — the JSONL log must
         # be able to tell buckets apart offline, long-tail big-N jobs
         # are not a small bucket's regression).
         bucket = self._job_bucket(spec, *(int(v) for v in x.shape))
-        # Queue wait feeds its SLO ledger HERE, outcome-blind: an
-        # admission backlog whose jobs then fail or time out must
-        # still burn the objective (the wedged-backend overload is
-        # exactly when it pages; end-to-end latency stays success-only
-        # in the terminal path below).
-        self.slo.observe_queue_wait(bucket, queue_wait)
+        if preloaded is None:
+            # Queue wait feeds its SLO ledger HERE, outcome-blind: an
+            # admission backlog whose jobs then fail or time out must
+            # still burn the objective (the wedged-backend overload is
+            # exactly when it pages; end-to-end latency stays
+            # success-only in the terminal path below).  A PRELOADED
+            # job already observed its wait at the FUSED pickup — a
+            # second sample here, inflated by the degraded fused
+            # attempt's runtime, would double-burn the objective.
+            queue_wait = max(0.0, time.time() - submitted_at)
+            self.hist_queue_wait_seconds.observe(queue_wait)
+            tracer.record("queue_wait", queue_wait)
+            self.slo.observe_queue_wait(bucket, queue_wait)
 
         # Late dedup: submission-time dedup misses a twin that was
         # still RUNNING (its result not yet stored), and a restart can
@@ -1553,6 +1906,7 @@ class Scheduler:
                 "job_done", job_id=job_id, fingerprint=fp, cached=True,
                 bucket=bucket, worker_id=self.worker_id,
             )
+            self._note_drain()
             return
 
         def progress_cb(k: int, pac: float) -> None:
@@ -1562,18 +1916,31 @@ class Scheduler:
             self.events.emit(
                 "k_batch_complete", job_id=job_id, k=k, pac=pac
             )
+            self.bus.publish(job_id, {
+                "event": "k_batch_complete", "job_id": job_id,
+                "k": int(k), "pac": float(pac),
+            })
 
         def block_cb(block: int, h_done: int, pac_list) -> None:
             # Per-streamed-block progress from the H-block driver: the
             # signs-of-life signal for a long job, at block resolution.
             # The same beat renews this worker's leases (rate-limited,
             # non-blocking inside the manager) — the heartbeat→renewal
-            # path of docs/SERVING.md "Multi-worker runbook".
+            # path of docs/SERVING.md "Multi-worker runbook".  Client
+            # cancel lands HERE: the next block boundary after the flag
+            # is the first interruptible point of a compiled sweep.
+            if cancel_flag.is_set():
+                raise JobCancelled(job_id)
             self._lease_beat()
             self.events.emit(
                 "h_block_complete", job_id=job_id, block=block,
                 h_done=h_done, pac_area=pac_list,
             )
+            self.bus.publish(job_id, {
+                "event": "h_block_complete", "job_id": job_id,
+                "block": int(block), "h_done": int(h_done),
+                "pac_area": list(pac_list),
+            })
 
         # Duck-typed executors (test stubs) may not stream; only a real
         # streaming executor gets the per-block callback, the
@@ -1661,6 +2028,26 @@ class Scheduler:
                             "profile_captured", job_id=job_id,
                             profile_dir=profile_dir,
                         )
+            except JobCancelled as e:
+                # The client walked away (docs/SERVING.md "Fair-share
+                # & fusion runbook"): terminal, NOT a failure — no
+                # retry, no SLO error-budget burn (the service did
+                # nothing wrong), ring cleared and lease released by
+                # the terminal update, slot freed for the next job.
+                with self._lock:
+                    self.jobs_cancelled_total += 1
+                self._update(
+                    job_id, status="cancelled",
+                    error=f"cancelled mid-run ({e.reason})",
+                    finished_at=round(time.time(), 3),
+                )
+                self.events.emit(
+                    "job_cancelled", job_id=job_id, reason=e.reason,
+                    stage="running", bucket=bucket,
+                    worker_id=self.worker_id,
+                )
+                self._note_drain()
+                return
             except JobTimeout as e:
                 # A timed-out attempt burned error budget like any
                 # other failed one (the SLO's error_rate signal).
@@ -1677,6 +2064,7 @@ class Scheduler:
                     kind="timeout", bucket=bucket,
                     worker_id=self.worker_id,
                 )
+                self._note_drain()
                 return
             except JobSpecError as e:
                 # The caller's fault, deterministic: retrying cannot help.
@@ -1691,6 +2079,7 @@ class Scheduler:
                     kind="bad_request", bucket=bucket,
                     worker_id=self.worker_id,
                 )
+                self._note_drain()
                 return
             except Exception as e:
                 # Every failed attempt — retried or terminal — is one
@@ -1784,6 +2173,7 @@ class Scheduler:
                     ),
                     bucket=bucket, worker_id=self.worker_id,
                 )
+                self._note_drain()
                 return
             seconds = time.perf_counter() - t0
             if isinstance(result, dict):
@@ -1828,4 +2218,329 @@ class Scheduler:
                 seconds=round(seconds, 3), bucket=bucket,
                 worker_id=self.worker_id,
             )
+            self._note_drain()
             return
+
+    # -- fused execution (serve/sched/fusion.py) -------------------------
+
+    def _execute_fused(self, job_ids: List[str]) -> None:
+        """Run a fusion-planned batch: the eligible jobs through ONE
+        fused device program, everything else solo.  The invariant the
+        whole path keeps is DEGRADE, NEVER BLOCK: any error inside the
+        fused attempt falls every non-terminal job back to the
+        ordinary solo path (retries, triage, resume from whatever
+        checkpoints the fused attempt wrote), and one job's problem
+        (takeover, cancel, dedup) never aborts its batch-mates."""
+        loaded: Dict[str, tuple] = {}
+        for job_id in job_ids:
+            with self._lock:
+                record = self._jobs.get(job_id)
+                spec = self._specs.pop(job_id, None)
+                x = self._data.pop(job_id, None)
+            loaded[job_id] = (record, spec, x)
+        runnable: List[str] = []
+        now = time.time()
+        for job_id in job_ids:
+            record, spec, x = loaded[job_id]
+            if record is None or spec is None or x is None:
+                stored = self.store.load_job(job_id)
+                if stored is None or stored.get("status") not in (
+                    _TERMINAL
+                ):
+                    # Takeover raced the pickup: the successor owns it.
+                    logger.warning(
+                        "fused pickup stood down from job %s "
+                        "(taken over)", job_id,
+                    )
+                continue
+            runnable.append(job_id)
+            # Queue wait at pickup, once per job, OUTCOME-BLIND — fed
+            # here, before dedup/partition, so a backlog whose jobs
+            # then dedup, degrade or fail still burns the objective
+            # (the solo path's rule), and the solo fallback never
+            # double-observes (preloaded jobs skip it in _execute).
+            wait = max(0.0, now - float(
+                record.get("submitted_at") or now
+            ))
+            self.hist_queue_wait_seconds.observe(wait)
+            self.slo.observe_queue_wait(
+                self._job_bucket(spec, *(int(v) for v in x.shape)),
+                wait,
+            )
+        # Late dedup per job (the solo path's rule): a stored result is
+        # a disk read, whatever vehicle the twin rode.  Per-job
+        # isolation throughout: one job's store hiccup must not strand
+        # its popped batch-mates in "running" (nothing upstream would
+        # ever touch them again — this worker keeps renewing their
+        # leases, so not even a peer takeover rescues them).
+        still: List[str] = []
+        for job_id in runnable:
+            record, spec, x = loaded[job_id]
+            fp = record["fingerprint"]
+            try:
+                cached = self.store.get_result(fp)
+                if cached is None:
+                    still.append(job_id)
+                    continue
+                bucket = self._job_bucket(
+                    spec, *(int(v) for v in x.shape)
+                )
+                self._update(
+                    job_id, status="done", result=cached,
+                    from_cache=True, finished_at=round(time.time(), 3),
+                )
+            except LeaseLost:
+                continue
+            except Exception as e:  # noqa: BLE001 — isolate the batch
+                self._fail_internal(job_id, e)
+                continue
+            with self._lock:
+                self.cache_hits += 1
+                self.jobs_completed += 1
+            self.events.emit(
+                "job_done", job_id=job_id, fingerprint=fp, cached=True,
+                bucket=bucket, worker_id=self.worker_id,
+            )
+            self._note_drain()
+        fingerprints = {
+            job_id: loaded[job_id][0]["fingerprint"] for job_id in still
+        }
+        ring_empty = {
+            job_id: (
+                not self.checkpoints
+                or ring_is_empty(self.store.checkpoint_dir(
+                    fingerprints[job_id]
+                ))
+            )
+            for job_id in still
+        }
+        parts = partition_batch(still, fingerprints, ring_empty)
+        solo_ids = list(parts["solo"])
+        fused_ids = list(parts["fused"])
+        if fused_ids:
+            solo_ids = self._run_fused_group(fused_ids, loaded) + solo_ids
+        for job_id in solo_ids:
+            try:
+                self._execute(job_id, preloaded=loaded[job_id])
+            except LeaseLost as e:
+                logger.warning(
+                    "worker stood down from job %s: %s", job_id, e
+                )
+            except Exception as e:  # noqa: BLE001 — isolate batch-mates
+                # A scheduler bug on one fallback must not strand the
+                # rest of the batch in "running" forever.
+                self._fail_internal(job_id, e)
+
+    def _cancel_executor_events(self) -> None:
+        """Duck-typed ``cancel_events`` (stub executors without the
+        generation guard simply have no late emissions to drop)."""
+        cancel = getattr(self.executor, "cancel_events", None)
+        if cancel is not None:
+            cancel()
+
+    def _run_fused_group(
+        self, job_ids: List[str], loaded: Dict[str, tuple]
+    ) -> List[str]:
+        """Execute ``job_ids`` through one fused device program;
+        returns the ids that must FALL BACK to solo (empty on clean
+        success).  Per-job terminal handling mirrors ``_execute``'s
+        success path; any exception inside the fused attempt degrades
+        the whole group (minus a cancelled job, which terminalises)."""
+        k = len(job_ids)
+        specs = [loaded[j][1] for j in job_ids]
+        xs = [loaded[j][2] for j in job_ids]
+        n, d = (int(v) for v in xs[0].shape)
+        buckets = {
+            job_id: self._job_bucket(loaded[job_id][1], n, d)
+            for job_id in job_ids
+        }
+        flags: Dict[str, threading.Event] = {}
+        with self._lock:
+            for job_id in job_ids:
+                flag = self._cancel_flags.get(job_id)
+                if flag is None:
+                    flag = self._cancel_flags[job_id] = threading.Event()
+                flags[job_id] = flag
+        # (Queue waits were already observed at the fused PICKUP in
+        # _execute_fused — once per job, outcome-blind.)
+        started: List[str] = []
+        for job_id in job_ids:
+            try:
+                self._update(
+                    job_id, status="running", attempt=0,
+                    started_at=round(time.time(), 3),
+                )
+            except LeaseLost:
+                continue
+            except Exception as e:  # noqa: BLE001 — isolate the batch
+                self._fail_internal(job_id, e)
+                continue
+            self.events.emit(
+                "job_started", job_id=job_id, attempt=0, fused=True,
+                worker_id=self.worker_id,
+            )
+            started.append(job_id)
+        if len(started) < 2:
+            return started
+        job_ids = started
+        # Re-derive the batch width AFTER the LeaseLost filter: events
+        # (fusion_executed.k, job_done.fusion_k), the ballast padding
+        # and the wedge-deadline scale must all describe the batch
+        # that actually runs, not the one that was planned.
+        k = len(job_ids)
+        specs = [loaded[j][1] for j in job_ids]
+        xs = [loaded[j][2] for j in job_ids]
+
+        def make_block_cb(job_id):
+            flag = flags[job_id]
+
+            def block_cb(block, h_done, pac_list):
+                if flag.is_set():
+                    raise JobCancelled(job_id)
+                self._lease_beat()
+                self.events.emit(
+                    "h_block_complete", job_id=job_id, block=block,
+                    h_done=h_done, pac_area=pac_list, fused=True,
+                )
+                self.bus.publish(job_id, {
+                    "event": "h_block_complete", "job_id": job_id,
+                    "block": int(block), "h_done": int(h_done),
+                    "pac_area": list(pac_list), "fused": True,
+                })
+
+            return block_cb
+
+        block_cbs = [make_block_cb(j) for j in job_ids]
+        checkpoint_dirs = None
+        if self.checkpoints:
+            checkpoint_dirs = [
+                self.store.checkpoint_dir(loaded[j][0]["fingerprint"])
+                for j in job_ids
+            ]
+        heartbeat = None
+        expected_block_fn = None
+        if self.watchdog and hasattr(self.executor, "run_fused"):
+            heartbeat = Heartbeat()
+            if hasattr(self.executor, "expected_block_seconds"):
+                first = specs[0]
+
+                def expected_block_fn():
+                    try:
+                        solo = self.executor.expected_block_seconds(
+                            first, n, d
+                        )
+                    except Exception:  # noqa: BLE001 — an expectation
+                        return None  # hiccup must not fail live jobs
+                    # A fused block does k jobs' work: scale the solo
+                    # expectation so fusion never reads as a wedge.
+                    return None if solo is None else solo * k
+
+        def call():
+            return self.executor.run_fused(
+                specs, xs,
+                block_cbs=block_cbs,
+                checkpoint_dirs=checkpoint_dirs,
+                heartbeat=heartbeat,
+                pad_to=self.fusion_max,
+            )
+
+        t0 = time.perf_counter()
+        try:
+            if self.job_timeout is None and heartbeat is None:
+                results = call()
+            else:
+                results = self._supervised_call(
+                    call, heartbeat, expected_block_fn
+                )
+        except JobCancelled as e:
+            # One client walked away mid-batch: ITS job terminalises,
+            # the batch-mates degrade to solo (they resume from the
+            # fused attempt's checkpoints — degrade, never block).
+            self._cancel_executor_events()
+            with self._lock:
+                self.jobs_cancelled_total += 1
+                self.fusion_degraded_total += 1
+            survivors = [j for j in job_ids if j != e.job_id]
+            try:
+                self._update(
+                    e.job_id, status="cancelled",
+                    error=f"cancelled mid-run ({e.reason})",
+                    finished_at=round(time.time(), 3),
+                )
+                self.events.emit(
+                    "job_cancelled", job_id=e.job_id, reason=e.reason,
+                    stage="running", bucket=buckets.get(e.job_id),
+                    fused=True, worker_id=self.worker_id,
+                )
+                self._note_drain()
+            except LeaseLost:
+                pass
+            return survivors
+        except BaseException as e:  # noqa: BLE001 — degrade, don't die
+            # ANY fused-attempt failure (timeout, wedge, integrity
+            # breach, device fault) degrades the whole group to the
+            # solo path, whose triage/retry/resume machinery owns the
+            # hard cases.  The abandoned thread's late events drop via
+            # the executor generation bump.
+            self._cancel_executor_events()
+            with self._lock:
+                self.fusion_degraded_total += 1
+                ran = getattr(e, "integrity_checks_run", 0)
+                if ran:
+                    self.integrity_checks_total += int(ran)
+            logger.warning(
+                "fused execution of %s degraded to solo: %s",
+                job_ids, e,
+            )
+            return job_ids
+        run_seconds = time.perf_counter() - t0
+        with self._lock:
+            self.fused_executions_total += 1
+        self.events.emit(
+            "fusion_executed", job_ids=list(job_ids),
+            bucket=buckets[job_ids[0]], k=k,
+            seconds=round(run_seconds, 3), worker_id=self.worker_id,
+        )
+        for job_id, result in zip(job_ids, results):
+            record = loaded[job_id][0]
+            fp = record["fingerprint"]
+            streaming = result.get("streaming")
+            if isinstance(streaming, dict):
+                with self._lock:
+                    self.integrity_checks_total += int(
+                        streaming.get("integrity_checks", 0)
+                    )
+            try:
+                # Store first, then flip status (the solo rule); per-
+                # job isolation so one result's disk-full does not
+                # strand the batch-mates whose results wrote fine.
+                self.store.put_result(fp, result)
+                stored = self.store.get_result(fp)
+                self._update(
+                    job_id, status="done", result=stored,
+                    finished_at=round(time.time(), 3),
+                    seconds=run_seconds,
+                )
+            except LeaseLost:
+                continue
+            except Exception as e:  # noqa: BLE001 — isolate the batch
+                self._fail_internal(job_id, e)
+                continue
+            with self._lock:
+                self.jobs_completed += 1
+                self.fused_jobs_total += 1
+            end_to_end = max(0.0, time.time() - float(
+                record.get("submitted_at") or time.time()
+            ))
+            self.hist_job_seconds.observe(end_to_end)
+            self.slo.observe_attempt(buckets[job_id], ok=True)
+            self.slo.observe_job(buckets[job_id], end_to_end, ok=True)
+            self.events.emit(
+                "job_done", job_id=job_id, fingerprint=fp,
+                seconds=round(run_seconds, 3), bucket=buckets[job_id],
+                fused=True, fusion_k=k, worker_id=self.worker_id,
+            )
+            self._note_drain()
+        # Every job was terminalised above (done, stood down, or
+        # internally failed): nothing left for the solo fallback.
+        return []

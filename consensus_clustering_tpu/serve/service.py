@@ -17,6 +17,14 @@ framework, nothing the container doesn't already have.  Endpoints:
   the job over the backend budget (structured body with the estimate
   breakdown).
 - ``GET /jobs/<id>``   — poll a job; embeds ``result`` once done.
+- ``GET /jobs/<id>/events`` — Server-Sent Events: the current record,
+  then live per-block progress (``h_block_complete`` + the PAC
+  trajectory) and the terminal record; ``?cancel_on_disconnect=1``
+  makes hanging up cancel the job (docs/SERVING.md "Fair-share &
+  fusion runbook").
+- ``POST /jobs/<id>/cancel`` — client cancel; terminal like ``done``
+  (lease released, ring cleared, slot freed at the next block
+  boundary).
 - ``GET /healthz``     — liveness: status, backend label, uptime.
 - ``GET /metrics``     — queue depth/capacity, jobs completed/failed/
   retried/timed-out/requeued, jobstore ``cache_hits``, in-process
@@ -45,15 +53,20 @@ the latter against an ephemeral port.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
+import queue as _queue_mod
+import select
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
+from urllib.parse import parse_qs
 
 from consensus_clustering_tpu.serve.events import EventLog
 from consensus_clustering_tpu.serve.executor import (
+    _TENANT_RE,
     InvalidDataError,
     JobSpecError,
     SweepExecutor,
@@ -62,10 +75,15 @@ from consensus_clustering_tpu.serve.executor import (
 from consensus_clustering_tpu.serve.jobstore import JobStore
 from consensus_clustering_tpu.serve.preflight import PreflightReject
 from consensus_clustering_tpu.serve.scheduler import (
+    _TERMINAL,
     QueueFull,
     QueueShed,
     Scheduler,
     ShedPolicy,
+)
+from consensus_clustering_tpu.serve.sched.stream import (
+    sse_event,
+    sse_keepalive,
 )
 
 logger = logging.getLogger(__name__)
@@ -100,7 +118,28 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(blob)
 
     def do_POST(self) -> None:  # noqa: N802 — http.server spelling
-        if self.path.rstrip("/") != "/jobs":
+        path = self.path.rstrip("/")
+        if path.startswith("/jobs/") and path.endswith("/cancel"):
+            job_id = path[len("/jobs/"):-len("/cancel")]
+            if not job_id or "/" in job_id:
+                self._send_json(404, {"error": "bad job path"})
+                return
+            # Drain any body before responding: a client POSTing
+            # `{}` on a keep-alive connection would otherwise desync
+            # the next request's parse at the unread bytes.
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > 0:
+                if length > self.service.max_body_bytes:
+                    self.close_connection = True
+                else:
+                    self.rfile.read(length)
+            record = self.service.scheduler.cancel(job_id)
+            if record is None:
+                self._send_json(404, {"error": f"unknown job {job_id}"})
+                return
+            self._send_json(202, record)
+            return
+        if path != "/jobs":
             self._send_json(404, {"error": f"no such route {self.path}"})
             return
         length = int(self.headers.get("Content-Length") or 0)
@@ -136,6 +175,25 @@ class _Handler(BaseHTTPRequestHandler):
         except JobSpecError as e:
             self._send_json(400, {"error": str(e)})
             return
+        tenant_header = self.service.tenant_header
+        if tenant_header:
+            header_tenant = self.headers.get(tenant_header)
+            if header_tenant is not None:
+                # The header is the DEPLOYMENT's tenant identity (an
+                # auth proxy stamps it); when present it overrides the
+                # body's self-declared config.tenant.  Same alphabet
+                # rule as the config field — lane keys become /metrics
+                # labels and JSONL fields.
+                if not _TENANT_RE.match(header_tenant):
+                    self._send_json(400, {
+                        "error": (
+                            f"{tenant_header} header must be 1-64 "
+                            "chars of [A-Za-z0-9._-], got "
+                            f"{header_tenant!r}"
+                        ),
+                    })
+                    return
+                spec = dataclasses.replace(spec, tenant=header_tenant)
         try:
             record = self.service.scheduler.submit(spec, x)
         except PreflightReject as e:
@@ -146,7 +204,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except QueueShed as e:
             # Shed ≠ full: the service is protecting higher-priority
-            # traffic.  Retry-After is the client's backoff contract.
+            # traffic.  Retry-After is the client's backoff contract —
+            # derived from the LIVE queue drain rate (floored at the
+            # static --shed-retry-after), with the arithmetic disclosed
+            # in the body so the hint reads as evidence.
             self._send_json(
                 429,
                 {
@@ -154,6 +215,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "shed": True,
                     "priority": e.priority,
                     "retry_after_seconds": e.retry_after,
+                    "retry_after_basis": e.basis,
                 },
                 headers={"Retry-After": str(int(e.retry_after))},
             )
@@ -197,6 +259,13 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/metrics":
             self._send_json(200, self.service.scheduler.metrics())
             return
+        if path.startswith("/jobs/") and path.endswith("/events"):
+            job_id = path[len("/jobs/"):-len("/events")]
+            if not job_id or "/" in job_id:
+                self._send_json(404, {"error": "bad job path"})
+                return
+            self._serve_sse(job_id, parse_qs(query))
+            return
         if path.startswith("/jobs/"):
             job_id = path[len("/jobs/"):]
             if "/" in job_id or not job_id:
@@ -209,6 +278,97 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, record)
             return
         self._send_json(404, {"error": f"no such route {self.path}"})
+
+    def _serve_sse(self, job_id: str, params: Dict[str, list]) -> None:
+        """``GET /jobs/<id>/events`` — Server-Sent Events: an initial
+        ``state`` frame (the current record), then live
+        ``h_block_complete``/``k_batch_complete`` frames as the job
+        streams, ending with the terminal record (docs/SERVING.md
+        "Fair-share & fusion runbook").  With
+        ``?cancel_on_disconnect=1``, closing the connection CANCELS
+        the job — a client that has watched the PAC trajectory
+        converge far enough can simply hang up, and the worker slot
+        frees at the next block boundary."""
+        scheduler = self.service.scheduler
+        cancel_on_disconnect = params.get(
+            "cancel_on_disconnect", ["0"]
+        )[0] in ("1", "true", "yes")
+        # Subscribe BEFORE the record read: a terminal transition
+        # between the two then lands in the subscription instead of
+        # vanishing.
+        sub = scheduler.bus.subscribe(job_id)
+        try:
+            record = scheduler.get(job_id)
+            if record is None:
+                self._send_json(404, {"error": f"unknown job {job_id}"})
+                return
+            scheduler.note_sse_stream()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            # No Content-Length: the stream ends when the job does (or
+            # the client hangs up), so this connection cannot be
+            # keep-alive reused.
+            self.close_connection = True
+            self.end_headers()
+            self.wfile.write(sse_event("state", record))
+            self.wfile.flush()
+            if record.get("status") in _TERMINAL:
+                return
+            keepalive = self.service.sse_keepalive_seconds
+            while True:
+                # Disconnect detection by READING, not just writing: an
+                # SSE client never sends after its request, so a
+                # readable socket means EOF (the client hung up) — and
+                # on some network stacks a write to a closed peer keeps
+                # succeeding silently, so the write-failure path alone
+                # is not a reliable signal.
+                readable, _, _ = select.select(
+                    [self.connection], [], [], 0
+                )
+                if readable and not self.connection.recv(1024):
+                    raise ConnectionResetError("sse client closed")
+                try:
+                    event = sub.get(timeout=keepalive)
+                except _queue_mod.Empty:
+                    # Comment frame: keeps proxies from idling the
+                    # stream out AND surfaces a vanished client (the
+                    # write raises) while no events flow.
+                    self.wfile.write(sse_keepalive())
+                    self.wfile.flush()
+                    continue
+                self.wfile.write(sse_event(
+                    event.get("event", "message"), event
+                ))
+                self.wfile.flush()
+                if event.get("terminal"):
+                    return
+        except (BrokenPipeError, ConnectionError, OSError):
+            # The client hung up mid-stream.
+            if cancel_on_disconnect:
+                try:
+                    scheduler.cancel(job_id, reason="sse_disconnect")
+                except Exception:  # noqa: BLE001 — a cancel failure
+                    logger.exception(  # must not kill the handler
+                        "sse disconnect-cancel failed for %s", job_id
+                    )
+        finally:
+            scheduler.bus.unsubscribe(job_id, sub)
+
+
+class _QuietHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-connection error hook LOGS instead
+    of printing a traceback to stderr: an SSE client hanging up
+    mid-write is normal operation (the disconnect-cancel path exists
+    for it), and socketserver's default print would interleave noise
+    into every consumer of the process's stderr — including the tier-1
+    runner's dot stream."""
+
+    def handle_error(self, request, client_address):
+        logger.debug(
+            "http connection error from %s", client_address,
+            exc_info=True,
+        )
 
 
 class ConsensusService:
@@ -244,6 +404,13 @@ class ConsensusService:
         leases: bool = True,
         lease_ttl: float = 60.0,
         lease_sweep: Optional[float] = None,
+        schedule: str = "fair",
+        fusion_max: int = 1,
+        priority_weights: Optional[Dict[str, float]] = None,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        starvation_seconds: float = 30.0,
+        tenant_header: Optional[str] = "X-Tenant",
+        sse_keepalive_seconds: float = 5.0,
     ):
         self.store = JobStore(store_dir)
         self.events = EventLog(events_path)
@@ -269,10 +436,22 @@ class ConsensusService:
             leases=leases,
             lease_ttl=lease_ttl,
             lease_sweep=lease_sweep,
+            schedule=schedule,
+            fusion_max=fusion_max,
+            priority_weights=priority_weights,
+            tenant_weights=tenant_weights,
+            starvation_seconds=starvation_seconds,
         )
+        self.tenant_header = tenant_header
+        if sse_keepalive_seconds <= 0:
+            raise ValueError(
+                f"sse_keepalive_seconds must be > 0, got "
+                f"{sse_keepalive_seconds}"
+            )
+        self.sse_keepalive_seconds = float(sse_keepalive_seconds)
         self.max_body_bytes = max_body_bytes
         self.started_at = time.time()
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _QuietHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.service = self  # type: ignore[attr-defined]
         self._http_thread: Optional[threading.Thread] = None

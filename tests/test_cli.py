@@ -2,6 +2,8 @@
 
 import json
 
+import pytest
+
 from consensus_clustering_tpu.cli import main
 
 
@@ -29,6 +31,9 @@ class TestCli:
         payload = json.loads(capsys.readouterr().out)
         assert payload["K"] == [3, 5]
 
+    # PR-12 rebalance (tier-1 budget): CLI-level interleave parity
+    # dups test_sweep's k_interleave_is_bit_identical; slow lane.
+    @pytest.mark.slow
     def test_run_sharded_interleaved_matches_default(self, tmp_path):
         # --k-shards/--row-shards build the mesh, --k-interleave
         # re-orders the K assignment; results must be bit-identical to

@@ -81,6 +81,10 @@ class TestKBatching:
         with pytest.raises(ValueError):
             ConsensusClustering(k_batch_size=0)
 
+    # PR-12 rebalance (tier-1 budget): the three-axis-mesh variant
+    # dups the single-device K-batching tests + test_sweep's mesh
+    # families; slow lane.
+    @pytest.mark.slow
     def test_k_batches_on_three_axis_mesh(self, blobs):
         # Composition not covered elsewhere: each k-batch compiles its
         # own sweep over a mesh that ALSO shards K (plus resamples and

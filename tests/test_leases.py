@@ -317,8 +317,16 @@ class TestSchedulerLeases:
             assert takeovers[0]["reason"] == "expired"
             assert takeovers[0]["prior_worker"] == "dead"
             assert takeovers[0]["token"] == 2
-            # Terminal transition released the taker's lease.
-            lease = read_lease(store.leases_dir, "f" * 32)
+            # Terminal transition releases the taker's lease.  The
+            # record mirrors "done" BEFORE the tombstone lands (the
+            # fence ordering), so a poller can observe done a few ms
+            # ahead of the release — wait for it like for the status.
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                lease = read_lease(store.leases_dir, "f" * 32)
+                if lease.get("released"):
+                    break
+                time.sleep(0.02)
             assert lease["released"] and lease["worker_id"] == "wb"
         finally:
             survivor.stop()

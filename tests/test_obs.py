@@ -1068,7 +1068,11 @@ class TestQueryEngine:
 
 def _emitted_event_names():
     names = set()
-    for path in glob.glob(os.path.join(SERVE_DIR, "*.py")):
+    # Recursive: the serve/sched subpackage's emissions (if any) are
+    # part of the same catalogue contract.
+    for path in glob.glob(
+        os.path.join(SERVE_DIR, "**", "*.py"), recursive=True
+    ):
         tree = ast.parse(open(path).read(), filename=path)
         for node in ast.walk(tree):
             if (

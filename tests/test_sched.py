@@ -1,0 +1,1062 @@
+"""Fair-share scheduling subsystem tests (docs/SERVING.md "Fair-share
+& fusion runbook"): weighted DRR lanes, same-bucket job fusion, SSE
+streamed partial results, client cancel, and the drain-rate-derived
+Retry-After.
+
+The fast lane is stub/host-only (no compile).  The slow lane drives the
+REAL streaming engine through the fusion parity gate — fused k∈{2,3}
+results byte-identical to solo oracles, including resume from
+fused-written checkpoint frames — because that bit-identity is the
+contract the whole fusion path rests on.
+"""
+
+import http.client
+import json
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.serve import (
+    ConsensusService,
+    JobStore,
+    QueueShed,
+    Scheduler,
+    ShedPolicy,
+)
+from consensus_clustering_tpu.serve.executor import (
+    JobSpec,
+    JobSpecError,
+    parse_job_spec,
+)
+from consensus_clustering_tpu.serve.sched.fairshare import (
+    FairShareQueue,
+    lane_name,
+    parse_priority_weights,
+    parse_tenant_weights,
+)
+from consensus_clustering_tpu.serve.sched.fusion import (
+    fusion_key,
+    partition_batch,
+    ring_is_empty,
+)
+from consensus_clustering_tpu.serve.sched.stream import (
+    JobEventBus,
+    sse_event,
+)
+
+
+# ---------------------------------------------------------------------------
+# FairShareQueue units
+
+
+class TestFairShareQueue:
+    def test_within_lane_fifo(self):
+        q = FairShareQueue(maxsize=0)
+        for i in range(5):
+            q.put_nowait(("a", i), tenant="t", priority="normal")
+        got = [q.get() for _ in range(5)]
+        assert got == [("a", i) for i in range(5)]
+
+    def test_weighted_ratio_high_over_low(self):
+        """Over a saturated interval the 4:1 default weights serve the
+        high lane ~4x the low lane."""
+        q = FairShareQueue(maxsize=0)
+        for i in range(40):
+            q.put_nowait(("hi", i), tenant="a", priority="high")
+            q.put_nowait(("lo", i), tenant="b", priority="low")
+        first20 = [q.get()[0] for _ in range(20)]
+        # 4:1 weights ⇒ ~16 high of the first 20; allow slack for the
+        # rotation's phase.
+        assert first20.count("hi") >= 14
+        # Low still progresses — never parked outright.
+        assert first20.count("lo") >= 2
+
+    def test_tenant_weight_multiplier(self):
+        q = FairShareQueue(
+            maxsize=0, tenant_weights={"vip": 3.0},
+        )
+        for i in range(30):
+            q.put_nowait(("vip", i), tenant="vip", priority="normal")
+            q.put_nowait(("std", i), tenant="std", priority="normal")
+        first12 = [q.get()[0] for _ in range(12)]
+        assert first12.count("vip") >= 8
+
+    def test_bad_weights_rejected(self):
+        with pytest.raises(ValueError):
+            FairShareQueue(priority_weights={"high": 0})
+        with pytest.raises(ValueError):
+            FairShareQueue(tenant_weights={"t": -1})
+        with pytest.raises(ValueError):
+            FairShareQueue(starvation_seconds=0)
+
+    def test_global_capacity_full(self):
+        q = FairShareQueue(maxsize=2)
+        q.put_nowait("a", tenant="t1")
+        q.put_nowait("b", tenant="t2")
+        with pytest.raises(queue.Full):
+            q.put_nowait("c", tenant="t3")
+        # The wake sentinel bypasses capacity — a shutdown must never
+        # be refused by a full queue.  Items drain first (the worker
+        # loop re-checks its stop flag per get), the sentinel last.
+        q.put_nowait(None)
+        assert q.get() == "a"
+        assert q.get() == "b"
+        assert q.get() is None
+
+    def test_wake_sentinel_wakes_blocked_get(self):
+        q = FairShareQueue(maxsize=0)
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.get()))
+        t.start()
+        time.sleep(0.05)
+        q.put_nowait(None)
+        t.join(5.0)
+        assert out == [None]
+
+    def test_starvation_clock_bounds_the_wait(self):
+        """A lane whose head has aged past the clock is served next,
+        whatever the weights say."""
+        now = [0.0]
+        q = FairShareQueue(
+            maxsize=0, starvation_seconds=5.0, clock=lambda: now[0],
+        )
+        q.put_nowait("old-low", tenant="t", priority="low")
+        now[0] = 100.0
+        q.put_nowait("new-high", tenant="u", priority="high")
+        assert q.get() == "old-low"
+        assert q.starvation_grants_total == 1
+
+    def test_backlogged_but_served_lane_is_not_starving(self):
+        """The clock catches lanes the weights PASS OVER, not deep
+        queues: a lane the rotation serves regularly never gets a
+        starvation grant however aged its backlog — otherwise any
+        overload longer than the clock would invert the weights into
+        oldest-head-first FIFO."""
+        now = [0.0]
+        q = FairShareQueue(
+            maxsize=0, starvation_seconds=5.0, clock=lambda: now[0],
+        )
+        for i in range(20):
+            q.put_nowait(("lo", i), tenant="t", priority="low")
+        # Drain steadily while time passes: heads age far past the
+        # clock, but the lane is served more often than the clock —
+        # congestion, not starvation.
+        for _ in range(10):
+            now[0] += 2.0
+            q.get()
+        q.put_nowait(("hi", 0), tenant="u", priority="high")
+        # The aged low backlog must NOT outrank the fresh high job for
+        # more than one rotation turn (DRR is turn-based, never
+        # aged-head-first), and no starvation grant may have fired for
+        # the served-every-tick lane.
+        first_two = [q.get()[0] for _ in range(2)]
+        assert "hi" in first_two
+        assert q.starvation_grants_total == 0
+
+    def test_idle_lane_cardinality_is_bounded(self):
+        """tenant is client-controlled: emptied lanes are GC'd past
+        the cap, so unique tenants cannot grow the rotation or the
+        /metrics lane labels without bound."""
+        q = FairShareQueue(maxsize=0)
+        for i in range(500):
+            q.put_nowait(i, tenant=f"tenant{i}")
+            q.get()
+        assert len(q.snapshot()) <= q._MAX_IDLE_LANES + 1
+
+    def test_take_matching_removes_and_preserves(self):
+        q = FairShareQueue(maxsize=0)
+        for item in ("a", "b", "c", "d"):
+            q.put_nowait(item, tenant="t")
+        taken = q.take_matching(lambda x: x in ("b", "d"), limit=1)
+        assert taken == ["b"]
+        assert [q.get() for _ in range(3)] == ["a", "c", "d"]
+        assert q.qsize() == 0
+
+    def test_snapshot_and_served_counters(self):
+        q = FairShareQueue(maxsize=0)
+        q.put_nowait("a", tenant="t", priority="high")
+        assert q.snapshot() == {lane_name("t", "high"): 1}
+        q.get()
+        assert q.served_snapshot() == {lane_name("t", "high"): 1}
+
+    def test_weight_parsers(self):
+        assert parse_tenant_weights(["a=2", "b=0.5"]) == {
+            "a": 2.0, "b": 0.5,
+        }
+        assert parse_priority_weights("6:3:1") == {
+            "high": 6.0, "normal": 3.0, "low": 1.0,
+        }
+        assert parse_priority_weights(None)["high"] == 4.0
+        for bad in (["a"], ["a=x"], ["a=0"]):
+            with pytest.raises(ValueError):
+                parse_tenant_weights(bad)
+        for bad in ("1:2", "a:b:c", "1:2:0"):
+            with pytest.raises(ValueError):
+                parse_priority_weights(bad)
+
+
+# ---------------------------------------------------------------------------
+# Fusion planning units
+
+
+class TestFusionPlanning:
+    def test_key_equality_across_tenant_priority_seed(self):
+        a = JobSpec(k_values=(2, 3), n_iterations=16, seed=1,
+                    tenant="a", priority="high")
+        b = JobSpec(k_values=(2, 3), n_iterations=16, seed=2,
+                    tenant="b", priority="low")
+        assert fusion_key(a, 40, 3, 4) == fusion_key(b, 40, 3, 4)
+        assert fusion_key(a, 40, 3, 4) is not None
+
+    def test_key_ineligible_modes(self):
+        est = JobSpec(k_values=(2,), mode="estimate", n_pairs=64)
+        assert fusion_key(est, 40, 3, 4) is None
+        adaptive = JobSpec(k_values=(2,), adaptive_tol=0.01)
+        assert fusion_key(adaptive, 40, 3, 4) is None
+
+    def test_key_splits_on_h_and_bucket(self):
+        a = JobSpec(k_values=(2, 3), n_iterations=16)
+        b = JobSpec(k_values=(2, 3), n_iterations=32)
+        c = JobSpec(k_values=(2, 4), n_iterations=16)
+        assert fusion_key(a, 40, 3, 4) != fusion_key(b, 40, 3, 4)
+        assert fusion_key(a, 40, 3, 4) != fusion_key(c, 40, 3, 4)
+        assert fusion_key(a, 40, 3, 4) != fusion_key(a, 50, 3, 4)
+
+    def test_partition_dedups_fingerprints_and_rings(self):
+        fps = {"j1": "f1", "j2": "f1", "j3": "f3", "j4": "f4"}
+        rings = {"j1": True, "j2": True, "j3": False, "j4": True}
+        parts = partition_batch(["j1", "j2", "j3", "j4"], fps, rings)
+        # j2 duplicates j1's fingerprint; j3 has ring progress.
+        assert parts["fused"] == ["j1", "j4"]
+        assert sorted(parts["solo"]) == ["j2", "j3"]
+
+    def test_partition_never_fuses_alone(self):
+        parts = partition_batch(
+            ["j1", "j2"], {"j1": "f1", "j2": "f1"},
+            {"j1": True, "j2": True},
+        )
+        assert parts["fused"] == []
+        assert parts["solo"] == ["j1", "j2"]
+
+    def test_ring_is_empty(self, tmp_path):
+        assert ring_is_empty(str(tmp_path / "missing"))
+        d = tmp_path / "ring"
+        d.mkdir()
+        assert ring_is_empty(str(d))
+        (d / "gen-00000001.ckpt").write_bytes(b"x")
+        assert not ring_is_empty(str(d))
+
+
+# ---------------------------------------------------------------------------
+# JobSpec tenant semantics
+
+
+class TestTenant:
+    def test_parse_and_roundtrip(self):
+        spec, _ = parse_job_spec({
+            "data": [[1.0, 2.0], [3.0, 4.0], [5.0, 0.5]],
+            "config": {"k": [2], "tenant": "acme-1"},
+        })
+        assert spec.tenant == "acme-1"
+
+    def test_parse_rejects_bad_tenant(self):
+        for bad in ("", "a b", "x" * 65, 7):
+            with pytest.raises(JobSpecError):
+                parse_job_spec({
+                    "data": [[1.0, 2.0], [3.0, 4.0], [5.0, 0.5]],
+                    "config": {"k": [2], "tenant": bad},
+                })
+
+    def test_tenant_excluded_from_fingerprint_and_bucket(self):
+        a = JobSpec(k_values=(2,), tenant="a")
+        b = JobSpec(k_values=(2,), tenant="b")
+        assert a.fingerprint_payload() == b.fingerprint_payload()
+        assert a.bucket(40, 3, 4) == b.bucket(40, 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# Stub executors
+
+
+class _StubExecutor:
+    """Minimal duck-typed executor: no streaming surface."""
+
+    def __init__(self):
+        self.run_count = 0
+
+    def run(self, spec, x, progress_cb=None, **kwargs):
+        self.run_count += 1
+        return {"seed": spec.seed, "stub": True}
+
+    def backend(self):
+        return "cpu-fallback"
+
+
+class _StreamingStubExecutor(_StubExecutor):
+    """Streaming-shaped stub: the scheduler hands it block callbacks
+    (``default_h_block`` is the duck-type gate), which is what the
+    cancel and SSE paths need."""
+
+    default_h_block = 4
+
+    def __init__(self, blocks=3, block_sleep=0.05, gate=None):
+        super().__init__()
+        self.blocks = blocks
+        self.block_sleep = block_sleep
+        self.gate = gate  # optional Event: run blocks until set
+
+    def run(self, spec, x, progress_cb=None, block_cb=None,
+            checkpoint_dir=None, **kwargs):
+        if self.gate is not None:
+            assert self.gate.wait(30.0)
+        for b in range(self.blocks):
+            time.sleep(self.block_sleep)
+            if block_cb is not None:
+                block_cb(b, (b + 1) * 4, [0.5])
+        self.run_count += 1
+        return {"seed": spec.seed, "stub": True}
+
+
+class _FusedStubExecutor(_StreamingStubExecutor):
+    """Adds run_fused so the scheduler's planner engages."""
+
+    def __init__(self, fail_fused=False, **kwargs):
+        super().__init__(**kwargs)
+        self.fused_calls = []
+        self.fail_fused = fail_fused
+
+    def run_fused(self, specs, xs, block_cbs=None, checkpoint_dirs=None,
+                  heartbeat=None, pad_to=None):
+        if self.gate is not None:
+            assert self.gate.wait(30.0)
+        self.fused_calls.append([s.seed for s in specs])
+        if self.fail_fused:
+            raise RuntimeError("injected fused failure")
+        out = []
+        for i, spec in enumerate(specs):
+            if block_cbs is not None and block_cbs[i] is not None:
+                block_cbs[i](0, 4, [0.5])
+            out.append({"seed": spec.seed, "fused": {"batch": len(specs)}})
+        return out
+
+
+def _mk_scheduler(tmp_path, executor, **kwargs):
+    kwargs.setdefault("leases", False)
+    s = Scheduler(executor, JobStore(str(tmp_path / "store")), **kwargs)
+    return s
+
+
+def _spec(seed=1, tenant="default", priority="normal", iters=16):
+    return JobSpec(
+        k_values=(2, 3), n_iterations=iters, seed=seed,
+        tenant=tenant, priority=priority,
+    )
+
+
+def _x(seed=0, n=12, d=3):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(
+        np.float32
+    )
+
+
+def _wait_status(s, job_id, statuses=("done",), budget=20.0):
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        rec = s.get(job_id)
+        if rec and rec["status"] in statuses:
+            return rec
+        time.sleep(0.02)
+    raise AssertionError(
+        f"job {job_id} still {rec and rec.get('status')}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: schedule selection, validation, dynamic Retry-After
+
+
+class TestSchedulerFairShare:
+    def test_default_schedule_is_fair(self, tmp_path):
+        s = _mk_scheduler(tmp_path, _StubExecutor())
+        assert s.metrics()["schedule"] == "fair"
+        assert isinstance(s._queue, FairShareQueue)
+
+    def test_fifo_control_arm(self, tmp_path):
+        s = _mk_scheduler(tmp_path, _StubExecutor(), schedule="fifo")
+        m = s.metrics()
+        assert m["schedule"] == "fifo"
+        assert m["fair_lanes"] == {}
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            _mk_scheduler(tmp_path, _StubExecutor(), schedule="lifo")
+        with pytest.raises(ValueError):
+            _mk_scheduler(
+                tmp_path, _StubExecutor(), schedule="fifo",
+                fusion_max=2,
+            )
+        with pytest.raises(ValueError):
+            _mk_scheduler(tmp_path, _StubExecutor(), fusion_max=99)
+
+    def test_fair_lane_metrics_reflect_admissions(self, tmp_path):
+        s = _mk_scheduler(tmp_path, _StubExecutor(), max_queue=8)
+        # Worker NOT started: admissions sit in their lanes.
+        s.submit(_spec(seed=1, tenant="a", priority="high"), _x(1))
+        s.submit(_spec(seed=2, tenant="b", priority="low"), _x(2))
+        lanes = s.metrics()["fair_lanes"]
+        assert lanes == {"a|high": 1, "b|low": 1}
+
+    def test_retry_after_floor_without_drain_evidence(self, tmp_path):
+        s = _mk_scheduler(
+            tmp_path, _StubExecutor(),
+            shed_policy=ShedPolicy(retry_after=15.0),
+        )
+        value, basis = s._retry_after()
+        assert value == 15.0
+        assert basis["derived"] is False
+        assert basis["drain_rate_per_s"] is None
+
+    def test_retry_after_derives_from_drain_rate(self, tmp_path):
+        s = _mk_scheduler(
+            tmp_path, _StubExecutor(), max_queue=64,
+            shed_policy=ShedPolicy(retry_after=2.0),
+        )
+        now = time.time()
+        with s._lock:
+            # 12 drains in the 120 s window = 0.1 jobs/s.
+            s._drain_times = [now - i for i in range(12)]
+        for i in range(6):
+            s.submit(_spec(seed=100 + i), _x(100 + i))
+        value, basis = s._retry_after()
+        assert basis["derived"] is True
+        assert basis["queue_depth"] == 6
+        # depth 6 / 0.1 per s = 60 s.
+        assert value == pytest.approx(60.0, rel=0.01)
+
+    def test_shed_carries_basis_and_dynamic_hint(self, tmp_path):
+        s = _mk_scheduler(
+            tmp_path, _StubExecutor(), max_queue=4,
+            shed_policy=ShedPolicy(low_frac=0.25, retry_after=3.0),
+        )
+        s.submit(_spec(seed=1), _x(1))  # depth 1/4 >= low_frac
+        with pytest.raises(QueueShed) as exc:
+            s.submit(_spec(seed=2, priority="low"), _x(2))
+        assert exc.value.retry_after >= 3.0
+        assert exc.value.basis["queue_depth"] == 1
+        assert "derived" in exc.value.basis
+
+
+# ---------------------------------------------------------------------------
+# Cancel semantics (stub executors, no compile)
+
+
+class TestCancel:
+    def test_cancel_queued_job(self, tmp_path):
+        gate = threading.Event()
+        ex = _StreamingStubExecutor(gate=gate)
+        s = _mk_scheduler(tmp_path, ex, max_queue=8)
+        s.start()
+        try:
+            blocker = s.submit(_spec(seed=1), _x(1))
+            victim = s.submit(_spec(seed=2), _x(2))
+            rec = s.cancel(victim["job_id"])
+            assert rec["status"] == "cancelled"
+            gate.set()
+            _wait_status(s, blocker["job_id"])
+            # The cancelled job never executed; the blocker did.
+            assert ex.run_count == 1
+            m = s.metrics()
+            assert m["jobs_cancelled_total"] == 1
+            # Payload gone (terminal, not quarantined).
+            assert s.store.load_payload(victim["job_id"]) is None
+        finally:
+            gate.set()
+            s.stop()
+
+    def test_cancel_running_job_at_block_boundary(self, tmp_path):
+        ex = _StreamingStubExecutor(blocks=100, block_sleep=0.05)
+        s = _mk_scheduler(tmp_path, ex, max_queue=8)
+        s.start()
+        try:
+            rec = s.submit(_spec(seed=3), _x(3))
+            _wait_status(s, rec["job_id"], statuses=("running",))
+            out = s.cancel(rec["job_id"])
+            assert out["status"] in ("running", "cancelled")
+            done = _wait_status(
+                s, rec["job_id"], statuses=("cancelled",)
+            )
+            assert "cancelled" in done["error"]
+            assert s.metrics()["jobs_cancelled_total"] == 1
+            # The slot is reusable: the next job completes.
+            ex.blocks = 2
+            nxt = s.submit(_spec(seed=4), _x(4))
+            _wait_status(s, nxt["job_id"])
+        finally:
+            s.stop()
+
+    def test_cancel_unknown_job(self, tmp_path):
+        s = _mk_scheduler(tmp_path, _StubExecutor())
+        assert s.cancel("deadbeef") is None
+
+    def test_cancel_queued_job_frees_admission_slot(self, tmp_path):
+        """A cancelled queued job must release its queue-capacity slot
+        immediately — not when the worker eventually pops the ghost —
+        or a cancel storm 429s fresh work against phantom backlog."""
+        gate = threading.Event()
+        ex = _StreamingStubExecutor(gate=gate)
+        s = _mk_scheduler(tmp_path, ex, max_queue=2)
+        s.start()
+        try:
+            blocker = s.submit(_spec(seed=1), _x(1))
+            time.sleep(0.1)  # let the worker pick the blocker up
+            victim = s.submit(_spec(seed=2), _x(2))
+            s.cancel(victim["job_id"])
+            assert s.queue_depth() == 0
+            # Capacity is free again: two fresh admissions fit.
+            third = s.submit(_spec(seed=3), _x(3))
+            fourth = s.submit(_spec(seed=4), _x(4))
+            gate.set()
+            for rec in (blocker, third, fourth):
+                _wait_status(s, rec["job_id"])
+        finally:
+            gate.set()
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fused execution through the scheduler (stub run_fused)
+
+
+class TestFusedScheduling:
+    def _submit_same_bucket(self, s, n_jobs, start_seed=10):
+        recs = []
+        for i in range(n_jobs):
+            recs.append(s.submit(
+                _spec(seed=start_seed + i, tenant=f"t{i % 2}"),
+                _x(start_seed + i),
+            ))
+        return recs
+
+    def test_fused_batch_runs_once(self, tmp_path):
+        ex = _FusedStubExecutor()
+        s = _mk_scheduler(tmp_path, ex, max_queue=8, fusion_max=3)
+        # Submit BEFORE starting the worker: the batch is deterministic.
+        recs = self._submit_same_bucket(s, 3)
+        s.start()
+        try:
+            for rec in recs:
+                _wait_status(s, rec["job_id"])
+            m = s.metrics()
+            assert m["fused_executions_total"] == 1
+            assert m["fused_jobs_total"] == 3
+            assert m["fusion_degraded_total"] == 0
+            assert len(ex.fused_calls) == 1
+            assert sorted(ex.fused_calls[0]) == [10, 11, 12]
+        finally:
+            s.stop()
+
+    def test_fusion_respects_max(self, tmp_path):
+        ex = _FusedStubExecutor()
+        s = _mk_scheduler(tmp_path, ex, max_queue=8, fusion_max=2)
+        recs = self._submit_same_bucket(s, 4)
+        s.start()
+        try:
+            for rec in recs:
+                _wait_status(s, rec["job_id"])
+            assert all(len(c) <= 2 for c in ex.fused_calls)
+            m = s.metrics()
+            assert m["fused_executions_total"] >= 1
+        finally:
+            s.stop()
+
+    def test_different_h_never_fuses(self, tmp_path):
+        ex = _FusedStubExecutor()
+        s = _mk_scheduler(tmp_path, ex, max_queue=8, fusion_max=3)
+        a = s.submit(_spec(seed=1, iters=16), _x(1))
+        b = s.submit(_spec(seed=2, iters=32), _x(2))
+        s.start()
+        try:
+            _wait_status(s, a["job_id"])
+            _wait_status(s, b["job_id"])
+            assert ex.fused_calls == []
+            assert ex.run_count == 2
+        finally:
+            s.stop()
+
+    def test_fused_failure_degrades_to_solo(self, tmp_path):
+        ex = _FusedStubExecutor(fail_fused=True)
+        s = _mk_scheduler(tmp_path, ex, max_queue=8, fusion_max=3)
+        recs = self._submit_same_bucket(s, 3)
+        s.start()
+        try:
+            for rec in recs:
+                done = _wait_status(s, rec["job_id"])
+                assert done["status"] == "done"
+            m = s.metrics()
+            assert m["fusion_degraded_total"] == 1
+            assert m["fused_executions_total"] == 0
+            # Every job completed through the solo path.
+            assert ex.run_count == 3
+        finally:
+            s.stop()
+
+    def test_fused_store_failure_isolated_per_job(self, tmp_path):
+        """One job's result failing to store must fail THAT job and
+        leave its batch-mates done — not strand them in 'running'
+        (their leases would keep renewing, so nothing would ever
+        rescue them)."""
+        ex = _FusedStubExecutor()
+        s = _mk_scheduler(tmp_path, ex, max_queue=8, fusion_max=3)
+        recs = self._submit_same_bucket(s, 3)
+        poison_fp = recs[1]["fingerprint"]
+        real_put = s.store.put_result
+
+        def flaky_put(fp, result):
+            if fp == poison_fp:
+                raise OSError("disk full")
+            return real_put(fp, result)
+
+        s.store.put_result = flaky_put
+        s.start()
+        try:
+            statuses = {
+                rec["job_id"]: _wait_status(
+                    s, rec["job_id"], statuses=("done", "failed")
+                )["status"]
+                for rec in recs
+            }
+            assert statuses[recs[1]["job_id"]] == "failed"
+            assert statuses[recs[0]["job_id"]] == "done"
+            assert statuses[recs[2]["job_id"]] == "done"
+        finally:
+            s.stop()
+
+    def test_fused_events_and_lanes(self, tmp_path):
+        events_path = tmp_path / "ev.jsonl"
+        from consensus_clustering_tpu.serve.events import EventLog
+
+        ex = _FusedStubExecutor()
+        s = _mk_scheduler(
+            tmp_path, ex, max_queue=8, fusion_max=3,
+            events=EventLog(str(events_path)),
+        )
+        recs = self._submit_same_bucket(s, 3)
+        s.start()
+        try:
+            for rec in recs:
+                _wait_status(s, rec["job_id"])
+        finally:
+            s.stop()
+        events = [
+            json.loads(line)
+            for line in open(events_path)
+            if line.strip()
+        ]
+        fusions = [e for e in events if e["event"] == "fusion_executed"]
+        assert len(fusions) == 1
+        assert fusions[0]["k"] == 3
+        dones = [e for e in events if e["event"] == "job_done"]
+        assert all(e.get("fused") for e in dones)
+        assert all(e.get("fusion_k") == 3 for e in dones)
+        submitted = [
+            e for e in events if e["event"] == "job_submitted"
+        ]
+        assert {e["tenant"] for e in submitted} == {"t0", "t1"}
+        assert all("priority" in e for e in submitted)
+
+
+# ---------------------------------------------------------------------------
+# SSE: the bus, the endpoint, disconnect-cancel
+
+
+class TestEventBus:
+    def test_publish_fanout_and_unsubscribe(self):
+        bus = JobEventBus()
+        a = bus.subscribe("j1")
+        b = bus.subscribe("j1")
+        bus.publish("j1", {"event": "x", "n": 1})
+        assert a.get_nowait()["n"] == 1
+        assert b.get_nowait()["n"] == 1
+        bus.unsubscribe("j1", a)
+        bus.publish("j1", {"event": "x", "n": 2})
+        assert b.get_nowait()["n"] == 2
+        assert a.empty()
+
+    def test_overflow_drops_oldest(self):
+        bus = JobEventBus(max_queue=2)
+        sub = bus.subscribe("j1")
+        for n in range(4):
+            bus.publish("j1", {"n": n})
+        got = [sub.get_nowait()["n"] for _ in range(2)]
+        assert got == [2, 3]
+
+    def test_sse_wire_format(self):
+        frame = sse_event("state", {"a": 1})
+        assert frame == b'event: state\ndata: {"a": 1}\n\n'
+
+
+def _sse_open(port, job_id, cancel_on_disconnect=False):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    path = f"/jobs/{job_id}/events"
+    if cancel_on_disconnect:
+        path += "?cancel_on_disconnect=1"
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    return conn, resp
+
+
+def _sse_read_frame(resp):
+    """One SSE frame as (event_name, data_dict|None); skips keepalive
+    comments."""
+    name, data = None, None
+    while True:
+        line = resp.fp.readline()
+        if not line:
+            return name, data
+        line = line.decode().rstrip("\n")
+        if line.startswith(":"):
+            continue
+        if line.startswith("event: "):
+            name = line[len("event: "):]
+        elif line.startswith("data: "):
+            data = json.loads(line[len("data: "):])
+        elif line == "" and name is not None:
+            return name, data
+
+
+@pytest.fixture()
+def stub_service(tmp_path):
+    ex = _StreamingStubExecutor(blocks=6, block_sleep=0.1)
+    svc = ConsensusService(
+        store_dir=str(tmp_path / "store"),
+        port=0,
+        executor=ex,
+        leases=False,
+    ).start()
+    yield svc, ex
+    svc.stop()
+
+
+def _post_json(port, path, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(
+        "POST", path, body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return resp.status, out
+
+
+def _stub_body(seed=1, iters=16):
+    rng = np.random.default_rng(seed)
+    return {
+        "data": rng.normal(size=(12, 3)).tolist(),
+        "config": {"k": [2, 3], "iterations": iters, "seed": seed},
+    }
+
+
+class TestSSE:
+    def test_stream_state_blocks_and_terminal(self, stub_service):
+        svc, _ex = stub_service
+        code, rec = _post_json(svc.port, "/jobs", _stub_body(seed=21))
+        assert code == 202
+        conn, resp = _sse_open(svc.port, rec["job_id"])
+        try:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            name, data = _sse_read_frame(resp)
+            assert name == "state"
+            assert data["job_id"] == rec["job_id"]
+            saw_block = saw_terminal = False
+            for _ in range(40):
+                name, data = _sse_read_frame(resp)
+                if name == "h_block_complete":
+                    saw_block = True
+                    assert "pac_area" in data
+                if name == "job_done":
+                    assert data["terminal"] is True
+                    assert data["record"]["status"] == "done"
+                    saw_terminal = True
+                    break
+            assert saw_block and saw_terminal
+        finally:
+            conn.close()
+        assert svc.scheduler.metrics()["sse_streams_total"] == 1
+
+    def test_stream_of_terminal_job_closes_immediately(
+        self, stub_service
+    ):
+        svc, _ex = stub_service
+        code, rec = _post_json(svc.port, "/jobs", _stub_body(seed=22))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if svc.scheduler.get(rec["job_id"])["status"] == "done":
+                break
+            time.sleep(0.05)
+        conn, resp = _sse_open(svc.port, rec["job_id"])
+        try:
+            name, data = _sse_read_frame(resp)
+            assert name == "state" and data["status"] == "done"
+            # Stream ends: the next read hits EOF.
+            assert resp.fp.readline() == b""
+        finally:
+            conn.close()
+
+    def test_stream_unknown_job_404(self, stub_service):
+        svc, _ex = stub_service
+        conn, resp = _sse_open(svc.port, "deadbeef")
+        try:
+            assert resp.status == 404
+        finally:
+            conn.close()
+
+    def test_disconnect_cancels_when_asked(self, stub_service):
+        svc, ex = stub_service
+        ex.blocks = 200  # long enough to cancel mid-run
+        code, rec = _post_json(svc.port, "/jobs", _stub_body(seed=23))
+        conn, resp = _sse_open(
+            svc.port, rec["job_id"], cancel_on_disconnect=True
+        )
+        name, _ = _sse_read_frame(resp)
+        assert name == "state"
+        # Read one live block, then hang up.  Close the RESPONSE too:
+        # http.client's makefile keeps the fd alive past conn.close(),
+        # and the server detects the disconnect by the socket's EOF.
+        name, _ = _sse_read_frame(resp)
+        resp.close()
+        conn.close()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status = svc.scheduler.get(rec["job_id"])["status"]
+            if status == "cancelled":
+                break
+            time.sleep(0.1)
+        assert status == "cancelled"
+        m = svc.scheduler.metrics()
+        assert m["sse_cancels_total"] == 1
+        assert m["jobs_cancelled_total"] == 1
+        # The slot is reused: a fresh job completes.
+        ex.blocks = 2
+        code, nxt = _post_json(svc.port, "/jobs", _stub_body(seed=24))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if svc.scheduler.get(nxt["job_id"])["status"] == "done":
+                break
+            time.sleep(0.05)
+        assert svc.scheduler.get(nxt["job_id"])["status"] == "done"
+
+    def test_post_cancel_endpoint(self, stub_service):
+        svc, ex = stub_service
+        ex.blocks = 200
+        code, rec = _post_json(svc.port, "/jobs", _stub_body(seed=25))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if svc.scheduler.get(rec["job_id"])["status"] == "running":
+                break
+            time.sleep(0.05)
+        code, out = _post_json(
+            svc.port, f"/jobs/{rec['job_id']}/cancel", {}
+        )
+        assert code == 202
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if svc.scheduler.get(
+                rec["job_id"]
+            )["status"] == "cancelled":
+                break
+            time.sleep(0.1)
+        assert svc.scheduler.get(rec["job_id"])["status"] == "cancelled"
+        code, out = _post_json(svc.port, "/jobs/nope/cancel", {})
+        assert code == 404
+
+    def test_tenant_header_overrides_config(self, stub_service):
+        svc, _ex = stub_service
+        code, rec = _post_json(
+            svc.port, "/jobs", _stub_body(seed=26),
+            headers={"X-Tenant": "header-team"},
+        )
+        assert code == 202
+        assert rec["tenant"] == "header-team"
+        code, out = _post_json(
+            svc.port, "/jobs", _stub_body(seed=27),
+            headers={"X-Tenant": "bad tenant!"},
+        )
+        assert code == 400
+
+
+# ---------------------------------------------------------------------------
+# Report rows (serve-admin report satellite)
+
+
+class TestReportLanes:
+    def _events(self):
+        return [
+            {"ts": 1.0, "event": "job_submitted", "job_id": "j1",
+             "priority": "high", "tenant": "acme"},
+            {"ts": 1.1, "event": "job_submitted", "job_id": "j2",
+             "priority": "low", "tenant": "bulk"},
+            {"ts": 2.0, "event": "span", "name": "queue_wait",
+             "trace_id": "j1", "seconds": 0.5},
+            {"ts": 2.1, "event": "span", "name": "queue_wait",
+             "trace_id": "j2", "seconds": 9.0},
+            {"ts": 3.0, "event": "job_done", "job_id": "j1",
+             "bucket": "b", "seconds": 1.0},
+            {"ts": 3.1, "event": "job_failed", "job_id": "j2",
+             "bucket": "b", "kind": "fatal:x"},
+            {"ts": 3.2, "event": "job_shed", "priority": "low",
+             "tenant": "bulk", "reason": "queue"},
+            {"ts": 3.3, "event": "job_cancelled", "job_id": "j1",
+             "reason": "client_cancel", "stage": "queued"},
+        ]
+
+    def test_summarize_lane_rows(self):
+        from consensus_clustering_tpu.obs.query import summarize
+
+        report = summarize(self._events())
+        pp = report["per_priority"]
+        assert pp["high"]["done"] == 1
+        assert pp["high"]["queue_wait_p95"] == 0.5
+        assert pp["low"]["failed"] == 1
+        assert pp["low"]["shed"] == 1
+        pt = report["per_tenant"]
+        assert pt["acme"]["done"] == 1
+        assert pt["acme"]["cancelled"] == 1
+        assert pt["bulk"]["shed"] == 1
+        assert pt["bulk"]["queue_wait_p95"] == 9.0
+        assert report["jobs"]["job_cancelled"] == 1
+
+    def test_render_report_sections(self):
+        from consensus_clustering_tpu.obs.query import (
+            render_report,
+            summarize,
+        )
+
+        text = render_report(summarize(self._events()))
+        assert "per-priority" in text
+        assert "per-tenant" in text
+        assert "acme" in text and "bulk" in text
+
+    def test_pre_lane_logs_render_without_rows(self):
+        from consensus_clustering_tpu.obs.query import (
+            render_report,
+            summarize,
+        )
+
+        report = summarize([
+            {"ts": 1.0, "event": "job_done", "job_id": "j1",
+             "bucket": "b", "seconds": 1.0},
+        ])
+        # No job_submitted with lane fields: rows file under unknown.
+        assert set(report["per_priority"]) == {"unknown"}
+        render_report(report)  # must not raise
+
+
+# ---------------------------------------------------------------------------
+# Slow lane: the fusion parity gate on the REAL engine
+
+
+@pytest.mark.slow
+class TestFusionParity:
+    @pytest.fixture(scope="class")
+    def executor(self):
+        from consensus_clustering_tpu.serve import SweepExecutor
+
+        return SweepExecutor(
+            use_compilation_cache=False, checkpoint_every=1,
+        )
+
+    def _spec(self, seed):
+        return JobSpec(
+            k_values=(2, 3), n_iterations=16, seed=seed,
+            stream_h_block=4,
+        )
+
+    def _xs(self, k):
+        rng = np.random.default_rng(7)
+        return [
+            rng.normal(size=(40, 3)).astype(np.float32)
+            for _ in range(k)
+        ]
+
+    @pytest.mark.parametrize("k,pad_to", [(2, None), (3, None), (2, 4)])
+    def test_fused_bit_identical_to_solo(self, executor, k, pad_to):
+        """THE parity gate: fused k∈{2,3} same-bucket jobs produce
+        byte-identical result_fingerprints vs solo oracle runs — with
+        and without ballast padding to the canonical width."""
+        xs = self._xs(k)
+        specs = [self._spec(seed=100 + i) for i in range(k)]
+        solo = [
+            executor.run(s, x, None) for s, x in zip(specs, xs)
+        ]
+        fused = executor.run_fused(specs, xs, pad_to=pad_to)
+        for i in range(k):
+            assert (
+                fused[i]["result_fingerprint"]
+                == solo[i]["result_fingerprint"]
+            )
+            assert fused[i]["pac_area"] == solo[i]["pac_area"]
+            assert fused[i]["best_k"] == solo[i]["best_k"]
+            assert fused[i]["fused"] == {"batch": k}
+            assert "fused" not in solo[i]
+
+    def test_resume_from_fused_checkpoints(self, executor, tmp_path):
+        """Fused-written checkpoint frames are solo frames: truncate a
+        fused ring to an interior generation and a SOLO run resumes
+        from it, bit-identical to the uninterrupted oracle."""
+        xs = self._xs(2)
+        specs = [self._spec(seed=200 + i) for i in range(2)]
+        oracle = [
+            executor.run(s, x, None) for s, x in zip(specs, xs)
+        ]
+        dirs = [str(tmp_path / f"ring{i}") for i in range(2)]
+        executor.run_fused(specs, xs, checkpoint_dirs=dirs)
+        # Drop the newest generation in ring 0, leaving an interior
+        # block's frame — the "interrupted mid-fusion" state.
+        gens = sorted(
+            f for f in os.listdir(dirs[0]) if f.startswith("gen-")
+        )
+        assert len(gens) >= 2
+        os.remove(os.path.join(dirs[0], gens[-1]))
+        resumed = executor.run(
+            specs[0], xs[0], None, checkpoint_dir=dirs[0]
+        )
+        assert resumed["resumed_from_block"] > 0
+        assert (
+            resumed["result_fingerprint"]
+            == oracle[0]["result_fingerprint"]
+        )
+
+    def test_scheduler_end_to_end_fused(self, executor, tmp_path):
+        """Three same-bucket jobs submitted to a quiet scheduler fuse
+        into one device program and every result equals its solo
+        oracle."""
+        xs = self._xs(3)
+        specs = [self._spec(seed=300 + i) for i in range(3)]
+        oracle_fps = [
+            executor.run(s, x, None)["result_fingerprint"]
+            for s, x in zip(specs, xs)
+        ]
+        s = Scheduler(
+            executor, JobStore(str(tmp_path / "store")),
+            max_queue=8, fusion_max=3, leases=False,
+        )
+        recs = [
+            s.submit(spec, x) for spec, x in zip(specs, xs)
+        ]
+        s.start()
+        try:
+            for rec, fp in zip(recs, oracle_fps):
+                done = _wait_status(s, rec["job_id"], budget=120.0)
+                assert done["result"]["result_fingerprint"] == fp
+                assert done["result"]["fused"]["batch"] == 3
+            m = s.metrics()
+            assert m["fused_executions_total"] == 1
+            assert m["fused_jobs_total"] == 3
+        finally:
+            s.stop()

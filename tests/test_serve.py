@@ -196,6 +196,15 @@ EXPECTED_METRICS_KEYS = frozenset(
         # Fenced-lease layer (docs/SERVING.md "Multi-worker runbook").
         "worker_id", "active_leases", "lease_takeovers_total",
         "lease_refused_writes_total", "lease_expired_total",
+        # Fair-share scheduling + fusion + streamed results
+        # (docs/SERVING.md "Fair-share & fusion runbook"): the active
+        # schedule, per-lane depths (lane keys traffic-dynamic like
+        # retry_total), starvation grants, fused device programs /
+        # jobs / degrades, client cancels, and the SSE surface.
+        "schedule", "fair_lanes", "fair_starvation_grants_total",
+        "fused_executions_total", "fused_jobs_total",
+        "fusion_degraded_total", "jobs_cancelled_total",
+        "sse_streams_total", "sse_cancels_total",
     }
 )
 
@@ -206,6 +215,17 @@ def test_metrics_schema(base):
     assert set(m) == EXPECTED_METRICS_KEYS
     assert isinstance(m["retry_total"], dict)
     assert isinstance(m["autotune_provenance_total"], dict)
+    # Fair-share layer (docs/SERVING.md "Fair-share & fusion
+    # runbook"): the schedule label and per-lane depth dict.
+    assert m["schedule"] in ("fair", "fifo")
+    assert isinstance(m["fair_lanes"], dict)
+    for key in (
+        "fair_starvation_grants_total", "fused_executions_total",
+        "fused_jobs_total", "fusion_degraded_total",
+        "jobs_cancelled_total", "sse_streams_total",
+        "sse_cancels_total",
+    ):
+        assert isinstance(m[key], int), key
     # Pre-seeded with every priority at construction (the dict-copy-
     # races-first-insert class): the key set never changes.
     assert set(m["jobs_shed_total"]) == {"high", "normal", "low"}
