@@ -97,13 +97,144 @@ def plan_for(row_shards, n, h, k_values, clusterer=None, cluster_batch=None,
     return stats
 
 
+def streaming_plan(n, h, h_block, accum_repr, k_values=(2, 3),
+                   n_features=16):
+    """Per-device compiled memory plan of the STREAMING block program at
+    one (N, H) shape for one accumulator representation — the packed
+    arm's measurement (dense-vs-packed at identical shapes)."""
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.streaming import StreamingSweep
+
+    config = SweepConfig(
+        n_samples=n, n_features=n_features, k_values=tuple(k_values),
+        n_iterations=h, store_matrices=False, stream_h_block=h_block,
+        accum_repr=accum_repr,
+    )
+    t0 = time.perf_counter()
+    engine = StreamingSweep(KMeans(n_init=1), config)
+    stats = engine.compiled_memory_stats()
+    # AOT lower+compile only, never executed; .compile() blocks on the
+    # host, so the wall here is trace+compile.
+    stats["compile_seconds"] = round(time.perf_counter() - t0, 2)  # jaxlint: disable=JL007
+    stats["packed_kernel"] = engine.packed_kernel
+    return stats
+
+
+def packed_record(args):
+    """The ``--packed`` arm: measure dense-vs-packed streaming plans at
+    one shape, price both byte models, and derive the exact-mode
+    ADMISSION FRONTIER under a pinned budget — the committed evidence
+    (benchmarks/packed_scaling/PACKED_SCALING.json) that the bit-plane
+    representation moves the wall, not just the model
+    (tests/test_memory_scaling.py pins the measured-vs-model agreement
+    and the frontier's dense-413/packed-admitted witness shape)."""
+    from consensus_clustering_tpu.serve.preflight import (
+        PreflightReject,
+        check_admission,
+        estimate_job_bytes,
+        estimate_packed_bytes,
+    )
+
+    sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+    from roofline import accumulator_state_bytes
+
+    h_block = args.h_block or max(1, min(32, args.h))
+    out = {
+        "n": args.n, "h": args.h, "h_block": h_block,
+        "k_values": [2, 3],
+        "budget_bytes": int(args.budget),
+        "model": {
+            "state": accumulator_state_bytes(
+                args.n, args.h, (2, 3), h_block=h_block
+            ),
+            "dense_total": estimate_job_bytes(
+                args.n, 16, (2, 3), h_block=h_block
+            ),
+            "packed_total": estimate_packed_bytes(
+                args.n, 16, (2, 3), n_iterations=args.h,
+                h_block=h_block,
+            ),
+        },
+        "measured_plan": {
+            "dense": streaming_plan(args.n, args.h, h_block, "dense"),
+            "packed": streaming_plan(args.n, args.h, h_block, "packed"),
+        },
+    }
+    # Admission frontier under the pinned budget: the serving K sweep
+    # shape (K=2..10, d=16, H=args.h) priced by both models over a
+    # geometric N grid; the witness shape is the first N the packed
+    # model admits and the dense model 413s.
+    k_sweep = tuple(range(2, 11))
+    frontier = {"dense_max_n": 0, "packed_max_n": 0, "witness": None}
+    n_grid = [1 << s for s in range(9, 22)]
+    for n in n_grid:
+        dense = estimate_job_bytes(n, 16, k_sweep, h_block=h_block)
+        packed = estimate_packed_bytes(
+            n, 16, k_sweep, n_iterations=args.h, h_block=h_block
+        )
+        if dense["total_bytes"] <= args.budget:
+            frontier["dense_max_n"] = n
+        if packed["total_bytes"] <= args.budget:
+            frontier["packed_max_n"] = n
+        if (
+            frontier["witness"] is None
+            and dense["total_bytes"] > args.budget
+            and packed["total_bytes"] <= args.budget
+        ):
+            # Prove the 413 asymmetry through the real admission gate.
+            try:
+                check_admission(dense, args.budget, (n, 16))
+                raise AssertionError("dense model should have 413d")
+            except PreflightReject as e:
+                reject = {
+                    "estimated_bytes": e.payload["estimated_bytes"],
+                    "budget_bytes": e.payload["budget_bytes"],
+                }
+            check_admission(packed, args.budget, (n, 16))  # must pass
+            frontier["witness"] = {
+                "n": n, "d": 16, "k_values": list(k_sweep),
+                "h": args.h,
+                "dense_413": reject,
+                "packed_total_bytes": int(packed["total_bytes"]),
+            }
+    out["admission_frontier"] = frontier
+    print(
+        f"dense plan total={out['measured_plan']['dense'].get('total_bytes', 0)/1e6:.1f} MB "
+        f"packed plan total={out['measured_plan']['packed'].get('total_bytes', 0)/1e6:.1f} MB "
+        f"state model dense={out['model']['state']['dense_bytes']/1e6:.1f} MB "
+        f"packed={out['model']['state']['packed_bytes']/1e6:.1f} MB "
+        f"({out['model']['state']['compression']:.0f}x); frontier "
+        f"dense N<={frontier['dense_max_n']} packed N<="
+        f"{frontier['packed_max_n']}",
+        file=sys.stderr,
+    )
+    print(json.dumps(out, indent=1))
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--n", type=int, default=4096)
     p.add_argument("--h", type=int, default=8)
     p.add_argument("--spectral-plan", action="store_true",
                    help="also compile BASELINE #5 at true shape (slow)")
+    p.add_argument("--packed", action="store_true",
+                   help="measure the dense-vs-packed streaming plans + "
+                        "the pinned-budget admission frontier instead "
+                        "of the row-shard table (ROADMAP item 1)")
+    p.add_argument("--h-block", type=int, default=0,
+                   help="with --packed: streaming block size (default "
+                        "min(32, H))")
+    p.add_argument("--budget", type=int, default=8 << 30,
+                   help="with --packed: pinned admission budget in "
+                        "bytes (default 8 GiB — the estimator_scaling "
+                        "record's budget, so the frontiers compare)")
     args = p.parse_args(argv)
+
+    if args.packed:
+        _force_fake_devices(1)
+        return packed_record(args)
 
     _force_fake_devices()
     out = {"n": args.n, "h": args.h, "k_values": [2, 3],

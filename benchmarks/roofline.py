@@ -185,6 +185,53 @@ def _coassoc_bytes(n_rows, n_cols, chunk, k_max, chunks):
     return chunks * (2 * n_rows * n_cols * 4 + chunk * k_max * n_cols * 2)
 
 
+def accumulator_state_bytes(n, h, k_values, h_block=None):
+    """Dense vs packed accumulator byte model — the two representations'
+    PERSISTENT streaming state, priced side by side (ROADMAP item 1;
+    the admission-facing twin lives in serve/preflight.py and must stay
+    consistent with this one — tests/test_roofline.py pins both).
+
+    - dense: per-K int32 (N, N) Mij row blocks + Iij ->
+      ``4*(nK+1)*N^2``.
+    - packed: per-K per-cluster uint32 bit-planes, resamples packed
+      32-per-word with whole words per streamed block, + the
+      co-sampling plane -> ``4*(nK*k_max + 1) * ceil(H/hb)*ceil(hb/32)
+      * N`` (ops/bitpack.py layout).  Per co-membership ENTRY that is
+      exactly 1 bit vs the dense one-hot's 32 — the ~1/32 model of the
+      PR title — and as a state ratio it is ``32*N*(nK+1) /
+      (H*k_max*nK)``-ish: the packed representation wins everywhere
+      ``H*k_max << 32*N``, i.e. every serving shape that 413s today.
+    """
+    k_values = list(k_values)
+    nk = len(k_values)
+    k_max = max(k_values)
+    hb = int(h_block) if h_block else int(h)
+    w_cap = -(-int(h) // hb) * (-(-hb // 32))
+    dense = 4 * (nk + 1) * n * n
+    packed = 4 * (nk * k_max + 1) * w_cap * n
+    return {
+        "dense_bytes": int(dense),
+        "packed_bytes": int(packed),
+        "compression": dense / packed,
+    }
+
+
+def packed_report(config_name, h_block=None):
+    """Print the packed-vs-dense accumulator pricing for one config —
+    the roofline narrative's representation table (PERF.md)."""
+    fs = FULL_SHAPES[config_name]
+    n, h = fs["n"], fs["h"]
+    k_values = list(range(2, fs["k_hi"] + 1))
+    b = accumulator_state_bytes(n, h, k_values, h_block=h_block)
+    hb = h_block or h
+    print(f"\npacked accumulator model ({config_name}, h_block={hb}): "
+          f"dense {b['dense_bytes']/1e9:.2f} GB vs packed "
+          f"{b['packed_bytes']/1e9:.3f} GB "
+          f"({b['compression']:.0f}x compression; 1 bit vs 32 per "
+          "co-membership entry — ops/bitpack.py)")
+    return b
+
+
 def _floor_secs(flops, passes, b_lo, b_hi):
     """[lo, hi] roofline floor seconds for one phase."""
     ft = flops * passes / PEAK_BF16
@@ -526,6 +573,7 @@ def main(argv=None):
           "(Precision.HIGHEST = 6 bf16 passes)")
     for name in names:
         report(name)
+        packed_report(name, h_block=32)
         if args.mesh:
             project(name, *_parse_mesh(args.mesh),
                     interleave=args.interleave)

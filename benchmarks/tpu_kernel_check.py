@@ -1,13 +1,16 @@
 """Compiled (non-interpret) Pallas kernel verification on the real chip.
 
-The unit suite runs BOTH Pallas kernels (consensus histogram, fused Lloyd
-step) in interpreter mode on a CPU backend (tests/conftest.py pins
-JAX_PLATFORMS=cpu), which cannot catch Mosaic lowering failures — round 1
-shipped a kernel that passed every test and crashed on hardware ("Cannot
-store scalars to VMEM").  This script is the hardware gate: it compiles
-each kernel for the active accelerator and checks it against the same
-NumPy references the unit suite uses (histogram: bit-exact; Lloyd sums:
-f32-reduction-order tolerance, counts exact).
+The unit suite runs the Pallas kernels (consensus histogram, fused Lloyd
+step, packed popcount co-occurrence) in interpreter mode on a CPU
+backend (tests/conftest.py pins JAX_PLATFORMS=cpu), which cannot catch
+Mosaic lowering failures — round 1 shipped a kernel that passed every
+test and crashed on hardware ("Cannot store scalars to VMEM"; that
+BENCH_r01 tail is exactly the bug class the packed-coassoc lane below
+exists to catch).  This script is the hardware gate: it compiles each
+kernel for the active accelerator and checks it against the same
+references the unit suite uses (histogram: bit-exact; Lloyd sums:
+f32-reduction-order tolerance, counts exact; popcount co-occurrence:
+bit-exact vs the lax path).
 
 Run on TPU:  python benchmarks/tpu_kernel_check.py
 Exit code 0 = kernels proven on this backend; 1 = mismatch or crash.
@@ -74,6 +77,60 @@ def _check_lloyd(rng) -> int:
     return failures
 
 
+def _check_coassoc(rng) -> int:
+    """Compiled-mode verdict on the fused popcount co-occurrence kernel
+    (ops/pallas_coassoc.py) — the BENCH_r01 Mosaic-lowering bug class is
+    exactly what this lane exists to catch before a bench round does.
+    A crash here is reported (with the auto-degrade verdict the probe
+    gate would reach) and counted, never raised: the gate's whole
+    contract is that a lowering failure costs the lax path's speed,
+    not the job."""
+    from consensus_clustering_tpu.ops.bitpack import popcount_accumulate
+    from consensus_clustering_tpu.ops.pallas_coassoc import (
+        packed_coassoc_counts,
+        packed_kernel_available,
+    )
+
+    failures = 0
+    cases = [
+        (1, 8, 32),        # single word, sub-tile
+        (13, 264, 300),    # the probe's ragged multi-tile grid
+        (40, 128, 256),    # tile-aligned
+        (9, 31, 129),      # ragged on every axis
+        (65, 512, 512),    # multi word-block accumulation
+    ]
+    for l_words, r, c in cases:
+        rows = rng.integers(
+            0, 2**32, size=(l_words, r), dtype=np.uint32
+        )
+        cols = rng.integers(
+            0, 2**32, size=(l_words, c), dtype=np.uint32
+        )
+        # The pure-lax popcount path is the reference: kernel-vs-lax
+        # bit-identity is the parity contract the engines rely on.
+        want = np.asarray(
+            popcount_accumulate(jnp.asarray(rows), jnp.asarray(cols))
+        )
+        try:
+            got = np.asarray(packed_coassoc_counts(
+                jnp.asarray(rows), jnp.asarray(cols), use_kernel=True
+            ))
+        except Exception as exc:  # noqa: BLE001 — report, keep checking
+            print(f"FAIL coassoc L={l_words} {r}x{c}: "
+                  f"{type(exc).__name__}: {exc}")
+            print(f"     (probe gate verdict: packed_kernel_available()"
+                  f"={packed_kernel_available()} — jobs degrade to the "
+                  "lax popcount path, disclosed as packed_kernel=lax)")
+            failures += 1
+            continue
+        if (got == want).all():
+            print(f"ok   coassoc L={l_words} {r}x{c} sum={got.sum()}")
+        else:
+            print(f"FAIL coassoc L={l_words} {r}x{c}: kernel != lax")
+            failures += 1
+    return failures
+
+
 def main() -> int:
     backend = jax.default_backend()
     if backend == "cpu":
@@ -109,6 +166,7 @@ def main() -> int:
             print(f"FAIL {shape}: got {got} want {want}")
             failures += 1
     failures += _check_lloyd(rng)
+    failures += _check_coassoc(rng)
     print(f"kernel_check: backend={backend} failures={failures}")
     return 1 if failures else 0
 

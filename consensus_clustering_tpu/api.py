@@ -199,6 +199,20 @@ class ConsensusClustering:
         they run: a crash mid-batch resumes from the last completed
         BLOCK (bit-identically) instead of the last completed K batch
         (docs/ARCHITECTURE.md "Resilience").
+    accum_repr : {'dense', 'packed'}, keyword-only
+        Exact-mode accumulator representation (``config.ACCUM_REPRS``).
+        ``'packed'`` holds per-resample co-membership as uint32
+        bit-plane masks (``ops.bitpack``) and accumulates co-occurrence
+        via popcount — ~1/32 the accumulator HBM bytes, so exact mode
+        fits an order of magnitude more samples before the memory wall;
+        with ``stream_h_block`` the streamed state carries ONLY the
+        packed planes, materialising int32 ``Mij``/``Iij`` row tiles at
+        evaluate/finalize boundaries.  Results are bit-identical to
+        ``'dense'`` at every shape (the tested parity gate); the knob
+        never changes the statistic.  ``timing_['packed_kernel']``
+        discloses whether the fused Pallas popcount kernel or the lax
+        fallback ran.  Ignored (with a log message) for host-backend
+        clusterers.
     adaptive_tol : float, keyword-only, optional
         With ``stream_h_block``: stop the stream early once every K's
         PAC moved less than this for ``adaptive_patience`` consecutive
@@ -316,6 +330,8 @@ class ConsensusClustering:
         compute_dtype: str = "float32",
         delta_k_threshold: float = _DELTA_K_THRESHOLD,
         stream_h_block: Optional[int] = None,
+        accum_repr: str = "dense",
+        use_packed_kernel: Optional[bool] = None,
         adaptive_tol: Optional[float] = None,
         adaptive_patience: int = 2,
         adaptive_min_h: int = 0,
@@ -396,6 +412,10 @@ class ConsensusClustering:
         # adaptive/store_matrices interaction needs the resolved
         # store_matrices, which depends on N).
         self.stream_h_block = stream_h_block
+        from consensus_clustering_tpu.config import validate_accum_repr
+
+        self.accum_repr = validate_accum_repr(accum_repr)
+        self.use_packed_kernel = use_packed_kernel
         self.adaptive_tol = adaptive_tol
         self.adaptive_patience = adaptive_patience
         self.adaptive_min_h = adaptive_min_h
@@ -654,6 +674,8 @@ class ConsensusClustering:
             k_interleave=self.k_interleave,
             reseed_clusterer_per_resample=self.reseed_clusterer_per_resample,
             stream_h_block=stream_h_block,
+            accum_repr=self.accum_repr,
+            use_packed_kernel=self.use_packed_kernel,
             adaptive_tol=self.adaptive_tol,
             adaptive_patience=self.adaptive_patience,
             adaptive_min_h=self.adaptive_min_h,
@@ -693,6 +715,12 @@ class ConsensusClustering:
                     "stream_h_block is a device-path feature; the host "
                     "backend labels resamples in a Python loop and has "
                     "no compiled block to stream — running the host "
+                    "sweep normally"
+                )
+            if is_host and self.accum_repr != "dense":
+                logger.info(
+                    "accum_repr is a device-path feature; the host "
+                    "backend accumulates in numpy — running the host "
                     "sweep normally"
                 )
             if is_host and self.progress_callback is not None:

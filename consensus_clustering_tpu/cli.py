@@ -170,6 +170,10 @@ def cmd_run(args):
         compute_dtype=args.compute_dtype,
         progress_callback=progress_cb,
         stream_h_block=args.stream or None,
+        accum_repr=args.accum_repr,
+        use_packed_kernel={
+            "auto": None, "on": True, "off": False
+        }[args.packed_kernel],
         adaptive_tol=args.adaptive,
         adaptive_patience=args.adaptive_patience,
         adaptive_min_h=args.adaptive_min_h,
@@ -537,6 +541,20 @@ def main(argv=None):
     run.add_argument("--k-batch-size", type=int, default=None,
                      help="compile/run the sweep in batches of this many "
                           "K values, checkpointing after each")
+    run.add_argument("--accum-repr", choices=["dense", "packed"],
+                     default="dense",
+                     help="exact-mode accumulator representation: "
+                          "'packed' holds co-membership as uint32 "
+                          "bit-plane masks and accumulates via popcount "
+                          "(~1/32 the accumulator HBM bytes, results "
+                          "bit-identical; config.ACCUM_REPRS)")
+    run.add_argument("--packed-kernel", choices=["auto", "on", "off"],
+                     default="auto",
+                     help="with --accum-repr packed: fused Pallas "
+                          "popcount kernel on/off, or probe the backend "
+                          "(auto; any Mosaic lowering failure degrades "
+                          "to the lax path, disclosed in timing as "
+                          "packed_kernel)")
     run.add_argument("--stream", type=int, default=0, metavar="H_BLOCK",
                      help="stream the sweep in compiled blocks of this "
                      "many resamples with device-resident accumulators "

@@ -34,6 +34,32 @@ from consensus_clustering_tpu.ops.resample import subsample_size
 #:   mode.
 ESTIMATOR_MODES = ("exact", "estimate", "auto")
 
+#: Exact-mode accumulator representations every surface shares
+#: (api.py ``accum_repr``, the serving ``config.accum_repr`` key,
+#: ``cli run --accum-repr``):
+#:
+#: - ``dense``  — int32 per-K (N, N) ``Mij`` row blocks + ``Iij``; the
+#:   original layout, O(N²) state per K.
+#: - ``packed`` — per-resample co-membership held as uint32 bit-plane
+#:   masks (:mod:`consensus_clustering_tpu.ops.bitpack`), co-occurrence
+#:   accumulated via popcount; ~1/32 the accumulator bytes, int32
+#:   ``Mij``/``Iij`` materialised only at evaluate/finalize boundaries.
+#:   Counts are bit-identical to ``dense`` — the representation changes
+#:   HBM bytes, never the statistic.
+ACCUM_REPRS = ("dense", "packed")
+
+
+def validate_accum_repr(accum_repr: str) -> str:
+    """Validate (and return) an accumulator representation; shared by
+    the api constructor, the CLI, and the serving job-spec parser so
+    all three reject the same vocabulary the same way."""
+    if accum_repr not in ACCUM_REPRS:
+        raise ValueError(
+            f"accum_repr must be one of {list(ACCUM_REPRS)}, got "
+            f"{accum_repr!r}"
+        )
+    return accum_repr
+
 
 def validate_mode(mode: str) -> str:
     """Validate (and return) a consensus execution mode; shared by the
@@ -179,6 +205,28 @@ class SweepConfig:
         override it per run.  The check is one fused pass over the
         state per checked block (measured within CPU session noise at
         every cadence — benchmarks/integrity_overhead.py, PERF.md).
+      accum_repr: exact-mode accumulator representation (``ACCUM_REPRS``).
+        ``dense`` (default) keeps int32 (N, N) ``Mij`` row blocks per K;
+        ``packed`` re-represents per-resample co-membership as uint32
+        bit-plane masks (ops.bitpack) and accumulates co-occurrence via
+        popcount — ~1/32 the accumulator HBM bytes, with int32
+        ``Mij``/``Iij`` materialised in row tiles only at evaluate /
+        finalize boundaries (the streaming engine carries ONLY the
+        packed planes between blocks).  Counts are bit-identical to
+        ``dense`` at every shape (the parity gate in
+        tests/test_packed_parity.py), so the knob never enters result
+        fingerprints — but it DOES shape the streamed checkpoint state,
+        so packed and dense stream generations never cross-resume
+        (utils.checkpoint.stream_fingerprint).  With streaming on, the
+        packed state is sized by ``n_iterations`` at build time:
+        ``StreamingSweep.run`` accepts any H up to that capacity.
+      use_packed_kernel: with ``accum_repr="packed"``: True forces the
+        fused Pallas popcount kernel (ops.pallas_coassoc), False forces
+        the pure-lax popcount path, None probes the backend (kernel on
+        accelerators iff its compile-and-run probe passes — any Mosaic
+        lowering failure degrades to lax, disclosed as
+        ``packed_kernel: pallas|lax`` in result timing).  Ignored for
+        ``dense``.
       use_pallas: True forces the Pallas consensus-histogram kernel, False
         forces the XLA fallback, None picks by backend (Pallas on TPU).
       dtype: working float dtype for the data and the inner clusterers
@@ -210,10 +258,13 @@ class SweepConfig:
     adaptive_patience: int = 2
     adaptive_min_h: int = 0
     integrity_check_every: int = 0
+    accum_repr: str = "dense"
+    use_packed_kernel: Optional[bool] = None
     use_pallas: Optional[bool] = None
     dtype: str = "float32"
 
     def __post_init__(self):
+        validate_accum_repr(self.accum_repr)
         if self.dtype not in ("float32", "float64"):
             raise ValueError(
                 f"dtype must be 'float32' or 'float64', got {self.dtype!r}"

@@ -401,8 +401,15 @@ def tainted_names(ctx: ModuleContext, func: FunctionInfo) -> Set[str]:
 #: ``consensus_clustering_tpu/estimator/`` silently re-erects the
 #: memory wall the subsystem removes, which no unit test at small N
 #: would ever notice.
+#: ``packed``: the bit-plane accumulation path (``ops/bitpack.py``,
+#: ``ops/pallas_coassoc.py``, any future ``packed/`` directory) exists
+#: to keep per-resample co-membership ONE BIT wide — a dense (N, N)
+#: unpack/materialisation inside it re-erects the 32× HBM cost the
+#: representation removes.  Scope: the ``packed`` directory rule plus
+#: the two flat ops modules (``rules.PACKED_PATH_MODULES``).
 RULE_PACKS: Dict[str, Tuple[str, ...]] = {
     "estimator": ("JL009",),
+    "packed": ("JL010",),
 }
 
 

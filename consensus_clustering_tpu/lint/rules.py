@@ -1014,3 +1014,72 @@ class EstimatorDenseAlloc(Rule):
             if not isinstance(e, ast.Constant)
         ]
         return len(rendered) != len(set(rendered))
+
+
+# The `packed` rule pack: the bit-plane accumulation path
+# (ops/bitpack.py, ops/pallas_coassoc.py — the modules
+# PACKED_PATH_MODULES names, plus any future packed/ subdirectory).
+# Its reason to exist is that per-resample co-membership stays 1 BIT
+# wide end to end; unpacking the masks back into a dense (N, N) object
+# — or calling one of the dense exact-engine builders — inside that
+# path would silently re-pay the 32x the representation removed, and
+# no small-N unit test would notice.
+
+#: File stems that ARE the packed accumulation path today.  The pack
+#: scope is directory-based like every pack (a future ops/packed/
+#: lands inside automatically); these two modules live flat in ops/,
+#: so the rule matches them by name as well.
+PACKED_PATH_MODULES = frozenset({"bitpack.py", "pallas_coassoc.py"})
+
+
+@register
+class PackedDenseMaterialize(Rule):
+    id = "JL010"
+    name = "packed-dense-materialize"
+    summary = (
+        "dense (N, N) unpack/materialisation (or dense exact-engine "
+        "builder call) inside the packed accumulation path: silently "
+        "re-pays the 32x HBM bytes the bit-plane representation "
+        "removes"
+    )
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        import re as _re
+
+        parts = _re.split(r"[\\/]+", ctx.path)
+        if not (
+            in_pack_scope(ctx.path, "packed")
+            or (parts and parts[-1] in PACKED_PATH_MODULES)
+        ):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.resolve_call(node)
+            if qual is None:
+                continue
+            if qual in _DENSE_BUILDERS:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"{qual.rsplit('.', 1)[-1]}() builds (a row block "
+                    "of) a dense N x N matrix — the packed "
+                    "accumulation path must stay bit-planes + "
+                    "popcount tiles; materialise int32 counts only at "
+                    "the engines' evaluate/finalize boundaries "
+                    "(docs/LINT.md JL010)",
+                ))
+                continue
+            if qual in _ALLOCATOR_CALLS and EstimatorDenseAlloc\
+                    ._square_shape(node):
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "allocation with a repeated symbolic dimension "
+                    "(shape like (n, n)) inside the packed "
+                    "accumulation path — packed state is O(H*k*N/32) "
+                    "words and tiles are (tile_r, n), never square in "
+                    "N; if the repeated dimension is not N, rename "
+                    "one of them or suppress with a reason "
+                    "(docs/LINT.md JL010)",
+                ))
+        return findings

@@ -5,6 +5,13 @@ from consensus_clustering_tpu.ops.resample import (
     indicator_matrix,
     cosample_counts,
 )
+from consensus_clustering_tpu.ops.bitpack import (
+    cosample_masks,
+    membership_masks,
+    pack_bits,
+    popcount_accumulate,
+    unpack_bits,
+)
 from consensus_clustering_tpu.ops.coassoc import coassociation_counts
 from consensus_clustering_tpu.ops.analysis import (
     consensus_matrix,
@@ -23,6 +30,11 @@ __all__ = [
     "indicator_matrix",
     "cosample_counts",
     "coassociation_counts",
+    "cosample_masks",
+    "membership_masks",
+    "pack_bits",
+    "popcount_accumulate",
+    "unpack_bits",
     "consensus_matrix",
     "cdf_pac",
     "cdf_pac_from_counts",

@@ -1,0 +1,331 @@
+"""Bit-packed co-membership masks and the popcount co-occurrence primitive.
+
+The dense accumulation path re-represents each resample as a bf16 one-hot
+(K_max, N) matrix and GEMMs partial counts into int32 ``Mij`` row blocks
+(:mod:`consensus_clustering_tpu.ops.coassoc`).  Every entry of those
+one-hots — and of the per-resample co-membership they encode — is a 0/1
+value stored 16 or 32 bits wide.  This module stores it in ONE bit, the
+Monti et al. connectivity/indicator-matrix formulation taken literally
+(PAPER.md: each resample contributes a 0/1 matrix — bits, not int32s):
+
+- **Per-resample membership masks** (:func:`membership_masks`): labels of
+  one resample become per-cluster uint32 bit-plane masks of shape
+  ``(k_max, ceil(N/32))`` — bit ``i % 32`` of word ``[c, i // 32]`` is
+  "element i belongs to cluster c".  The co-sampling indicator packs the
+  same way as one extra bit-plane (:func:`cosample_masks`).  This is the
+  reference/debug layout: 1/32 the bytes of an int32 indicator,
+  round-trippable via :func:`pack_bits` / :func:`unpack_bits`.
+- **Accumulation layout** (:func:`pack_label_planes`): for the popcount
+  co-occurrence trick the same bits are packed along the RESAMPLE axis —
+  ``planes[c, w, i]`` holds, in its 32 bits, element i's membership in
+  cluster c across resamples ``32w .. 32w+31``.  Read down a column and
+  ``planes[c, :, i]`` is element i's bit-mask through cluster plane c;
+  co-occurrence is then exactly ``Mij[i, j] += popcount(mask_i & mask_j)``
+  accumulated per cluster plane, which :func:`popcount_accumulate`
+  evaluates tile by tile (the fused Pallas version lives in
+  :mod:`consensus_clustering_tpu.ops.pallas_coassoc`).
+
+Exactness: popcount sums are exact integers by construction, and the
+packing drops exactly the entries the dense one-hot drops (negative /
+out-of-range labels and indices), so the packed counts equal the dense
+f32-GEMM counts bit for bit as int32 — the parity gate the resume/dedup/
+integrity story rests on (tests/test_bitpack.py, tests/test_packed_parity
+.py).
+
+Everything here is pure ``lax``/``jnp`` and runs on every backend; no
+Pallas, no host round trips.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: Bits per packed word — the uint32 lane width every packing helper and
+#: byte model in this repo assumes (serve/preflight.py, benchmarks/
+#: roofline.py price the packed representation against it).
+PACK_BITS = 32
+
+
+def packed_width(n: int) -> int:
+    """Words needed to hold ``n`` bits: ``ceil(n / 32)``."""
+    return -(-int(n) // PACK_BITS)
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """Pack a {0, 1} integer array along its LAST axis into uint32 words.
+
+    ``(..., n)`` -> ``(..., ceil(n/32))``; bit ``i % 32`` of word
+    ``i // 32`` is ``bits[..., i]``.  Tail bits beyond ``n`` are zero.
+    """
+    n = bits.shape[-1]
+    w = packed_width(n)
+    pad = w * PACK_BITS - n
+    if pad:
+        bits = jnp.concatenate(
+            [
+                bits,
+                jnp.zeros(bits.shape[:-1] + (pad,), bits.dtype),
+            ],
+            axis=-1,
+        )
+    b = bits.reshape(bits.shape[:-1] + (w, PACK_BITS)).astype(jnp.uint32)
+    # Explicit rank: the suite traces under rank_promotion="raise".
+    shifts = jnp.left_shift(
+        jnp.uint32(1), jnp.arange(PACK_BITS, dtype=jnp.uint32)
+    ).reshape((1,) * (b.ndim - 1) + (PACK_BITS,))
+    return jnp.sum(b * shifts, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jax.Array, n: int) -> jax.Array:
+    """Inverse of :func:`pack_bits`: ``(..., w)`` uint32 -> ``(..., n)``
+    int32 {0, 1}."""
+    shifts = jnp.arange(PACK_BITS, dtype=jnp.uint32).reshape(
+        (1,) * words.ndim + (PACK_BITS,)
+    )
+    bits = jnp.right_shift(words[..., None], shifts) & jnp.uint32(1)
+    out = bits.reshape(words.shape[:-1] + (words.shape[-1] * PACK_BITS,))
+    return out[..., :n].astype(jnp.int32)
+
+
+def _valid_scatter(labels, indices, k_max, n_cols):
+    """Shared validity rule with the dense one-hot builders: negative or
+    >= k_max labels and negative indices are dropped — invalid entries
+    are redirected to column ``n_cols``, which is genuinely out of
+    bounds, because JAX wraps negative indices Python-style *before*
+    ``mode="drop"`` can drop them."""
+    valid = (labels >= 0) & (labels < k_max) & (indices >= 0)
+    lab = jnp.where(valid, labels, 0)
+    col = jnp.where(valid, indices, n_cols)
+    return lab, col
+
+
+def membership_masks(
+    labels: jax.Array,
+    indices: jax.Array,
+    k_max: int,
+    n_cols: int,
+) -> jax.Array:
+    """Per-resample per-cluster bit-plane masks, packed along N.
+
+    Args:
+      labels: (H, n_sub) int32 cluster labels (negative = dropped).
+      indices: (H, n_sub) int32 subsample indices (negative = dropped).
+      k_max: static one-hot height.
+      n_cols: mask width before packing (required — the caller knows N;
+        columns >= the real N simply stay zero).
+
+    Returns:
+      (H, k_max, ceil(n_cols/32)) uint32 — resample h's cluster-c members
+      are the set bits of ``out[h, c]``.
+    """
+    h_rows = labels.shape[0]
+    lab, col = _valid_scatter(labels, indices, k_max, n_cols)
+    onehot = jnp.zeros((h_rows, k_max, n_cols), jnp.uint32)
+    rows = jnp.arange(h_rows, dtype=jnp.int32)[:, None]
+    onehot = onehot.at[rows, lab, col].set(1, mode="drop")
+    return pack_bits(onehot)
+
+
+def cosample_masks(indices: jax.Array, n_cols: int) -> jax.Array:
+    """(H, ceil(n_cols/32)) uint32 co-sampling bit-planes: resample h's
+    sampled elements are the set bits of ``out[h]`` — the one extra
+    bit-plane next to :func:`membership_masks`' cluster planes."""
+    h_rows = indices.shape[0]
+    col = jnp.where(indices >= 0, indices, n_cols)
+    onehot = jnp.zeros((h_rows, n_cols), jnp.uint32)
+    rows = jnp.arange(h_rows, dtype=jnp.int32)[:, None]
+    onehot = onehot.at[rows, col].set(1, mode="drop")
+    return pack_bits(onehot)
+
+
+def pack_label_planes(
+    labels: jax.Array,
+    indices: jax.Array,
+    k_max: int,
+    n_cols: int,
+    *,
+    n_words: Optional[int] = None,
+    row0=0,
+) -> jax.Array:
+    """Accumulation-layout packing: resamples become BITS of uint32 words.
+
+    Resample row ``j`` of ``labels`` lands at global bit position
+    ``row0 + j``: bit ``(row0 + j) % 32`` of word ``(row0 + j) // 32``.
+    One scatter-add builds the whole array — every (resample, element)
+    pair contributes at most one DISTINCT bit (indices are drawn without
+    replacement within a resample, and different resamples own different
+    bits), so integer add equals bitwise OR exactly.  That same
+    disjointness is why mesh shards can each pack their own resample
+    rows into a zero array and ``psum`` the contributions
+    (parallel/streaming.py's packed block update).
+
+    Args:
+      labels/indices: (R, n_sub) int32; invalid entries dropped as in the
+        dense one-hot.
+      k_max: cluster-plane count.
+      n_cols: element-axis width.
+      n_words: word-axis length (required when ``row0`` is traced;
+        defaults to ``ceil((row0 + R) / 32)`` for a static ``row0``).
+      row0: global bit offset of row 0 — static or traced.
+
+    Returns:
+      (k_max, n_words, n_cols) uint32.
+    """
+    h_rows = labels.shape[0]
+    if n_words is None:
+        n_words = packed_width(int(row0) + h_rows)
+    pos = jnp.asarray(row0, jnp.int32) + jnp.arange(h_rows, dtype=jnp.int32)
+    word = (pos // PACK_BITS)[:, None]
+    shift = (pos % PACK_BITS).astype(jnp.uint32)[:, None]
+    lab, col = _valid_scatter(labels, indices, k_max, n_cols)
+    vals = jnp.broadcast_to(
+        jnp.left_shift(jnp.uint32(1), shift), labels.shape
+    )
+    word = jnp.broadcast_to(word, labels.shape)
+    planes = jnp.zeros((k_max, n_words, n_cols), jnp.uint32)
+    return planes.at[lab, word, col].add(vals, mode="drop")
+
+
+def pack_cosample_planes(
+    indices: jax.Array,
+    n_cols: int,
+    *,
+    n_words: Optional[int] = None,
+    row0=0,
+) -> jax.Array:
+    """(n_words, n_cols) uint32 co-sampling planes in the accumulation
+    layout — :func:`pack_label_planes` with the single sampled/unsampled
+    plane instead of ``k_max`` cluster planes (delegated, so the
+    bit-position contract the sentinel and frame verifier depend on has
+    exactly one implementation)."""
+    return pack_label_planes(
+        jnp.zeros_like(indices), indices, 1, n_cols,
+        n_words=n_words, row0=row0,
+    )[0]
+
+
+def popcount_accumulate(
+    row_words: jax.Array,
+    col_words: jax.Array,
+    *,
+    word_chunk: int = 4,
+) -> jax.Array:
+    """The popcount co-occurrence primitive, pure ``lax`` — the
+    always-available fallback behind the fused Pallas kernel
+    (:mod:`consensus_clustering_tpu.ops.pallas_coassoc`).
+
+    ``out[i, j] = sum_l popcount(row_words[l, i] & col_words[l, j])`` —
+    with ``row_words``/``col_words`` the (cluster-plane x word)-flattened
+    accumulation layout, that is exactly ``Mij_tile += popcount(mask_i &
+    mask_j)`` accumulated per cluster plane.
+
+    Args:
+      row_words: (L, R) uint32 — the Mij tile's row-side bit columns.
+      col_words: (L, C) uint32 — the column side (often the full packed
+        planes; ``row_words`` a slice of them).
+      word_chunk: words ANDed per scan step; bounds the transient
+        (word_chunk, R, C) broadcast at a few tile-sized buffers.
+
+    Returns:
+      (R, C) int32 exact co-occurrence counts.
+    """
+    l_words, n_rows = row_words.shape
+    l2, n_c = col_words.shape
+    if l2 != l_words:
+        raise ValueError(
+            f"row/col word counts differ: {l_words} vs {l2}"
+        )
+    word_chunk = max(1, min(int(word_chunk), l_words))
+    n_chunks = -(-l_words // word_chunk)
+    pad = n_chunks * word_chunk - l_words
+    if pad:
+        # Zero words contribute zero popcount: padding is free.
+        row_words = jnp.concatenate(
+            [row_words, jnp.zeros((pad, n_rows), jnp.uint32)]
+        )
+        col_words = jnp.concatenate(
+            [col_words, jnp.zeros((pad, n_c), jnp.uint32)]
+        )
+    row_words = row_words.reshape(n_chunks, word_chunk, n_rows)
+    col_words = col_words.reshape(n_chunks, word_chunk, n_c)
+
+    def step(acc, ops):
+        a, b = ops
+        anded = a[:, :, None] & b[:, None, :]
+        counts = jax.lax.population_count(anded).astype(jnp.int32)
+        return acc + jnp.sum(counts, axis=0), None
+
+    acc0 = jnp.zeros((n_rows, n_c), jnp.int32)
+    acc, _ = jax.lax.scan(step, acc0, (row_words, col_words))
+    return acc
+
+
+def coassoc_counts_packed(
+    labels: jax.Array,
+    indices: jax.Array,
+    n_samples: int,
+    k_max: int,
+    *,
+    n_cols: Optional[int] = None,
+    row_start: Optional[jax.Array] = None,
+    n_rows: Optional[int] = None,
+    popcount_fn: Optional[Callable[..., jax.Array]] = None,
+) -> jax.Array:
+    """Packed drop-in for :func:`~consensus_clustering_tpu.ops.coassoc.
+    coassociation_counts`: same signature contract, same int32 counts bit
+    for bit, ~1/32 the intermediate HBM bytes (uint32 bit-planes instead
+    of bf16 one-hots).
+
+    ``popcount_fn`` (default :func:`popcount_accumulate`) is the tile
+    primitive hook — the engines pass the Pallas/lax dispatcher from
+    :mod:`~consensus_clustering_tpu.ops.pallas_coassoc`.
+    """
+    if n_cols is None:
+        n_cols = n_samples
+    if (row_start is None) != (n_rows is None):
+        raise ValueError("row_start and n_rows must be passed together")
+    if popcount_fn is None:
+        popcount_fn = popcount_accumulate
+    planes = pack_label_planes(labels, indices, k_max, n_cols)
+    words = planes.reshape(-1, n_cols)
+    if row_start is None:
+        rows = words
+    else:
+        rows = jax.lax.dynamic_slice(
+            words,
+            (jnp.asarray(0, jnp.int32), jnp.asarray(row_start, jnp.int32)),
+            (words.shape[0], n_rows),
+        )
+    return popcount_fn(rows, words)
+
+
+def cosample_counts_packed(
+    indices: jax.Array,
+    n_samples: int,
+    *,
+    n_cols: Optional[int] = None,
+    row_start: Optional[jax.Array] = None,
+    n_rows: Optional[int] = None,
+    popcount_fn: Optional[Callable[..., jax.Array]] = None,
+) -> jax.Array:
+    """Packed drop-in for :func:`~consensus_clustering_tpu.ops.resample.
+    cosample_counts` — ``Iij`` from the co-sampling bit-plane alone."""
+    if n_cols is None:
+        n_cols = n_samples
+    if (row_start is None) != (n_rows is None):
+        raise ValueError("row_start and n_rows must be passed together")
+    if popcount_fn is None:
+        popcount_fn = popcount_accumulate
+    words = pack_cosample_planes(indices, n_cols)
+    if row_start is None:
+        rows = words
+    else:
+        rows = jax.lax.dynamic_slice(
+            words,
+            (jnp.asarray(0, jnp.int32), jnp.asarray(row_start, jnp.int32)),
+            (words.shape[0], n_rows),
+        )
+    return popcount_fn(rows, words)

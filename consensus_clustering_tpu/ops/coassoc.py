@@ -53,6 +53,8 @@ def coassociation_counts(
     n_cols: Optional[int] = None,
     row_start: Optional[jax.Array] = None,
     n_rows: Optional[int] = None,
+    accum_repr: str = "dense",
+    popcount_fn=None,
 ) -> jax.Array:
     """Accumulate the co-association count matrix over all resamples.
 
@@ -72,10 +74,30 @@ def coassociation_counts(
         block ``[row_start, row_start + n_rows)`` — the shard owned by one
         device on the mesh's ``'n'`` axis.  Requires ``n_rows``.
       n_rows: static height of the row block.
+      accum_repr: ``"dense"`` (this module's bf16 one-hot GEMMs) or
+        ``"packed"`` — per-resample co-membership as uint32 bit-plane
+        masks accumulated via popcount (:mod:`~consensus_clustering_tpu.
+        ops.bitpack`), ~1/32 the intermediate HBM bytes, counts
+        bit-identical by construction.  ``chunk_size`` applies only to
+        the dense GEMM chunking.
+      popcount_fn: packed-path tile primitive override — the engines
+        pass the Pallas/lax dispatcher
+        (:func:`~consensus_clustering_tpu.ops.pallas_coassoc.
+        packed_coassoc_counts`, gate resolved outside the trace).
 
     Returns:
       (N, N) int32 ``Mij`` — or its (n_rows, n_cols) row block.
     """
+    if accum_repr == "packed":
+        from consensus_clustering_tpu.ops.bitpack import (
+            coassoc_counts_packed,
+        )
+
+        return coassoc_counts_packed(
+            labels, indices, n_samples, k_max,
+            n_cols=n_cols, row_start=row_start, n_rows=n_rows,
+            popcount_fn=popcount_fn,
+        )
     if n_cols is None:
         n_cols = n_samples
     if (row_start is None) != (n_rows is None):

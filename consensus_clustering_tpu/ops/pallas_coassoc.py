@@ -1,0 +1,182 @@
+"""Pallas TPU kernel: fused mask-AND + popcount + int32 tile accumulation.
+
+The lax fallback (:func:`~consensus_clustering_tpu.ops.bitpack.
+popcount_accumulate`) scans word chunks and materialises a
+(word_chunk, R, C) broadcast per step in HBM; this kernel keeps the
+whole ``Mij_tile += popcount(mask_i & mask_j)`` loop in VMEM: each grid
+step loads a (TILE_R, WORD_BLK) row-side block and a (WORD_BLK, TILE_C)
+column-side block, ANDs + popcounts them word by word on the VPU, and
+accumulates into a resident int32 (TILE_R, TILE_C) output tile across
+the word-grid dimension — the packed counterpart of the accumulation
+GEMMs, with the ~1/32-compressed operands streamed HBM -> VMEM exactly
+once per output tile row/column.
+
+Lessons from BENCH_r01's tail (a real Mosaic lowering failure, "Cannot
+store scalars to VMEM", from the first Pallas attempt in this repo)
+baked in:
+
+- no scalar stores: the accumulator is a full (TILE_R, TILE_C) vector
+  tile, initialised under ``pl.when`` on the first word step;
+- 2-D shapes throughout the kernel body (``a[:, w:w+1] & b[w:w+1, :]``
+  broadcasts, never 1-D intermediates — the reduction shape class
+  Mosaic rejects);
+- int32 operands (uint32 is bitcast OUTSIDE the kernel; popcount and
+  AND are bit-pattern ops, so the reinterpretation is free and exact);
+- operands are zero-padded to tile multiples OUTSIDE the kernel, so no
+  masking logic lowers at all — zero words contribute zero popcount and
+  padded rows/columns are cropped after the call.
+
+Gating follows ops/pallas_hist exactly: the kernel is only selected
+after a one-time compile-and-run probe on a ragged multi-tile grid
+(:func:`packed_kernel_available`, shared ops.probe cache), any probe or
+compile failure auto-degrades to the lax popcount path, and callers
+disclose which path ran (``packed_kernel: pallas|lax`` in
+results/timing) — a Mosaic lowering failure must cost the fallback's
+speed, never the job.  ``benchmarks/tpu_kernel_check.py`` gives the next
+on-chip window a one-command compiled-mode verdict.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consensus_clustering_tpu.ops.bitpack import popcount_accumulate
+
+logger = logging.getLogger(__name__)
+
+# int32 tiles: sublane multiple of 8, lane multiple of 128.  One output
+# tile plus both operand blocks is ~132 KiB of VMEM — small enough to
+# double-buffer, large enough to amortise the grid loop.
+_TILE_R = 128
+_TILE_C = 128
+_WORD_BLK = 8
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return -(-value // multiple) * multiple
+
+
+def _coassoc_kernel(rows_ref, cols_ref, out_ref, *, word_blk):
+    w_step = pl.program_id(2)
+
+    @pl.when(w_step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    a = rows_ref[:]  # (TILE_R, word_blk) int32 — row-side bit columns
+    b = cols_ref[:]  # (word_blk, TILE_C) int32 — column-side words
+    acc = jnp.zeros(out_ref.shape, jnp.int32)
+    for w in range(word_blk):
+        # 2-D broadcasts only (see module docstring): (TILE_R, 1) AND
+        # (1, TILE_C) -> (TILE_R, TILE_C) on the VPU.
+        anded = a[:, w:w + 1] & b[w:w + 1, :]
+        acc = acc + jax.lax.population_count(anded)
+    out_ref[:] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_coassoc(
+    row_words: jax.Array,
+    col_words: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """(L, R) x (L, C) uint32 -> (R, C) int32 popcount co-occurrence."""
+    l_words, n_rows = row_words.shape
+    _, n_c = col_words.shape
+    tile_r = min(_TILE_R, _round_up(n_rows, 8))
+    tile_c = min(_TILE_C, _round_up(n_c, 128))
+    word_blk = _WORD_BLK
+    lp = _round_up(l_words, word_blk)
+    rp = _round_up(n_rows, tile_r)
+    cp = _round_up(n_c, tile_c)
+    # Row side transposed to (R, L): the kernel walks words along the
+    # minor axis of a (TILE_R, word_blk) block, so each word slice is a
+    # (TILE_R, 1) column — the broadcast layout Mosaic lowers cleanly.
+    rows_t = jnp.pad(
+        row_words.T, ((0, rp - n_rows), (0, lp - l_words))
+    )
+    cols = jnp.pad(col_words, ((0, lp - l_words), (0, cp - n_c)))
+    rows_t = jax.lax.bitcast_convert_type(rows_t, jnp.int32)
+    cols = jax.lax.bitcast_convert_type(cols, jnp.int32)
+
+    grid = (rp // tile_r, cp // tile_c, lp // word_blk)
+    out = pl.pallas_call(
+        functools.partial(_coassoc_kernel, word_blk=word_blk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (tile_r, word_blk), lambda i, j, w: (i, w),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (word_blk, tile_c), lambda i, j, w: (w, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_r, tile_c), lambda i, j, w: (i, j),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((rp, cp), jnp.int32),
+        interpret=interpret,
+    )(rows_t, cols)
+    return out[:n_rows, :n_c]
+
+
+def packed_kernel_available() -> bool:
+    """True iff the fused popcount kernel compiles and runs on the active
+    backend.
+
+    Shared probe mechanism (ops.probe): one compile-and-run on a ragged
+    multi-tile grid — (13, 264) x (13, 300) words, partial edge tiles on
+    every grid dimension, the layout class where Mosaic lowering bugs
+    hide — cached per backend.  Any failure (the BENCH_r01 class)
+    degrades to the lax popcount path with a logged warning; CPU is
+    always False (interpret mode is the CPU test path).
+    """
+    from consensus_clustering_tpu.ops.probe import probe_cached
+
+    return probe_cached(
+        "packed_coassoc",
+        lambda: _pallas_coassoc(
+            jnp.ones((13, 264), jnp.uint32),
+            jnp.ones((13, 300), jnp.uint32),
+        ),
+    )
+
+
+def packed_coassoc_counts(
+    row_words: jax.Array,
+    col_words: jax.Array,
+    *,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """(R, C) int32 popcount co-occurrence tile, kernel or lax.
+
+    Args:
+      row_words: (L, R) uint32 row-side packed planes.
+      col_words: (L, C) uint32 column-side packed planes.
+      use_kernel: force the Pallas kernel (True), the lax popcount path
+        (False), or pick by backend probe (None — the engines resolve
+        this OUTSIDE their traced programs, exactly like ``use_pallas``
+        for the histogram kernel, and disclose the resolved path as
+        ``packed_kernel: pallas|lax``).
+      interpret: run the kernel in interpreter mode (CPU testing).
+
+    Both paths compute the same exact integer counts: popcount sums
+    commute, so kernel-vs-lax is bit-identical by construction (pinned
+    by tests/test_bitpack.py and benchmarks/tpu_kernel_check.py).
+    """
+    if use_kernel is None:
+        use_kernel = packed_kernel_available()
+    if use_kernel:
+        return _pallas_coassoc(row_words, col_words, interpret=interpret)
+    return popcount_accumulate(row_words, col_words)

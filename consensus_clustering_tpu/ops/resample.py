@@ -106,6 +106,8 @@ def cosample_counts(
     n_cols: Optional[int] = None,
     row_start: Optional[jax.Array] = None,
     n_rows: Optional[int] = None,
+    accum_repr: str = "dense",
+    popcount_fn=None,
 ) -> jax.Array:
     """Co-sampling count matrix ``Iij[i, j] = #{resamples containing both}``.
 
@@ -116,7 +118,24 @@ def cosample_counts(
     ``row_start``/``n_rows`` (with ``n_cols`` the padded width) select the
     ``[row_start, row_start + n_rows)`` row block, for callers that shard
     consensus-matrix rows over a mesh axis; ``row_start`` may be traced.
+
+    ``accum_repr="packed"`` routes to the bit-plane/popcount variant
+    (:func:`~consensus_clustering_tpu.ops.bitpack.cosample_counts_packed`
+    — the co-sampling indicator as ONE uint32 bit-plane per 32
+    resamples); counts bit-identical, ~1/32 the intermediate bytes.
+    ``popcount_fn`` overrides its tile primitive (the Pallas/lax
+    dispatcher, gate resolved outside the trace).
     """
+    if accum_repr == "packed":
+        from consensus_clustering_tpu.ops.bitpack import (
+            cosample_counts_packed,
+        )
+
+        return cosample_counts_packed(
+            indices, n_samples,
+            n_cols=n_cols, row_start=row_start, n_rows=n_rows,
+            popcount_fn=popcount_fn,
+        )
     if (row_start is None) != (n_rows is None):
         raise ValueError("row_start and n_rows must be passed together")
     r = indicator_matrix(indices, n_samples, n_cols=n_cols)

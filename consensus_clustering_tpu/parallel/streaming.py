@@ -63,6 +63,7 @@ from __future__ import annotations
 import logging
 import os
 import time
+from functools import partial
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 
 import jax
@@ -79,7 +80,16 @@ from consensus_clustering_tpu.ops.analysis import (
     cdf_pac_from_counts,
     consensus_matrix,
 )
+from consensus_clustering_tpu.ops.bitpack import (
+    pack_cosample_planes,
+    pack_label_planes,
+    packed_width,
+)
 from consensus_clustering_tpu.ops.coassoc import coassociation_counts
+from consensus_clustering_tpu.ops.pallas_coassoc import (
+    packed_coassoc_counts,
+    packed_kernel_available,
+)
 from consensus_clustering_tpu.ops.pallas_hist import (
     consensus_hist_counts,
     kernel_available,
@@ -103,6 +113,7 @@ from consensus_clustering_tpu.parallel.sweep import (
 )
 from consensus_clustering_tpu.resilience.faults import IntegrityError, faults
 from consensus_clustering_tpu.resilience.integrity import (
+    build_packed_sentinel,
     build_sentinel,
     flip_array_bits,
     sentinel_sample_rows,
@@ -173,14 +184,82 @@ class StreamingSweep:
         use_pallas = config.use_pallas
         if use_pallas is None:
             use_pallas = kernel_available()
+        # Packed-representation geometry + kernel gate (accum_repr =
+        # "packed", ROADMAP item 1): the state carries uint32 bit-plane
+        # masks instead of int32 (N, N) row blocks — ~1/32 the
+        # accumulator HBM bytes — and int32 Mij/Iij exist only as
+        # transient ROW TILES materialised at evaluate/finalize
+        # boundaries via the popcount primitive.  The Pallas/lax choice
+        # is probed here, outside the traced program, exactly like
+        # use_pallas above, and disclosed in every result's timing as
+        # ``packed_kernel: pallas|lax`` (None for dense).
+        self._accum_repr = config.accum_repr
+        packed = self._accum_repr == "packed"
+        self.packed_kernel = None
+        popcount_fn = None
+        if packed:
+            use_pk = config.use_packed_kernel
+            if use_pk is None:
+                use_pk = packed_kernel_available()
+            self.packed_kernel = "pallas" if use_pk else "lax"
+            popcount_fn = partial(
+                packed_coassoc_counts, use_kernel=bool(use_pk)
+            )
+            # Capacity: the plane words are sized by the BUILD config's
+            # n_iterations (rounded up to whole blocks) — H stays a
+            # runtime argument below that cap, so the executable remains
+            # H-agnostic within it (run() guards the cap with a clear
+            # rebuild message).  Each block owns its own whole words
+            # (wb = ceil(hb_pad/32)) so a traced h_start maps to a word
+            # offset with no cross-block bit straddling; the <= 31
+            # tail bits per block stay zero and contribute nothing.
+            self._n_blocks_cap = -(-config.n_iterations // hb_pad)
+            self._h_cap = self._n_blocks_cap * hb_pad
+            self._wb = packed_width(hb_pad)
+            self._w_cap = self._n_blocks_cap * self._wb
+            # Row-tile geometry for evaluate-time materialisation: each
+            # device's element columns are padded so n_tiles equal
+            # tiles of tile_r rows partition them exactly — a tile
+            # never crosses into another device's row range, so the
+            # per-tile histogram masks stay global-index-exact.
+            # Element j sits at padded-global position j (identity);
+            # positions >= N hold no bits and are masked everywhere.
+            n_tiles = -(-n_local // 256)
+            tile_r = -(-n_local // n_tiles)
+            tile_r = -(-tile_r // 8) * 8
+            self._tile_r = tile_r
+            self._n_tiles = n_tiles
+            self._n_local_pack = tile_r * n_tiles
+            self._n_pad2 = self._n_local_pack * n_r
 
         k_axis = KSHARD_AXIS if KSHARD_AXIS in mesh.axis_names else None
         mij_spec = P(k_axis, ROW_AXIS, None)
         iij_spec = P(ROW_AXIS, None)
-        self._state_shardings = {
-            "mij": NamedSharding(mesh, mij_spec),
-            "iij": NamedSharding(mesh, iij_spec),
-        }
+        if packed:
+            planes_spec = P(k_axis, None, None, ROW_AXIS)
+            coplanes_spec = P(None, ROW_AXIS)
+            self._state_shardings = {
+                "planes": NamedSharding(mesh, planes_spec),
+                "coplanes": NamedSharding(mesh, coplanes_spec),
+            }
+            self._state_shapes = {
+                "planes": (
+                    (self._nk_pad, k_max, self._w_cap, self._n_pad2),
+                    jnp.uint32,
+                ),
+                "coplanes": ((self._w_cap, self._n_pad2), jnp.uint32),
+            }
+        else:
+            self._state_shardings = {
+                "mij": NamedSharding(mesh, mij_spec),
+                "iij": NamedSharding(mesh, iij_spec),
+            }
+            self._state_shapes = {
+                "mij": (
+                    (self._nk_pad, self._n_pad, self._n_pad), jnp.int32
+                ),
+                "iij": ((self._n_pad, self._n_pad), jnp.int32),
+            }
 
         def local_step(
             mij_blk, iij_blk, x, key_resample, key_cluster, k_arr_local,
@@ -294,24 +373,215 @@ class StreamingSweep:
             }
             return out["mij"], iij_new, curves
 
+        def local_step_packed(
+            planes_blk, coplanes_blk, x, key_resample, key_cluster,
+            k_arr_local, h_start, h_total,
+        ):
+            """Per-device packed block step.
+
+            ``planes_blk``: this device's (k_local, k_max, w_cap,
+            n_local_pack) slices of the per-K cluster bit-planes —
+            resamples packed 32-per-word along the word axis, elements
+            along the (ROW_AXIS-sharded) last axis; ``coplanes_blk``:
+            its (w_cap, n_local_pack) co-sampling planes.  The block's
+            resample draw/shard logic is the dense step's verbatim;
+            what changes is the accumulation: each device scatter-packs
+            its h-row's resample bits for ITS element columns into a
+            zero block-plane array and ``psum``s over 'h' (disjoint
+            bits, so integer sum == bitwise OR, exactly — see
+            ops.bitpack.pack_label_planes), then writes the block's
+            words at a traced word offset.  Curves come from int32
+            Mij/Iij ROW TILES materialised via the popcount primitive
+            and discarded after their histogram pass — no (N, N)
+            accumulator ever exists, which is both the ~32x capacity
+            win and the HBM-traffic win.
+            """
+            h_idx = jax.lax.axis_index(RESAMPLE_AXIS)
+            r_idx = jax.lax.axis_index(ROW_AXIS)
+            h_global = h_start + (
+                (h_idx * n_r + r_idx) * local_hb
+                + jnp.arange(local_hb, dtype=jnp.int32)
+            )
+            h_valid = h_global < h_total
+            col_start = r_idx * self._n_local_pack
+
+            indices_full = resample_indices(
+                key_resample, n, hb_pad, n_sub, h_start=h_start
+            )
+            block_rows = h_start + jnp.arange(hb_pad, dtype=jnp.int32)
+            indices_full = jnp.where(
+                (block_rows < h_total)[:, None], indices_full, -1
+            )
+            indices = jax.lax.dynamic_slice(
+                indices_full,
+                (
+                    jnp.asarray(
+                        (h_idx * n_r + r_idx) * local_hb, jnp.int32
+                    ),
+                    jnp.asarray(0, jnp.int32),
+                ),
+                (local_hb, n_sub),
+            )
+            indices_row = jax.lax.dynamic_slice(
+                indices_full,
+                (
+                    jnp.asarray(h_idx * n_r * local_hb, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                ),
+                (n_r * local_hb, n_sub),
+            )
+            # This device's element columns: global index -> local
+            # column (identity placement: element j at padded-global
+            # position j); out-of-range columns are dropped by the
+            # packers' OOB redirect.
+            indices_row_local = jnp.where(
+                (indices_row >= col_start)
+                & (indices_row < col_start + self._n_local_pack),
+                indices_row - col_start,
+                -1,
+            )
+            # Block-local bit offset of this h-row's first resample.
+            g0 = h_idx * n_r * local_hb
+            # Word offset of this block in the plane state: blocks own
+            # whole words, so a traced h_start maps exactly.
+            word0 = (h_start // hb_pad) * self._wb
+
+            blk_coplanes = jax.lax.psum(
+                pack_cosample_planes(
+                    indices_row_local, self._n_local_pack,
+                    n_words=self._wb, row0=g0,
+                ),
+                RESAMPLE_AXIS,
+            )
+            coplanes_new = jax.lax.dynamic_update_slice(
+                coplanes_blk, blk_coplanes,
+                (word0, jnp.asarray(0, jnp.int32)),
+            )
+            # Full-width column side for the popcount tiles: int32
+            # label rows ride the dense path's all_gather; here the
+            # ~1/32-packed planes do (the whole point of the layout).
+            cop_full = jax.lax.all_gather(
+                coplanes_new, ROW_AXIS, tiled=True, axis=1
+            )
+
+            x_sub = x[jnp.where(indices >= 0, indices, 0)]
+
+            def per_k(_, scanned):
+                k, planes_k = scanned
+                keys = resample_lane_keys(
+                    config, key_cluster, k, h_global
+                )
+                labels = fit_resample_lanes(
+                    clusterer, config, keys, x_sub, k, k_max
+                )
+                labels = jnp.where(h_valid[:, None], labels, -1)
+                labels_row = jax.lax.all_gather(
+                    labels, ROW_AXIS, tiled=True, axis=0
+                )
+                blk_planes = jax.lax.psum(
+                    pack_label_planes(
+                        labels_row, indices_row_local, k_max,
+                        self._n_local_pack, n_words=self._wb, row0=g0,
+                    ),
+                    RESAMPLE_AXIS,
+                )
+                planes_new = jax.lax.dynamic_update_slice(
+                    planes_k, blk_planes,
+                    (
+                        jnp.asarray(0, jnp.int32), word0,
+                        jnp.asarray(0, jnp.int32),
+                    ),
+                )
+                planes_full = jax.lax.all_gather(
+                    planes_new, ROW_AXIS, tiled=True, axis=2
+                )
+                cols_words = planes_full.reshape(
+                    k_max * self._w_cap, self._n_pad2
+                )
+                rows_words = planes_new.reshape(
+                    k_max * self._w_cap, self._n_local_pack
+                )
+
+                def tile_counts(t, counts):
+                    # Materialise one (tile_r, n_pad2) Mij/Iij row
+                    # tile from the planes, histogram its consensus
+                    # values, discard it — the only int32 co-occurrence
+                    # state that ever exists in packed mode.
+                    t0 = t * self._tile_r
+                    rw = jax.lax.dynamic_slice(
+                        rows_words,
+                        (jnp.asarray(0, jnp.int32), t0),
+                        (rows_words.shape[0], self._tile_r),
+                    )
+                    mij_t = popcount_fn(rw, cols_words)
+                    crw = jax.lax.dynamic_slice(
+                        coplanes_new,
+                        (jnp.asarray(0, jnp.int32), t0),
+                        (self._w_cap, self._tile_r),
+                    )
+                    iij_t = popcount_fn(crw, cop_full)
+                    row_off = col_start + t0
+                    cij_t = consensus_matrix(
+                        mij_t, iij_t, row_offset=row_off
+                    )
+                    return counts + consensus_hist_counts(
+                        cij_t, n, row_off, config.bins,
+                        use_pallas=use_pallas,
+                    )
+
+                counts = jax.lax.fori_loop(
+                    0, self._n_tiles, tile_counts,
+                    jnp.zeros((config.bins,), jnp.int32),
+                )
+                counts = jax.lax.psum(counts, ROW_AXIS)
+                hist, cdf, pac = cdf_pac_from_counts(
+                    counts, n, lo, hi, config.parity_zeros
+                )
+                return 0, {
+                    "planes": planes_new, "hist": hist, "cdf": cdf,
+                    "pac_area": pac,
+                }
+
+            _, out = jax.lax.scan(per_k, 0, (k_arr_local, planes_blk))
+            curves = {
+                "hist": out["hist"], "cdf": out["cdf"],
+                "pac_area": out["pac_area"],
+            }
+            return out["planes"], coplanes_new, curves
+
         per_k_specs = {
             "hist": P(k_axis), "cdf": P(k_axis), "pac_area": P(k_axis),
         }
-        sharded_step = shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(
-                mij_spec, iij_spec, P(), P(), P(), P(k_axis), P(), P(),
-            ),
-            out_specs=(mij_spec, iij_spec, per_k_specs),
-            check_vma=False,
-        )
+        if packed:
+            sharded_step = shard_map(
+                local_step_packed,
+                mesh=mesh,
+                in_specs=(
+                    planes_spec, coplanes_spec, P(), P(), P(),
+                    P(k_axis), P(), P(),
+                ),
+                out_specs=(planes_spec, coplanes_spec, per_k_specs),
+                check_vma=False,
+            )
+        else:
+            sharded_step = shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=(
+                    mij_spec, iij_spec, P(), P(), P(), P(k_axis), P(), P(),
+                ),
+                out_specs=(mij_spec, iij_spec, per_k_specs),
+                check_vma=False,
+            )
+
+        state_keys = tuple(self._state_shapes)
 
         def step(state, x, key, h_start, h_total):
             x = x.astype(jnp.dtype(config.dtype))
             key_resample, key_cluster = jax.random.split(key)
-            mij, iij, curves = sharded_step(
-                state["mij"], state["iij"], x, key_resample, key_cluster,
+            a, b, curves = sharded_step(
+                state[state_keys[0]], state[state_keys[1]], x,
+                key_resample, key_cluster,
                 self._k_arr, h_start, h_total,
             )
             if k_unperm is not None:
@@ -326,16 +596,34 @@ class StreamingSweep:
             curves["pac_area"] = (
                 curves["cdf"][:, hi - 1] - curves["cdf"][:, lo]
             )
-            return {"mij": mij, "iij": iij}, curves
+            return {state_keys[0]: a, state_keys[1]: b}, curves
 
         def finalize(state):
             """Cropped host-facing matrices from the final accumulators
-            (full-H runs with ``store_matrices`` only)."""
-            mij = state["mij"]
-            if k_unperm is not None:
-                mij = jnp.take(mij, k_unperm, axis=0)
-            mij = mij[:n_ks, :n, :n]
-            iij = state["iij"][:n, :n]
+            (full-H runs with ``store_matrices`` only).  In packed mode
+            this is THE full materialisation boundary: int32 Mij/Iij
+            are popcounted out of the bit-planes here and nowhere
+            else."""
+            if packed:
+                planes = state["planes"]
+                if k_unperm is not None:
+                    planes = jnp.take(planes, k_unperm, axis=0)
+                planes = planes[:n_ks]
+                cop = state["coplanes"]
+                iij = popcount_fn(cop, cop)[:n, :n]
+                mij = jax.lax.map(
+                    lambda p: popcount_fn(
+                        p.reshape(k_max * self._w_cap, self._n_pad2),
+                        p.reshape(k_max * self._w_cap, self._n_pad2),
+                    )[:n, :n],
+                    planes,
+                )
+            else:
+                mij = state["mij"]
+                if k_unperm is not None:
+                    mij = jnp.take(mij, k_unperm, axis=0)
+                mij = mij[:n_ks, :n, :n]
+                iij = state["iij"][:n, :n]
             cij = jax.vmap(lambda m: consensus_matrix(m, iij))(mij)
             return {"mij": mij, "iij": iij, "cij": cij}
 
@@ -383,10 +671,8 @@ class StreamingSweep:
 
         def init_state_fn():
             return {
-                "mij": jnp.zeros(
-                    (self._nk_pad, self._n_pad, self._n_pad), jnp.int32
-                ),
-                "iij": jnp.zeros((self._n_pad, self._n_pad), jnp.int32),
+                name: jnp.zeros(shape, dtype)
+                for name, (shape, dtype) in self._state_shapes.items()
             }
 
         # Zeros materialise ON DEVICE, already sharded: a device_put of
@@ -440,16 +726,10 @@ class StreamingSweep:
             return dict(self._compiled_memory)
         try:
             state_struct = {
-                "mij": jax.ShapeDtypeStruct(
-                    (self._nk_pad, self._n_pad, self._n_pad),
-                    jnp.int32,
-                    sharding=self._state_shardings["mij"],
-                ),
-                "iij": jax.ShapeDtypeStruct(
-                    (self._n_pad, self._n_pad),
-                    jnp.int32,
-                    sharding=self._state_shardings["iij"],
-                ),
+                name: jax.ShapeDtypeStruct(
+                    shape, dtype, sharding=self._state_shardings[name]
+                )
+                for name, (shape, dtype) in self._state_shapes.items()
             }
             x_struct = jax.ShapeDtypeStruct(
                 (self.config.n_samples, self.config.n_features),
@@ -472,9 +752,18 @@ class StreamingSweep:
     def _integrity_stats(self, state, h_seen: int, block: int):
         """Dispatch the invariant sentinel on ``state``; returns device
         scalars (evaluated lazily by the driver, one block later, so
-        the check's compute overlaps the next in-flight block)."""
+        the check's compute overlaps the next in-flight block).  Packed
+        state gets the packed-domain sentinel (:func:`~consensus_
+        clustering_tpu.resilience.integrity.build_packed_sentinel`) —
+        the invariants stay checkable on the bit-plane representation,
+        no dense materialisation needed."""
         if self._sentinel is None:
-            self._sentinel = build_sentinel()
+            if self._accum_repr == "packed":
+                self._sentinel = build_packed_sentinel(
+                    self._hb_pad, self.config.k_max
+                )
+            else:
+                self._sentinel = build_sentinel()
         idx = sentinel_sample_rows(self.config.n_samples, block)
         return self._sentinel(
             state, jnp.int32(h_seen), jnp.asarray(idx)
@@ -482,14 +771,21 @@ class StreamingSweep:
 
     def _flip_state_bits(self, state, nbits: int, block: int):
         """Apply the ``accumulator`` bitflip fault: a deterministic
-        HBM-corruption stand-in (host round-trip of ``mij``, high bit
-        flipped, re-placed under the state sharding).  Test-path only —
-        reached when a fault plan armed the point, never otherwise."""
-        mij = np.array(state["mij"])
-        flip_array_bits(mij, nbits, seed=block)
+        HBM-corruption stand-in (host round-trip of the per-K
+        accumulator — dense ``mij`` or the packed cluster bit-planes —
+        high bit flipped, re-placed under the state sharding).
+        Test-path only — reached when a fault plan armed the point,
+        never otherwise."""
+        name = "planes" if self._accum_repr == "packed" else "mij"
+        arr = np.array(state[name])
+        # flip_array_bits wants a signed int view; for uint32 planes the
+        # flipped bit is one resample's membership bit — exactly the
+        # corruption class the packed sentinel's coverage/disjointness
+        # equality exists to catch.
+        flip_array_bits(arr.view(np.int32), nbits, seed=block)
         corrupted = dict(state)
-        corrupted["mij"] = jax.device_put(
-            mij, self._state_shardings["mij"]
+        corrupted[name] = jax.device_put(
+            arr, self._state_shardings[name]
         )
         return corrupted
 
@@ -626,6 +922,23 @@ class StreamingSweep:
             raise ValueError(
                 f"n_iterations must be >= 1, got {n_iterations}"
             )
+        if (
+            self._accum_repr == "packed"
+            and n_iterations > self._h_cap
+        ):
+            # The packed plane words are sized by the BUILD config's
+            # n_iterations (rounded up to whole blocks): the executable
+            # stays H-agnostic BELOW that capacity, but more resamples
+            # have no words to land in — fail loudly instead of
+            # silently dropping counts.
+            raise ValueError(
+                f"packed accumulator capacity is {self._h_cap} "
+                f"resamples (built from n_iterations="
+                f"{self.config.n_iterations}, block {self._hb_pad}); "
+                f"got n_iterations={n_iterations} — rebuild the engine "
+                "with a config whose n_iterations covers the largest H "
+                "it will serve"
+            )
         config = self.config
         if adaptive_tol is None:
             adaptive_tol = config.adaptive_tol
@@ -691,7 +1004,7 @@ class StreamingSweep:
                         arrays[f"state_{name}"],
                         self._state_shardings[name],
                     )
-                    for name in ("mij", "iij")
+                    for name in self._state_shardings
                 }
                 # float32 restore keeps the adaptive arithmetic
                 # bit-identical to the uninterrupted run: the PAC
@@ -857,6 +1170,13 @@ class StreamingSweep:
                         "trajectory": [list(row) for row in trajectory],
                         "quiet": int(quiet),
                         "stopped": bool(stop),
+                        # Representation tag + block geometry: the
+                        # resume-time verifier needs hb_pad to judge
+                        # the packed ghost-bit invariant, and the tag
+                        # makes frame forensics self-describing (the
+                        # fingerprint already separates the rings).
+                        "accum_repr": self._accum_repr,
+                        "hb_pad": int(self._hb_pad),
                         "written_at": round(time.time(), 3),
                     },
                     arrays,
@@ -982,6 +1302,7 @@ class StreamingSweep:
             # they ran at (0 = the sentinel was off).
             "integrity_checks": int(integrity_checks),
             "integrity_check_every": int(integrity_check_every),
+            "accum_repr": self._accum_repr,
         }
         out["timing"] = {
             "run_seconds": run_seconds,
@@ -995,6 +1316,12 @@ class StreamingSweep:
             # pays the AOT retrace (see compiled_memory_stats).
             "compiled_memory": dict(self._compiled_memory or {}),
         }
+        if self.packed_kernel is not None:
+            # Which popcount path the packed representation actually
+            # ran ("pallas" | "lax") — a Mosaic lowering failure
+            # degrades silently at the probe gate, so the result must
+            # say so (ops/pallas_coassoc.py).
+            out["timing"]["packed_kernel"] = self.packed_kernel
         return out
 
     # -- fused (batch-axis) driver ---------------------------------------
@@ -1011,7 +1338,10 @@ class StreamingSweep:
         if fused is None:
             fused = jax.jit(jax.vmap(
                 self._step,
-                in_axes=({"mij": 0, "iij": 0}, 0, 0, None, None),
+                in_axes=(
+                    {name: 0 for name in self._state_shapes},
+                    0, 0, None, None,
+                ),
             ))
             self._fused_steps[k] = fused
         return fused
@@ -1101,13 +1431,20 @@ class StreamingSweep:
         keys = jnp.stack([
             jax.random.PRNGKey(int(s)) for s in pad_seeds
         ])
+        if (
+            self._accum_repr == "packed"
+            and n_iterations > self._h_cap
+        ):
+            raise ValueError(
+                f"packed accumulator capacity is {self._h_cap} "
+                f"resamples; got n_iterations={n_iterations} (see "
+                "StreamingSweep.run)"
+            )
         h_total = jnp.int32(n_iterations)
         n_blocks = -(-n_iterations // self._hb_pad)
         state = {
-            "mij": jnp.zeros(
-                (kp, self._nk_pad, self._n_pad, self._n_pad), jnp.int32
-            ),
-            "iij": jnp.zeros((kp, self._n_pad, self._n_pad), jnp.int32),
+            name: jnp.zeros((kp,) + shape, dtype)
+            for name, (shape, dtype) in self._state_shapes.items()
         }
 
         ckpt_fps: List[Optional[str]] = []
@@ -1204,6 +1541,8 @@ class StreamingSweep:
                             ],
                             "quiet": 0,
                             "stopped": False,
+                            "accum_repr": self._accum_repr,
+                            "hb_pad": int(self._hb_pad),
                             "written_at": round(time.time(), 3),
                         },
                         arrays,
@@ -1225,10 +1564,7 @@ class StreamingSweep:
                     # sentinel program.
                     checks = [
                         self._integrity_stats(
-                            {
-                                "mij": state["mij"][i],
-                                "iij": state["iij"][i],
-                            },
+                            {name: state[name][i] for name in state},
                             min((b + 1) * self._hb_pad, n_iterations),
                             b,
                         )
@@ -1245,8 +1581,8 @@ class StreamingSweep:
                     # (the solo driver's non-donate rule).
                     snap = [
                         {
-                            "state_mij": state["mij"][i],
-                            "state_iij": state["iij"][i],
+                            f"state_{name}": state[name][i]
+                            for name in state
                         }
                         for i in range(k)
                     ]
@@ -1290,6 +1626,7 @@ class StreamingSweep:
                 ),
                 "integrity_checks": int(checked_blocks),
                 "integrity_check_every": int(integrity_check_every),
+                "accum_repr": self._accum_repr,
             }
             out["timing"] = {
                 # The fused wall covers all k jobs; per-job rate is
@@ -1304,6 +1641,8 @@ class StreamingSweep:
                 "device_memory": device_mem,
                 "compiled_memory": dict(self._compiled_memory or {}),
             }
+            if self.packed_kernel is not None:
+                out["timing"]["packed_kernel"] = self.packed_kernel
             outs.append(out)
         return outs
 

@@ -62,6 +62,10 @@ from consensus_clustering_tpu.ops.pallas_hist import (
     consensus_hist_counts,
     kernel_available,
 )
+from consensus_clustering_tpu.ops.pallas_coassoc import (
+    packed_coassoc_counts,
+    packed_kernel_available,
+)
 from consensus_clustering_tpu.ops.coassoc import coassociation_counts
 from consensus_clustering_tpu.ops.resample import (
     cosample_counts,
@@ -330,6 +334,22 @@ def build_sweep(
     use_pallas = config.use_pallas
     if use_pallas is None:
         use_pallas = kernel_available()
+    # The packed-accumulation kernel gate resolves here too (probe
+    # compiles + runs once per backend, never inside the shard_map
+    # trace), exactly like the histogram kernel above: any Mosaic
+    # lowering failure degrades to the lax popcount path, and the
+    # resolved choice is DISCLOSED via the returned callable's
+    # ``packed_kernel`` attribute ("pallas" | "lax"; None for dense) so
+    # run_sweep can put it in the timing block.
+    accum_repr = config.accum_repr
+    packed_kernel = None
+    popcount_fn = None
+    if accum_repr == "packed":
+        use_pk = config.use_packed_kernel
+        if use_pk is None:
+            use_pk = packed_kernel_available()
+        packed_kernel = "pallas" if use_pk else "lax"
+        popcount_fn = partial(packed_coassoc_counts, use_kernel=bool(use_pk))
     # The fused Lloyd kernel (ops/pallas_lloyd) is NOT probed here: it is
     # opt-in via KMeans(use_pallas=True) only.  At sweep shapes the grid
     # is (restarts x resamples x row-tiles) of small blocks and Mosaic's
@@ -399,6 +419,7 @@ def build_sweep(
             cosample_counts(
                 indices_row, n,
                 n_cols=n_pad, row_start=row_start, n_rows=n_local,
+                accum_repr=accum_repr, popcount_fn=popcount_fn,
             ),
             RESAMPLE_AXIS,
         )
@@ -420,6 +441,7 @@ def build_sweep(
                 coassociation_counts(
                     labels_row, indices_row, n, k_max, config.chunk_size,
                     n_cols=n_pad, row_start=row_start, n_rows=n_local,
+                    accum_repr=accum_repr, popcount_fn=popcount_fn,
                 ),
                 RESAMPLE_AXIS,
             )
@@ -509,6 +531,9 @@ def build_sweep(
             per_k_out["cij"] = per_k_out["cij"][:, :n, :n]
         return per_k_out
 
+    # Disclosure for run_sweep's timing block: which popcount path the
+    # packed representation resolved to (None for dense).
+    sweep.packed_kernel = packed_kernel
     return sweep
 
 
@@ -610,6 +635,11 @@ def run_sweep(
         # the HBM commitment of the program and is always available.
         "compiled_memory": compiled_memory_stats(compiled),
     }
+    if getattr(sweep, "packed_kernel", None) is not None:
+        # Which popcount path the packed representation actually ran
+        # ("pallas" | "lax") — a Mosaic lowering failure degrades the
+        # kernel silently at the gate, so the result must say so.
+        host["timing"]["packed_kernel"] = sweep.packed_kernel
     return host
 
 

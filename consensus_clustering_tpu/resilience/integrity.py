@@ -54,6 +54,7 @@ from consensus_clustering_tpu.resilience.faults import IntegrityError
 __all__ = [
     "INTEGRITY_POINTS",
     "IntegrityError",
+    "build_packed_sentinel",
     "build_sentinel",
     "check_input_matrix",
     "flip_array_bits",
@@ -135,6 +136,121 @@ def build_sentinel() -> Callable[..., Dict[str, Any]]:
     return sentinel
 
 
+def build_packed_sentinel(
+    hb_pad: int, k_max: int
+) -> Callable[..., Dict[str, Any]]:
+    """The invariant sentinel for the PACKED accumulator representation
+    (``SweepConfig.accum_repr="packed"``): a jitted ``(state, h_seen,
+    sample_idx) -> violation counts`` check over the streaming engine's
+    ``{"planes", "coplanes"}`` bit-plane state — the count invariants
+    stay checkable WITHOUT materialising any dense row block, mostly as
+    pure word arithmetic:
+
+    - ``cover_bad``    — words where ``OR_c planes[c] != coplanes``: a
+      sampled element must carry exactly one cluster bit and an
+      unsampled element none, so ANY single membership-bit flip breaks
+      this equality (the dense sentinel needs the flip to cross an
+      inequality; the packed equality is strictly sharper).
+    - ``disjoint_bad`` — words where ``sum_c popcount(planes[c]) !=
+      popcount(OR_c planes[c])``: two cluster planes claiming the same
+      element in the same resample.
+    - ``ghost_bad``    — set bits at resample positions ``>= h_seen``
+      or in a block's unused word-tail bits: state claiming resamples
+      that never ran.
+    - ``range_bad``/``bound_bad``/``diag_bad`` — the dense sentinel's
+      ``0 <= Mij <= Iij <= h_seen`` and diagonal checks, applied to
+      Mij/Iij ROWS materialised (via popcount) for the sampled indices
+      only — the packed analog of the dense symmetry probe's sampled
+      rows.  Popcount co-occurrence is symmetric by construction, so
+      the dense ``sym_bad`` check has no packed counterpart.
+
+    ``hb_pad``/``k_max`` are the engine's block geometry (each block
+    owns ``ceil(hb_pad/32)`` whole words — parallel/streaming.py).
+    All counts are zero for any state a valid sweep can produce.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_clustering_tpu.ops.bitpack import (
+        PACK_BITS,
+        packed_width,
+        popcount_accumulate,
+    )
+
+    wb = packed_width(hb_pad)
+
+    @jax.jit
+    def sentinel(state, h_seen, sample_idx):
+        planes = state["planes"]    # (nK, k_max, Wcap, n_pad) uint32
+        cop = state["coplanes"]     # (Wcap, n_pad) uint32
+        pc = jax.lax.population_count
+        w_cap = planes.shape[2]
+
+        orp = planes[:, 0]
+        for c in range(1, k_max):
+            orp = orp | planes[:, c]
+        cover_bad = jnp.sum((orp != cop[None]).astype(jnp.int32))
+        sum_pc = jnp.sum(pc(planes).astype(jnp.int32), axis=1)
+        disjoint_bad = jnp.sum(
+            (sum_pc != pc(orp).astype(jnp.int32)).astype(jnp.int32)
+        )
+
+        # Allowed-bit mask per word: bit b of word w is resample
+        # ``(w // wb) * hb_pad + (w % wb) * 32 + b`` — live iff that
+        # resample is < h_seen AND the bit is not block-tail padding.
+        w = jnp.arange(w_cap, dtype=jnp.int32)
+        bit = jnp.arange(PACK_BITS, dtype=jnp.int32)
+        in_block = (w % wb)[:, None] * PACK_BITS + bit[None, :]
+        h_of_bit = (w // wb)[:, None] * hb_pad + in_block
+        allowed_bits = (h_of_bit < h_seen) & (in_block < hb_pad)
+        shifts = jnp.left_shift(
+            jnp.uint32(1), jnp.arange(PACK_BITS, dtype=jnp.uint32)
+        )[None, :]
+        ghost = ~jnp.sum(
+            allowed_bits.astype(jnp.uint32) * shifts, axis=1,
+            dtype=jnp.uint32,
+        )
+        ghost_bad = jnp.sum(
+            pc(cop & ghost[:, None]).astype(jnp.int32)
+        ) + jnp.sum(
+            pc(orp & ghost[None, :, None]).astype(jnp.int32)
+        )
+
+        # Materialised spot rows (sampled indices only): the dense
+        # invariants on real int32 counts, popcounted out of the
+        # planes tile-free.
+        rows_s = jnp.take(planes, sample_idx, axis=3)
+        cop_s = jnp.take(cop, sample_idx, axis=1)
+        iij_s = popcount_accumulate(cop_s, cop)
+        mij_s = jax.lax.map(
+            lambda ab: popcount_accumulate(
+                ab[1].reshape(-1, sample_idx.shape[0]),
+                ab[0].reshape(-1, cop.shape[1]),
+            ),
+            (planes, rows_s),
+        )
+        range_bad = jnp.sum(
+            ((mij_s < 0) | (mij_s > iij_s[None])).astype(jnp.int32)
+        )
+        bound_bad = jnp.sum(
+            ((iij_s < 0) | (iij_s > h_seen)).astype(jnp.int32)
+        )
+        s_ar = jnp.arange(sample_idx.shape[0], dtype=jnp.int32)
+        diag_m = mij_s[:, s_ar, sample_idx]
+        diag_i = iij_s[s_ar, sample_idx]
+        diag_bad = jnp.sum((diag_m != diag_i[None]).astype(jnp.int32))
+        return {
+            "cover_bad": cover_bad,
+            "disjoint_bad": disjoint_bad,
+            "ghost_bad": ghost_bad,
+            "range_bad": range_bad,
+            "bound_bad": bound_bad,
+            "diag_bad": diag_bad,
+        }
+
+    return sentinel
+
+
 def sentinel_sample_rows(n: int, block: int, count: int = 16):
     """Deterministic symmetry-probe row indices for one check.
 
@@ -153,6 +269,20 @@ def sentinel_sample_rows(n: int, block: int, count: int = 16):
 
 # ---------------------------------------------------------------------------
 # Verified checkpoint frames (host-side, numpy only)
+
+
+def _popcount_u32(a):
+    """Vectorised SWAR popcount of a uint32 numpy array (int32 out) —
+    no numpy>=2.0 ``bitwise_count`` dependency."""
+    import numpy as np
+
+    v = np.asarray(a, dtype=np.uint32).copy()
+    v -= (v >> np.uint32(1)) & np.uint32(0x55555555)
+    v = (v & np.uint32(0x33333333)) + (
+        (v >> np.uint32(2)) & np.uint32(0x33333333)
+    )
+    v = (v + (v >> np.uint32(4))) & np.uint32(0x0F0F0F0F)
+    return ((v * np.uint32(0x01010101)) >> np.uint32(24)).astype(np.int32)
 
 
 def frame_digest(arrays: Dict[str, Any]) -> Dict[str, Any]:
@@ -218,6 +348,51 @@ def verify_state_frame(
                 if fresh.get(name) != recorded.get(name)
             )
             return f"digest mismatch on {changed}"
+    planes = arrays.get("state_planes")
+    coplanes = arrays.get("state_coplanes")
+    if planes is not None and coplanes is not None:
+        # Packed-representation frames (accum_repr="packed"): the same
+        # two-layer contract as dense — digest above, then the packed
+        # invariants on the bit-planes themselves (mirrors
+        # build_packed_sentinel's word arithmetic in pure numpy).
+        planes = np.asarray(planes)
+        coplanes = np.asarray(coplanes)
+        orp = np.bitwise_or.reduce(planes, axis=1)
+        if (orp != coplanes[None]).any():
+            return (
+                "invariant violation: cluster planes disagree with "
+                "the co-sampling plane"
+            )
+        if (
+            _popcount_u32(planes).sum(axis=1) != _popcount_u32(orp)
+        ).any():
+            return (
+                "invariant violation: overlapping cluster planes "
+                "(an element in two clusters of one resample)"
+            )
+        h_done = header.get("h_done")
+        hb_pad = header.get("hb_pad")
+        if h_done is not None and hb_pad is not None:
+            w_cap = coplanes.shape[0]
+            wb = -(-int(hb_pad) // 32)
+            w = np.arange(w_cap)
+            bit = np.arange(32)
+            in_block = (w % wb)[:, None] * 32 + bit[None, :]
+            live = (
+                ((w // wb)[:, None] * int(hb_pad) + in_block)
+                < int(h_done)
+            ) & (in_block < int(hb_pad))
+            ghost = ~np.sum(
+                live.astype(np.uint32) << bit[None, :].astype(np.uint32),
+                axis=1, dtype=np.uint32,
+            )
+            if (coplanes & ghost[:, None]).any() or (
+                orp & ghost[None, :, None]
+            ).any():
+                return (
+                    "invariant violation: packed state claims "
+                    "resamples beyond h_done"
+                )
     mij = arrays.get("state_mij")
     iij = arrays.get("state_iij")
     if mij is not None and iij is not None:

@@ -332,6 +332,67 @@ def add_arguments(parser) -> None:
     )
 
 
+def _footprints_view(
+    store_dir: str, job_id: str, record: Dict[str, Any]
+) -> Dict[str, Any]:
+    """The three admission footprint models for a stored job — dense
+    vs packed vs estimator — rendered (never persisted) into the
+    ``show`` view.  The PR-11 "decide without a second round-trip"
+    contract extended to the packed representation: an operator looking
+    at a queued/quarantined job sees every engine's predicted bytes
+    next to each other — the numbers the 413 body would disclose under
+    the DEFAULT block-size policy (the job's ``stream_h_block`` pin is
+    honoured; a calibrated autotune block can shift the scheduler's
+    own gate slightly, and resolving that store needs the jax-side
+    executor this stdlib view must not import).  Empty when the job's
+    payload or shape is unavailable (externally modified store) —
+    ``show`` must never fail over telemetry.  preflight stays jax-free
+    at import, so the serve-admin stdlib pin holds.
+    """
+    shape = record.get("shape")
+    envelope = _load_payload_envelope(store_dir, job_id)
+    if envelope is None or not shape or len(shape) != 2:
+        return {}
+    spec, _attempts = envelope
+    try:
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_estimator_bytes,
+            estimate_job_bytes,
+            estimate_packed_bytes,
+        )
+
+        n, d = int(shape[0]), int(shape[1])
+        k_values = [int(k) for k in spec.get("k_values") or [2]]
+        # The default-policy block size (config.autotune_stream_block's
+        # H/8 clamped [16, 128] — replicated here because importing
+        # config would drag jax into the stdlib-pinned admin path).
+        h_block = spec.get("stream_h_block") or max(
+            16, min(128, int(spec.get("n_iterations", 25)) // 8)
+        )
+        kwargs = dict(
+            dtype=spec.get("dtype", "float32"),
+            h_block=int(h_block),
+            subsampling=float(spec.get("subsampling", 0.8)),
+        )
+        return {
+            "footprints": {
+                "dense": estimate_job_bytes(n, d, k_values, **kwargs),
+                "packed": estimate_packed_bytes(
+                    n, d, k_values,
+                    n_iterations=int(spec.get("n_iterations", 25)),
+                    **kwargs,
+                ),
+                "estimator": estimate_estimator_bytes(
+                    n, d, k_values,
+                    n_pairs=spec.get("n_pairs"),
+                    **kwargs,
+                ),
+            }
+        }
+    except Exception:  # noqa: BLE001 — a sizing-model hiccup must not
+        return {}  # take down the operator's forensic view
+
+
 def cmd_serve_admin(args) -> int:
     if args.admin_cmd == "list":
         jobs = quarantined_jobs(args.store_dir)
@@ -359,6 +420,7 @@ def cmd_serve_admin(args) -> int:
         lease = lease_state(args.store_dir, args.job_id)
         if lease is not None:
             out["lease"] = lease
+        out.update(_footprints_view(args.store_dir, args.job_id, record))
         print(json.dumps(out, indent=1, sort_keys=True, default=float))
         return 0
     if args.admin_cmd == "release":

@@ -64,6 +64,7 @@ _CONFIG_KEYS = frozenset(
         "analysis", "delta_k_threshold", "dtype", "chunk_size",
         "stream_h_block", "adaptive_tol", "adaptive_patience",
         "adaptive_min_h", "priority", "mode", "n_pairs", "tenant",
+        "accum_repr",
     }
 )
 
@@ -160,6 +161,16 @@ class JobSpec:
     # Pair-sample size for estimate mode (None: the deterministic
     # default, estimator.bounds.default_n_pairs(N)).
     n_pairs: Optional[int] = None
+    # Exact-mode accumulator representation (config.ACCUM_REPRS):
+    # "dense" int32 row blocks or "packed" uint32 bit-plane masks
+    # (~1/32 the accumulator bytes; results bit-identical — the packed
+    # parity gate).  In the bucket (it shapes the compiled block
+    # program AND, packed only, pins n_iterations: the packed state is
+    # capacity-sized by H, so packed jobs bucket per H while dense
+    # jobs keep the H-agnostic bucket).  Kept in the fingerprint like
+    # stream_h_block — same-spec jobs at different representations are
+    # rare enough that dedup purity loses to plumbing simplicity.
+    accum_repr: str = "dense"
 
     def fingerprint_payload(self) -> Dict[str, Any]:
         """The JSON payload hashed into the job fingerprint.
@@ -219,6 +230,8 @@ class JobSpec:
                 None if payload.get("n_pairs") is None
                 else int(payload["n_pairs"])
             ),
+            # Pre-packed payloads load as dense jobs.
+            accum_repr=payload.get("accum_repr", "dense"),
         )
 
     def bucket(self, n: int, d: int, h_block: Optional[int] = None) -> str:
@@ -237,6 +250,12 @@ class JobSpec:
             payload.pop(field)
         if payload["stream_h_block"] is None:
             payload["stream_h_block"] = h_block
+        if self.accum_repr == "packed":
+            # The packed plane state is capacity-sized by H at build
+            # time (StreamingSweep's h_cap), so packed jobs cannot ride
+            # the H-agnostic executable: H goes back into the bucket
+            # and jobs differing only in iterations compile separately.
+            payload["n_iterations"] = int(self.n_iterations)
         payload["shape"] = [int(n), int(d)]
         return json.dumps(payload, sort_keys=True)
 
@@ -401,6 +420,14 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
             f"config.mode must be one of {list(ESTIMATOR_MODES)}, got "
             f"{mode!r}"
         )
+    from consensus_clustering_tpu.config import ACCUM_REPRS
+
+    accum_repr = cfg.get("accum_repr", "dense")
+    if accum_repr not in ACCUM_REPRS:
+        raise JobSpecError(
+            f"config.accum_repr must be one of {list(ACCUM_REPRS)}, "
+            f"got {accum_repr!r}"
+        )
     n_pairs = cfg.get("n_pairs")
     if n_pairs is not None:
         if mode == "exact":
@@ -441,6 +468,7 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         tenant=tenant,
         mode=mode,
         n_pairs=n_pairs,
+        accum_repr=accum_repr,
     )
     return spec, x
 
@@ -677,6 +705,7 @@ class SweepExecutor:
             store_matrices=False,  # serving results are curves-only JSON
             chunk_size=spec.chunk_size,
             stream_h_block=h_block,
+            accum_repr=spec.accum_repr,
             # Adaptive knobs deliberately NOT baked: the cached engine
             # is shared by every job in the bucket, and run() takes them
             # as per-job overrides.
@@ -1345,10 +1374,24 @@ class SweepExecutor:
                 "integrity_checks": int(
                     streaming.get("integrity_checks", 0)
                 ),
+                # Which accumulator representation ran (dense |
+                # packed) — production metadata, never identity: the
+                # packed parity gate keeps the semantic block (and so
+                # result_fingerprint) byte-identical across reprs.
+                "accum_repr": streaming.get("accum_repr", "dense"),
             },
             "timings": {
                 "compile_seconds": compile_seconds,
                 "run_seconds": run_seconds,
+                # Packed jobs disclose which popcount path ran
+                # ("pallas" | "lax"): a Mosaic lowering failure
+                # degrades silently at the probe gate, so the result
+                # must say so (ops/pallas_coassoc.py).
+                **(
+                    {"packed_kernel": host["timing"]["packed_kernel"]}
+                    if "packed_kernel" in host.get("timing", {})
+                    else {}
+                ),
                 # Rate over resamples actually RUN: an adaptive job's
                 # r/s stays a true throughput, not budget-skipped
                 # inflation.

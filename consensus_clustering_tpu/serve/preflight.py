@@ -102,6 +102,78 @@ def estimate_job_bytes(
     }
 
 
+def estimate_packed_bytes(
+    n: int,
+    d: int,
+    k_values: Sequence[int],
+    n_iterations: int = 25,
+    dtype: str = "float32",
+    h_block: int = 16,
+    subsampling: float = 0.8,
+    checkpoints: bool = True,
+) -> Dict[str, Any]:
+    """Estimated device footprint of the PACKED accumulator
+    representation (``accum_repr="packed"``) for the same job — the
+    ~1/32 twin of :func:`estimate_job_bytes`, and the third footprint
+    the 413 admission body discloses (dense vs packed vs estimator).
+
+    The model mirrors ``parallel/streaming.py``'s packed engine:
+
+    - **mask state** — per-K per-cluster uint32 bit-planes, resamples
+      packed 32-per-word with whole words per block:
+      ``4 · (nK·k_max + 1) · ceil(H/h_block)·ceil(h_block/32) · N``
+      bytes (the ``+1`` is the co-sampling plane) — the dense model's
+      ``4·(nK+1)·N²`` accumulator term divided by ~``32·N/(H·k_max)``;
+      at H·k_max << 32·N this is the whole capacity win.  Checkpoint
+      pinning multiplies this term exactly as it does dense state.
+    - **tile workspace** — int32 Mij/Iij row tiles + the f32 consensus
+      tile, materialised per evaluate and discarded:
+      ``16 · min(256, N) · N`` bytes — O(N), not O(N²): no dense row
+      block ever persists.
+    - **block packing scratch** — the per-block plane scatter:
+      ``4 · (k_max + 1) · ceil(h_block/32) · N``.
+    - **data + clustering lanes** — identical to the dense model
+      (shared code, shared cost).
+
+    Unlike the estimator's O(M) path this stays EXACT — bit-identical
+    ``Mij``/``Iij`` — which is why it needs ``n_iterations``: the
+    packed state is capacity-sized by H.  Monotonic in N, H and |K| by
+    construction; NOT in ``h_block`` — each block owns whole words, so
+    a smaller block means more tail-padding words (``w_cap`` grows as
+    ``h_block`` shrinks below 32) while the lane/scratch terms shrink.
+    The preflight's monotonicity pins cover N/H/|K| only.
+    """
+    n = int(n)
+    nk = len(tuple(k_values))
+    k_max = max(int(k) for k in k_values)
+    itemsize = 8 if dtype == "float64" else 4
+    n_sub = max(1, int(round(n * float(subsampling))))
+    h = max(1, int(n_iterations))
+    hb = max(1, int(h_block))
+    w_cap = -(-h // hb) * -(-hb // 32)
+
+    state = 4 * (nk * k_max + 1) * w_cap * n
+    pin = 1 + (_CHECKPOINT_PIN_GENERATIONS if checkpoints else 0)
+    tile = 16 * min(256, n) * n
+    scratch = 4 * (k_max + 1) * -(-hb // 32) * n
+    data = n * d * itemsize
+    lanes = 2 * hb * n_sub * (d + k_max) * itemsize
+    total = state * pin + tile + scratch + data + lanes
+    return {
+        "state_bytes": int(state),
+        "pinned_state_generations": int(pin),
+        "tile_workspace_bytes": int(tile),
+        "scratch_bytes": int(scratch),
+        "data_bytes": int(data),
+        "lane_bytes": int(lanes),
+        "n_iterations": int(h),
+        "total_bytes": int(total),
+        "model": "uint32 bit-plane mask state (exact counts at ~1/32 "
+        "the dense accumulator bytes) + O(N) row-tile workspace + data "
+        "+ clustering lanes; see serve/preflight.py",
+    }
+
+
 def estimate_estimator_bytes(
     n: int,
     d: int,
@@ -202,6 +274,7 @@ def check_admission(
     budget_bytes: int,
     shape: Sequence[int],
     estimator: Optional[Dict[str, Any]] = None,
+    packed: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Raise :class:`PreflightReject` when the estimate exceeds the
     budget; no-op otherwise.  Split from the estimate so the scheduler
@@ -211,8 +284,11 @@ def check_admission(
     is the sampled-pair admission path's disclosure — the estimator's
     own predicted footprint, pair count and PAC error bound — attached
     to the 413 body so the refusal carries the resubmission decision's
-    whole basis: a client reads one response and either shrinks the
-    job or retries with ``config.mode = "estimate"``, no second
+    whole basis.  ``packed`` is the packed-representation disclosure
+    (``accum_repr="packed"``: exact counts at ~1/32 the accumulator
+    bytes): with both attached the refusal is a THREE-WAY choice —
+    shrink the job, go exact-but-packed, or go estimator-with-bound —
+    and a client reads one response and decides without a second
     round-trip (docs/SERVING.md "The 413 -> mode=estimate admission
     path").
     """
@@ -230,6 +306,16 @@ def check_admission(
             "CCTPU_MEMORY_BUDGET) if the model is wrong for your "
             "backend"
         )
+    elif "tile_workspace_bytes" in estimate:
+        # Packed-representation gate: the mask state is O(nK·k·H·N/32)
+        # and the workspace O(N) — the dense hint's "N² accumulator"
+        # knobs don't exist here.
+        hint = (
+            "shrink N, iterations (the bit-plane mask state scales "
+            "with H), or the K list; or raise the budget "
+            "(--memory-budget / CCTPU_MEMORY_BUDGET) if the model is "
+            "wrong for your backend"
+        )
     else:
         hint = (
             "shrink N (the N² accumulator term dominates), the K "
@@ -243,6 +329,17 @@ def check_admission(
             "sampled-pair estimator fits this budget and returns PAC "
             "with the disclosed error bound in the 'estimator' field "
             "— or " + hint
+        )
+    if packed is not None and packed.get("fits_budget"):
+        # Prepended LAST so it leads the hint: the packed
+        # representation keeps EXACT counts — same statistic, no error
+        # band, just a different accumulator layout — so it outranks
+        # the estimator in the recommendation ordering.
+        hint = (
+            "resubmit with config.accum_repr = 'packed': the "
+            "bit-plane representation keeps exact counts at ~1/32 the "
+            "accumulator bytes and fits this budget (see the 'packed' "
+            "field) — or " + hint
         )
     payload = {
         "error": (
@@ -258,4 +355,6 @@ def check_admission(
     }
     if estimator is not None:
         payload["estimator"] = dict(estimator)
+    if packed is not None:
+        payload["packed"] = dict(packed)
     raise PreflightReject(payload)

@@ -128,6 +128,7 @@ from consensus_clustering_tpu.serve.preflight import (
     check_admission,
     estimate_estimator_bytes,
     estimate_job_bytes,
+    estimate_packed_bytes,
 )
 from consensus_clustering_tpu.serve.sched.fairshare import (
     FairShareQueue,
@@ -1240,11 +1241,34 @@ class Scheduler:
                 pass  # resolution hiccup; 16 is the heuristic floor
         return h_block
 
+    def _packed_estimate(
+        self, spec: JobSpec, n: int, d: int, h_block: int
+    ) -> Dict[str, Any]:
+        """The packed-representation footprint model (uint32 bit-plane
+        masks, ~1/32 the dense accumulator bytes, exact counts) — the
+        admission gate for ``accum_repr="packed"`` jobs and the third
+        disclosure block on every dense 413."""
+        return estimate_packed_bytes(
+            n, d, spec.k_values,
+            n_iterations=spec.n_iterations,
+            dtype=spec.dtype,
+            h_block=h_block,
+            subsampling=spec.subsampling,
+            checkpoints=self.checkpoints,
+        )
+
     def _exact_estimate(
         self, spec: JobSpec, n: int, d: int, h_block: int
     ) -> Dict[str, Any]:
         """The (correction-tightened) dense-engine footprint model —
-        the admission gate for exact-mode jobs."""
+        the admission gate for exact-mode jobs.  Packed-representation
+        jobs gate on THEIR model instead (that asymmetry is the whole
+        admission story: an exact job that 413s dense can resubmit
+        packed and fit) — uncorrected, because the memory accountant's
+        EWMA ledger is fed by dense executions of this shape bucket
+        and must not tighten a representation it never measured."""
+        if getattr(spec, "accum_repr", "dense") == "packed":
+            return self._packed_estimate(spec, n, d, h_block)
         estimate = estimate_job_bytes(
             n, d, spec.k_values,
             dtype=spec.dtype,
@@ -1354,6 +1378,29 @@ class Scheduler:
         n, d = (int(v) for v in x.shape)
         h_block = self._resolved_h_block(spec, n, d)
         estimator_est = self._estimator_estimate(spec, n, d, h_block)
+        # Packed-representation disclosure (ROADMAP item 1): priced for
+        # every job that is not already packed, so a dense 413 carries
+        # the exact-mode escape hatch next to the estimator's — the
+        # three-way choice, decided from one response.
+        packed_info = None
+        if (
+            getattr(spec, "mode", "exact") != "estimate"
+            and getattr(spec, "accum_repr", "dense") != "packed"
+        ):
+            packed_est = self._packed_estimate(spec, n, d, h_block)
+            packed_info = {
+                "estimated_bytes": int(packed_est["total_bytes"]),
+                "fits_budget": (
+                    int(packed_est["total_bytes"])
+                    <= self.memory_budget_bytes
+                ),
+                "estimate": dict(packed_est),
+                "hint": (
+                    "resubmit with config.accum_repr = 'packed' to "
+                    "run EXACT consensus on bit-plane accumulators at "
+                    "this footprint (results bit-identical to dense)"
+                ),
+            }
         if getattr(spec, "mode", "exact") == "estimate":
             # Estimate-mode jobs are gated on their own O(M) model
             # (uncorrected: the correction EWMA belongs to the dense
@@ -1389,6 +1436,7 @@ class Scheduler:
             check_admission(
                 estimate, self.memory_budget_bytes, x.shape,
                 estimator=estimator_info,
+                packed=packed_info,
             )
         except PreflightReject as e:
             with self._lock:

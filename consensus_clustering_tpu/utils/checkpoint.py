@@ -39,6 +39,13 @@ def _fingerprint(config: SweepConfig, seed: int) -> str:
     payload.pop("chunk_size")
     payload.pop("use_pallas", None)
     payload.pop("integrity_check_every", None)
+    # accum_repr (dense vs packed accumulators) and the packed-kernel
+    # selector change HBM layout and the popcount path, never any
+    # count: packed-vs-dense Mij/Iij bit-identity is the representation's
+    # parity gate (tests/test_packed_parity.py), so neither may
+    # invalidate per-K result checkpoints.
+    payload.pop("accum_repr", None)
+    payload.pop("use_packed_kernel", None)
     # stream_h_block is an execution strategy, not a semantic: the
     # streamed sweep is bit-exact to the monolithic one at full H (the
     # PR-3 parity proof), so block size must not invalidate per-K
@@ -105,12 +112,21 @@ def stream_fingerprint(
     the per-K scheme's reasons — exact integer counts either way — and
     ``integrity_check_every`` because the sentinel only reads state: a
     run checked at a different cadence must still resume this ring.
+
+    ``accum_repr`` deliberately stays IN (unlike the per-K scheme):
+    the streamed state IS the representation — dense int32 row blocks
+    vs packed uint32 bit-planes — so packed and dense generations get
+    different fingerprints and can never cross-resume, even though
+    their finished counts are bit-identical.  ``use_packed_kernel`` is
+    popped: Pallas-vs-lax popcount produces the same planes bit for
+    bit, and a kernel probe degrading mid-fleet must not orphan a ring.
     """
     payload = dataclasses.asdict(config)
     payload["seed"] = seed
     payload.pop("store_matrices")
     payload.pop("chunk_size")
     payload.pop("use_pallas", None)
+    payload.pop("use_packed_kernel", None)
     payload.pop("integrity_check_every", None)
     payload["n_iterations"] = (
         config.n_iterations if n_iterations is None else int(n_iterations)

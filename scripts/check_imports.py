@@ -46,6 +46,16 @@ EXPECTED_SUBPACKAGES = (
     "consensus_clustering_tpu.utils",
 )
 
+# Individual modules the gate must SEE (same rationale): load-bearing
+# leaf modules a rename/delete would silently drop from the walk while
+# their importers (engines, preflight, benchmarks) still die.  The
+# packed accumulation path lives here — both engines and the serving
+# admission gate import it.
+EXPECTED_MODULES = (
+    "consensus_clustering_tpu.ops.bitpack",
+    "consensus_clustering_tpu.ops.pallas_coassoc",
+)
+
 
 def iter_module_names(package_name: str):
     pkg = importlib.import_module(package_name)
@@ -68,11 +78,12 @@ def main() -> int:
         except BaseException:  # noqa: BLE001 — report, keep scanning
             failures.append((name, traceback.format_exc(limit=3)))
     missing = [p for p in EXPECTED_SUBPACKAGES if p not in names]
+    missing += [m for m in EXPECTED_MODULES if m not in names]
     if missing:
         for pkg in missing:
             print(
-                f"FAIL {pkg}: subpackage not discovered by pkgutil "
-                "(deleted __init__.py / renamed directory?)",
+                f"FAIL {pkg}: module not discovered by pkgutil "
+                "(deleted __init__.py / renamed file or directory?)",
                 file=sys.stderr,
             )
     if failures or missing:

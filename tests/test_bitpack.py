@@ -1,0 +1,255 @@
+"""Bit-packed co-membership masks: pack/unpack, popcount primitive,
+packed-vs-dense count parity, and the fused Pallas kernel's gate.
+
+Ops-level half of the packed-representation parity story (the engine
+half lives in tests/test_packed_parity.py): every count the packed path
+produces must equal the dense bf16-GEMM path's BIT FOR BIT — int32
+exactness is load-bearing for the resume/dedup/integrity story.  Per
+the tier-1 budget rule only the tiny boundary cases run in the fast
+lane; the heavier kernel/interpret shapes are slow-marked
+(packed-smoke CI runs them all).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensus_clustering_tpu.ops.bitpack import (
+    PACK_BITS,
+    coassoc_counts_packed,
+    cosample_masks,
+    cosample_counts_packed,
+    membership_masks,
+    pack_bits,
+    pack_cosample_planes,
+    pack_label_planes,
+    packed_width,
+    popcount_accumulate,
+    unpack_bits,
+)
+from consensus_clustering_tpu.ops.coassoc import coassociation_counts
+from consensus_clustering_tpu.ops.resample import (
+    cosample_counts,
+    resample_indices,
+)
+
+
+def _numpy_popcount(v):
+    v = np.asarray(v, dtype=np.uint32).copy()
+    v -= (v >> np.uint32(1)) & np.uint32(0x55555555)
+    v = (v & np.uint32(0x33333333)) + (
+        (v >> np.uint32(2)) & np.uint32(0x33333333)
+    )
+    v = (v + (v >> np.uint32(4))) & np.uint32(0x0F0F0F0F)
+    return ((v * np.uint32(0x01010101)) >> np.uint32(24)).astype(np.int64)
+
+
+def _plan(n=37, h=45, n_sub=29, k_max=5, seed=0, invalid_rows=2):
+    rng = np.random.default_rng(seed)
+    idx = np.array(
+        resample_indices(jax.random.PRNGKey(seed), n, h, n_sub)
+    )
+    labels = rng.integers(0, k_max, size=(h, n_sub)).astype(np.int32)
+    if invalid_rows:
+        # Padding sentinels: both representations must drop them.
+        labels[-invalid_rows:] = -1
+        idx[-invalid_rows:] = -1
+    return jnp.asarray(labels), jnp.asarray(idx)
+
+
+class TestPackUnpack:
+    def test_roundtrip_vs_numpy(self):
+        rng = np.random.default_rng(1)
+        for n in (1, 31, 32, 33, 70):
+            bits = rng.integers(0, 2, size=(3, n)).astype(np.int32)
+            words = pack_bits(jnp.asarray(bits))
+            assert words.dtype == jnp.uint32
+            assert words.shape == (3, packed_width(n))
+            assert np.array_equal(
+                np.asarray(unpack_bits(words, n)), bits
+            )
+
+    def test_packed_width(self):
+        assert packed_width(1) == 1
+        assert packed_width(32) == 1
+        assert packed_width(33) == 2
+        assert PACK_BITS == 32
+
+    def test_membership_masks_shape_and_bits(self):
+        labels, idx = _plan()
+        masks = membership_masks(labels, idx, 5, 37)
+        assert masks.shape == (45, 5, packed_width(37))
+        bits = np.asarray(unpack_bits(masks, 37))
+        # Every valid (resample, element) pair has exactly one cluster
+        # bit; invalid rows none.
+        per_elem = bits.sum(axis=1)
+        cos = np.asarray(unpack_bits(cosample_masks(idx, 37), 37))
+        assert np.array_equal(per_elem, cos)
+
+    def test_plane_layout_matches_membership_masks(self):
+        labels, idx = _plan()
+        planes = pack_label_planes(labels, idx, 5, 37)
+        # Transposed views agree: plane bit (h, c, i) == mask bit.
+        mask_bits = np.asarray(
+            unpack_bits(membership_masks(labels, idx, 5, 37), 37)
+        )  # (H, k, N)
+        plane_bits = np.zeros((45, 5, 37), np.int32)
+        pw = np.asarray(planes)  # (k, Wh, N)
+        for h in range(45):
+            plane_bits[h] = (
+                (pw[:, h // 32, :] >> np.uint32(h % 32)) & 1
+            ).astype(np.int32)
+        assert np.array_equal(mask_bits, plane_bits)
+
+    def test_offset_split_psum_equivalence(self):
+        # Disjoint-bit contributions sum to the whole packing — the
+        # property the mesh shards' psum-as-OR rests on.
+        labels, idx = _plan()
+        whole = pack_label_planes(labels, idx, 5, 37)
+        nw = packed_width(45)
+        a = pack_label_planes(
+            labels[:20], idx[:20], 5, 37, n_words=nw, row0=0
+        )
+        b = pack_label_planes(
+            labels[20:], idx[20:], 5, 37, n_words=nw, row0=20
+        )
+        assert np.array_equal(np.asarray(a + b), np.asarray(whole))
+        cw = pack_cosample_planes(idx, 37)
+        ca = pack_cosample_planes(idx[:20], 37, n_words=nw, row0=0)
+        cb = pack_cosample_planes(idx[20:], 37, n_words=nw, row0=20)
+        assert np.array_equal(np.asarray(ca + cb), np.asarray(cw))
+
+
+class TestPopcountPrimitive:
+    def test_vs_numpy(self):
+        rng = np.random.default_rng(2)
+        rows = rng.integers(0, 2**32, size=(9, 13), dtype=np.uint32)
+        cols = rng.integers(0, 2**32, size=(9, 17), dtype=np.uint32)
+        got = np.asarray(
+            popcount_accumulate(jnp.asarray(rows), jnp.asarray(cols))
+        )
+        want = sum(
+            _numpy_popcount(rows[l][:, None] & cols[l][None, :])
+            for l in range(9)
+        )
+        assert np.array_equal(got, want)
+
+    def test_word_count_mismatch_raises(self):
+        with pytest.raises(ValueError, match="word counts differ"):
+            popcount_accumulate(
+                jnp.zeros((3, 4), jnp.uint32), jnp.zeros((2, 4), jnp.uint32)
+            )
+
+
+class TestPackedDenseParity:
+    """The fast boundary case of the ops parity family (engine-level
+    cases are slow-marked in test_packed_parity.py)."""
+
+    def test_coassoc_counts_bit_identical(self):
+        labels, idx = _plan()
+        dense = np.asarray(coassociation_counts(labels, idx, 37, 5))
+        packed = np.asarray(
+            coassociation_counts(labels, idx, 37, 5, accum_repr="packed")
+        )
+        assert packed.dtype == np.int32
+        assert np.array_equal(dense, packed)
+
+    def test_row_block_traced_start(self):
+        labels, idx = _plan()
+        kw = dict(n_cols=40, row_start=jnp.int32(8), n_rows=16)
+        dense = np.asarray(
+            coassociation_counts(labels, idx, 37, 5, **kw)
+        )
+        packed = np.asarray(coassoc_counts_packed(
+            labels, idx, 37, 5, **kw
+        ))
+        assert np.array_equal(dense, packed)
+
+    def test_cosample_counts_bit_identical(self):
+        _, idx = _plan()
+        dense = np.asarray(cosample_counts(idx, 37))
+        packed = np.asarray(
+            cosample_counts(idx, 37, accum_repr="packed")
+        )
+        assert np.array_equal(dense, packed)
+        blk = np.asarray(cosample_counts_packed(
+            idx, 37, n_cols=40, row_start=jnp.int32(4), n_rows=8
+        ))
+        assert np.array_equal(
+            np.asarray(cosample_counts(
+                idx, 37, n_cols=40, row_start=jnp.int32(4), n_rows=8
+            )),
+            blk,
+        )
+
+
+class TestPallasKernel:
+    def test_interpret_parity_small(self):
+        # One fast interpret-mode case; heavier grids are slow below.
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, 2**32, size=(5, 9), dtype=np.uint32)
+        cols = rng.integers(0, 2**32, size=(5, 7), dtype=np.uint32)
+        from consensus_clustering_tpu.ops.pallas_coassoc import (
+            packed_coassoc_counts,
+        )
+
+        lax_out = popcount_accumulate(
+            jnp.asarray(rows), jnp.asarray(cols)
+        )
+        k_out = packed_coassoc_counts(
+            jnp.asarray(rows), jnp.asarray(cols),
+            use_kernel=True, interpret=True,
+        )
+        assert np.array_equal(np.asarray(lax_out), np.asarray(k_out))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "l_words,r,c",
+        [(13, 264, 300), (40, 128, 256), (9, 31, 129), (65, 200, 140)],
+    )
+    def test_interpret_parity_ragged_grids(self, l_words, r, c):
+        rng = np.random.default_rng(l_words)
+        rows = rng.integers(0, 2**32, size=(l_words, r), dtype=np.uint32)
+        cols = rng.integers(0, 2**32, size=(l_words, c), dtype=np.uint32)
+        from consensus_clustering_tpu.ops.pallas_coassoc import (
+            packed_coassoc_counts,
+        )
+
+        lax_out = popcount_accumulate(
+            jnp.asarray(rows), jnp.asarray(cols)
+        )
+        k_out = packed_coassoc_counts(
+            jnp.asarray(rows), jnp.asarray(cols),
+            use_kernel=True, interpret=True,
+        )
+        assert np.array_equal(np.asarray(lax_out), np.asarray(k_out))
+
+    def test_cpu_probe_degrades_to_lax(self):
+        # On a CPU backend the probe never selects compiled Pallas —
+        # use_kernel=None must resolve to the lax fallback (the
+        # BENCH_r01 auto-degrade contract at its cheapest tier).
+        from consensus_clustering_tpu.ops.pallas_coassoc import (
+            packed_kernel_available,
+        )
+
+        assert packed_kernel_available() is False
+
+    def test_probe_failure_caches_fallback(self, monkeypatch):
+        # A probe that crashes (the Mosaic lowering class) yields False
+        # and caches it — the gate degrades, never raises.
+        from consensus_clustering_tpu.ops import probe
+
+        monkeypatch.setattr(
+            probe.jax, "default_backend", lambda: "faketpu"
+        )
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("Mosaic lowering failed")
+
+        assert probe.probe_cached("jl010-test-kernel", boom) is False
+        assert probe.probe_cached("jl010-test-kernel", boom) is False
+        assert len(calls) == 1

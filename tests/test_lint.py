@@ -230,9 +230,9 @@ def test_rule_does_not_fire(tmp_path, rule_id):
     ]
 
 
-def test_all_nine_rules_registered():
+def test_all_ten_rules_registered():
     ids = sorted(r.id for r in all_rules())
-    assert ids == [f"JL{i:03d}" for i in range(1, 10)]
+    assert ids == [f"JL{i:03d}" for i in range(1, 11)]
 
 
 def test_rule_packs_name_registered_rules():
@@ -242,6 +242,7 @@ def test_rule_packs_name_registered_rules():
     for pack, rule_ids_ in RULE_PACKS.items():
         assert set(rule_ids_) <= ids, pack
     assert RULE_PACKS["estimator"] == ("JL009",)
+    assert RULE_PACKS["packed"] == ("JL010",)
 
 
 # JL009 is directory-scoped (the estimator rule pack), so its fixtures
@@ -290,6 +291,57 @@ def test_jl009_silent_outside_estimator(tmp_path):
     # subsystem invariant, not a universal rule.
     active = _lint_in_pack(tmp_path, _JL009_FIRES, "parallel")
     assert "JL009" not in rule_ids(active)
+
+
+# JL010 guards the packed accumulation path: a packed/ directory (the
+# pack scope) or the two flat ops modules (PACKED_PATH_MODULES).
+
+_JL010_FIRES = """
+from consensus_clustering_tpu.ops.coassoc import coassociation_counts
+
+def bad(n, planes):
+    dense = jnp.zeros((n, n), jnp.int32)   # square unpack target
+    return dense + coassociation_counts(planes, planes, n, 2)
+"""
+
+_JL010_CLEAN = """
+def good(k_max, w_cap, n, tile_r):
+    planes = jnp.zeros((k_max, w_cap, n), jnp.uint32)  # packed state
+    tile = jnp.zeros((tile_r, n), jnp.int32)           # row tile: fine
+    return planes, tile
+"""
+
+
+def _lint_named_module(tmp_path, source, filename):
+    pkg = tmp_path / "consensus_clustering_tpu" / "ops"
+    pkg.mkdir(parents=True, exist_ok=True)
+    path = pkg / filename
+    path.write_text(_PRELUDE + source)
+    active, suppressed, error = lint_file(str(path))
+    assert error is None, error
+    return active
+
+
+def test_jl010_fires_in_packed_modules(tmp_path):
+    for filename in ("bitpack.py", "pallas_coassoc.py"):
+        active = _lint_named_module(tmp_path, _JL010_FIRES, filename)
+        lines = [f for f in active if f.rule == "JL010"]
+        assert len(lines) == 2, [(f.line, f.message) for f in active]
+
+
+def test_jl010_fires_in_packed_directory(tmp_path):
+    active = _lint_in_pack(tmp_path, _JL010_FIRES, "packed")
+    assert len([f for f in active if f.rule == "JL010"]) == 2
+
+
+def test_jl010_clean_in_packed_modules(tmp_path):
+    active = _lint_named_module(tmp_path, _JL010_CLEAN, "bitpack.py")
+    assert "JL010" not in rule_ids(active)
+
+
+def test_jl010_silent_elsewhere(tmp_path):
+    active = _lint_named_module(tmp_path, _JL010_FIRES, "other.py")
+    assert "JL010" not in rule_ids(active)
 
 
 def test_finding_names_file_line_and_rule(tmp_path):

@@ -1,0 +1,441 @@
+"""Packed accumulator representation: engine parity, resume, integrity,
+admission.
+
+The parity gate is int32 BIT-IDENTITY: ``accum_repr="packed"`` must
+produce byte-equal ``Mij``/``Iij``/curves (and therefore byte-equal
+``result_fingerprint``) at every tested shape family — exactness is
+load-bearing for the resume/dedup/integrity story.  Compile-bearing
+cases are slow-marked per the tier-1 budget rule; the tiny streamed
+boundary case stays in the fast lane (packed-smoke CI runs the whole
+file).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.streaming import StreamingSweep
+from consensus_clustering_tpu.resilience.faults import (
+    IntegrityError,
+    faults,
+)
+
+N, D = 29, 4
+KV = (2, 3)
+
+
+def _x(seed=0, n=N, d=D):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(
+        np.float32
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        n_samples=N, n_features=D, k_values=KV, n_iterations=12,
+        store_matrices=False, stream_h_block=4,
+    )
+    base.update(kw)
+    return SweepConfig(**base)
+
+
+_CURVE_KEYS = ("hist", "cdf", "pac_area")
+
+
+def _assert_bit_equal(a, b, keys):
+    for k in keys:
+        av, bv = np.asarray(a[k]), np.asarray(b[k])
+        assert av.dtype == bv.dtype, k
+        assert av.tobytes() == bv.tobytes(), f"{k} not byte-identical"
+
+
+class TestConfigSurface:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="accum_repr"):
+            SweepConfig(n_samples=10, n_features=2, accum_repr="bits")
+        cfg = _cfg(accum_repr="packed")
+        assert cfg.accum_repr == "packed"
+        assert cfg.use_packed_kernel is None
+
+    def test_stream_fingerprint_separates_reprs(self):
+        from consensus_clustering_tpu.utils.checkpoint import (
+            stream_fingerprint,
+        )
+
+        dense = stream_fingerprint(_cfg(), 7, "sha")
+        packed = stream_fingerprint(
+            _cfg(accum_repr="packed"), 7, "sha"
+        )
+        assert dense != packed
+        # ... while the kernel selector must NOT split rings.
+        assert packed == stream_fingerprint(
+            _cfg(accum_repr="packed", use_packed_kernel=True), 7, "sha"
+        )
+
+    def test_per_k_fingerprint_ignores_repr(self):
+        from consensus_clustering_tpu.utils.checkpoint import (
+            _fingerprint,
+        )
+
+        assert _fingerprint(_cfg(), 7) == _fingerprint(
+            _cfg(accum_repr="packed", use_packed_kernel=False), 7
+        )
+
+    def test_capacity_guard_before_any_compile(self):
+        eng = StreamingSweep(
+            KMeans(n_init=1), _cfg(accum_repr="packed")
+        )
+        with pytest.raises(ValueError, match="packed accumulator "
+                                             "capacity"):
+            eng.run(_x(), 7, 100)
+
+
+class TestStreamedParity:
+    def test_tiny_boundary_bit_identity(self):
+        # The one fast compile-bearing case of this family (PR-3/PR-12
+        # budget rule); every other shape is slow below.
+        x = _x()
+        out_d = StreamingSweep(KMeans(n_init=1), _cfg()).run(x, 7, 12)
+        out_p = StreamingSweep(
+            KMeans(n_init=1), _cfg(accum_repr="packed")
+        ).run(x, 7, 12)
+        _assert_bit_equal(out_d, out_p, _CURVE_KEYS)
+        assert out_p["timing"]["packed_kernel"] == "lax"
+        assert out_p["streaming"]["accum_repr"] == "packed"
+        # result_fingerprint byte-identity through the REAL serving
+        # shaper: the semantic block is a pure function of the curves,
+        # and accum_repr rides outside it (production metadata).
+        fps = []
+        for spec_repr, host in (("dense", out_d), ("packed", out_p)):
+            from consensus_clustering_tpu.autotune.policy import (
+                Resolution,
+            )
+            from consensus_clustering_tpu.serve.executor import (
+                JobSpec,
+                SweepExecutor,
+            )
+
+            class _Fake:
+                backend = staticmethod(lambda: "cpu")
+
+            spec = JobSpec(
+                k_values=KV, n_iterations=12, accum_repr=spec_repr
+            )
+            result = SweepExecutor._shape_result(
+                _Fake(), spec, N, D, host,
+                Resolution("stream_h_block", 4, "user-pinned"),
+                0.0, False, 1.0, {},
+            )
+            fps.append(result["result_fingerprint"])
+            assert result["streaming"]["accum_repr"] == spec_repr
+        assert fps[0] == fps[1]
+
+    @pytest.mark.slow
+    def test_matrices_and_h_agnostic_runs(self):
+        x = _x()
+        cfg = _cfg(store_matrices=True)
+        eng_d = StreamingSweep(KMeans(n_init=1), cfg)
+        eng_p = StreamingSweep(
+            KMeans(n_init=1), dataclasses.replace(
+                cfg, accum_repr="packed"
+            )
+        )
+        for h in (12, 7):  # full capacity, then a smaller runtime H
+            out_d, out_p = eng_d.run(x, 7, h), eng_p.run(x, 7, h)
+            _assert_bit_equal(
+                out_d, out_p, _CURVE_KEYS + ("mij", "iij", "cij")
+            )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "devices,row_shards,k_shards",
+        [(4, 2, 1), (4, 4, 1), (8, 2, 2)],
+    )
+    def test_sharded_mesh_bit_identity(
+        self, devices, row_shards, k_shards
+    ):
+        x = _x()
+        cfg = _cfg(k_values=(2, 3, 4), store_matrices=True)
+        base = StreamingSweep(KMeans(n_init=1), cfg).run(x, 7, 12)
+        mesh = resample_mesh(
+            jax.devices()[:devices], row_shards=row_shards,
+            k_shards=k_shards,
+        )
+        out = StreamingSweep(
+            KMeans(n_init=1),
+            dataclasses.replace(cfg, accum_repr="packed"), mesh,
+        ).run(x, 7, 12)
+        _assert_bit_equal(
+            base, out, _CURVE_KEYS + ("mij", "iij", "cij")
+        )
+
+    @pytest.mark.slow
+    def test_monolithic_sweep_bit_identity(self):
+        from consensus_clustering_tpu.parallel.sweep import run_sweep
+
+        x = _x()
+        cfg = SweepConfig(
+            n_samples=N, n_features=D, k_values=KV, n_iterations=10,
+            store_matrices=True,
+        )
+        out_d = run_sweep(KMeans(n_init=1), cfg, x, 7)
+        out_p = run_sweep(
+            KMeans(n_init=1),
+            dataclasses.replace(cfg, accum_repr="packed"), x, 7,
+        )
+        _assert_bit_equal(
+            out_d, out_p, _CURVE_KEYS + ("mij", "iij", "cij")
+        )
+        assert out_p["timing"]["packed_kernel"] == "lax"
+        assert "packed_kernel" not in out_d["timing"]
+
+    @pytest.mark.slow
+    def test_fused_matches_solo(self):
+        xs = [_x(0), _x(1)]
+        eng = StreamingSweep(
+            KMeans(n_init=1), _cfg(accum_repr="packed")
+        )
+        solo = [eng.run(x, s, 12) for x, s in zip(xs, (3, 4))]
+        fused = eng.run_fused(xs, [3, 4], 12)
+        for s, f in zip(solo, fused):
+            _assert_bit_equal(s, f, _CURVE_KEYS)
+
+
+class TestResume:
+    @pytest.mark.slow
+    def test_kill_and_resume_bit_identical(self, tmp_path):
+        from consensus_clustering_tpu.resilience.blocks import (
+            StreamCheckpointer,
+        )
+
+        x = _x()
+        eng = StreamingSweep(
+            KMeans(n_init=1), _cfg(accum_repr="packed")
+        )
+        clean = eng.run(x, 7, 12)
+        ck = StreamCheckpointer(str(tmp_path / "ring"), every=1)
+        try:
+            faults.configure("block_start=2")
+            with pytest.raises(Exception):
+                eng.run(x, 7, 12, checkpointer=ck)
+            faults.configure("")
+            resumed = eng.run(x, 7, 12, checkpointer=ck)
+        finally:
+            faults.configure("")
+            ck.close()
+        assert resumed["streaming"]["resumed_from_block"] > 0
+        _assert_bit_equal(clean, resumed, _CURVE_KEYS)
+
+    @pytest.mark.slow
+    def test_dense_ring_never_cross_resumes(self, tmp_path):
+        # A dense generation must be invisible to a packed run of the
+        # same sweep (and vice versa): the stream fingerprints differ.
+        from consensus_clustering_tpu.resilience.blocks import (
+            StreamCheckpointer,
+        )
+
+        x = _x()
+        ck = StreamCheckpointer(str(tmp_path / "ring"), every=1)
+        try:
+            StreamingSweep(KMeans(n_init=1), _cfg()).run(
+                x, 7, 12, checkpointer=ck
+            )
+            out = StreamingSweep(
+                KMeans(n_init=1), _cfg(accum_repr="packed")
+            ).run(x, 7, 12, checkpointer=ck)
+        finally:
+            ck.close()
+        assert out["streaming"]["resumed_from_block"] == 0
+
+
+class TestIntegrity:
+    @pytest.mark.slow
+    def test_sentinel_catches_injected_bitflip(self):
+        x = _x()
+        eng = StreamingSweep(
+            KMeans(n_init=1),
+            _cfg(accum_repr="packed", integrity_check_every=1),
+        )
+        try:
+            faults.configure("accumulator=1:bitflip:3")
+            with pytest.raises(IntegrityError) as exc:
+                eng.run(x, 7, 12)
+        finally:
+            faults.configure("")
+        assert exc.value.details  # named violation counters
+
+    def test_packed_frame_verifier_refuses_corruption(self):
+        from consensus_clustering_tpu.ops.bitpack import (
+            pack_cosample_planes,
+            pack_label_planes,
+        )
+        from consensus_clustering_tpu.resilience.integrity import (
+            frame_digest,
+            verify_state_frame,
+        )
+
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 3, size=(8, 20)).astype(np.int32)
+        idx = np.stack([
+            rng.permutation(N)[:20].astype(np.int32) for _ in range(8)
+        ])
+        planes = np.array(pack_label_planes(
+            jax.numpy.asarray(labels), jax.numpy.asarray(idx), 3, N
+        ))[None]  # (nK=1, k, W, N)
+        cop = np.array(pack_cosample_planes(
+            jax.numpy.asarray(idx), N
+        ))
+        arrays = {"state_planes": planes, "state_coplanes": cop}
+        header = {
+            "h_done": 8, "hb_pad": 8, "digest": frame_digest(arrays),
+        }
+        assert verify_state_frame(header, arrays) is None
+        # A flipped membership bit must be refused even when the digest
+        # is recomputed to bless it (the already-corrupt-when-written
+        # class).
+        bad = planes.copy()
+        bad[0, 0, 0, 3] ^= np.uint32(1) << np.uint32(2)
+        bad_arrays = {"state_planes": bad, "state_coplanes": cop}
+        reason = verify_state_frame(
+            {"h_done": 8, "hb_pad": 8,
+             "digest": frame_digest(bad_arrays)},
+            bad_arrays,
+        )
+        assert reason is not None and "invariant" in reason
+        # Ghost bits beyond h_done are refused too.
+        reason = verify_state_frame(
+            {"h_done": 2, "hb_pad": 8, "digest": frame_digest(arrays)},
+            arrays,
+        )
+        assert reason is not None and "beyond h_done" in reason
+
+
+class TestAdmission:
+    def test_packed_model_monotonic_and_cheaper(self):
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_job_bytes,
+            estimate_packed_bytes,
+        )
+
+        prev = 0
+        for n in (256, 512, 1024, 4096):
+            est = estimate_packed_bytes(
+                n, 16, tuple(range(2, 11)), n_iterations=100
+            )
+            assert est["total_bytes"] > prev
+            prev = est["total_bytes"]
+        dense = estimate_job_bytes(4096, 16, tuple(range(2, 11)))
+        packed = estimate_packed_bytes(
+            4096, 16, tuple(range(2, 11)), n_iterations=100
+        )
+        assert packed["total_bytes"] * 10 < dense["total_bytes"]
+
+    def test_413_disclosure_is_three_way(self):
+        from consensus_clustering_tpu.serve.preflight import (
+            PreflightReject,
+            check_admission,
+            estimate_estimator_bytes,
+            estimate_job_bytes,
+            estimate_packed_bytes,
+        )
+
+        n, budget = 8192, 1 << 30
+        dense = estimate_job_bytes(n, 16, (2, 3))
+        packed_est = estimate_packed_bytes(
+            n, 16, (2, 3), n_iterations=100
+        )
+        est = estimate_estimator_bytes(n, 16, (2, 3))
+        assert dense["total_bytes"] > budget
+        with pytest.raises(PreflightReject) as exc:
+            check_admission(
+                dense, budget, (n, 16),
+                estimator={
+                    "estimated_bytes": est["total_bytes"],
+                    "fits_budget": est["total_bytes"] <= budget,
+                },
+                packed={
+                    "estimated_bytes": packed_est["total_bytes"],
+                    "fits_budget": (
+                        packed_est["total_bytes"] <= budget
+                    ),
+                },
+            )
+        payload = exc.value.payload
+        # The three-way contract: dense (the gating estimate) + packed
+        # + estimator all present, so the client decides without a
+        # second round-trip.
+        assert payload["estimate"]["total_bytes"] == dense[
+            "total_bytes"
+        ]
+        assert payload["packed"]["fits_budget"] is True
+        assert "estimator" in payload
+        assert "accum_repr = 'packed'" in payload["hint"]
+
+    def test_jobspec_roundtrip_and_bucket(self):
+        from consensus_clustering_tpu.serve.executor import (
+            JobSpec,
+            parse_job_spec,
+        )
+
+        spec, _ = parse_job_spec({
+            "data": [[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]],
+            "config": {"k": [2], "accum_repr": "packed"},
+        })
+        assert spec.accum_repr == "packed"
+        rebuilt = JobSpec.from_payload(spec.fingerprint_payload())
+        assert rebuilt.accum_repr == "packed"
+        # Old payloads (pre-packed) load as dense.
+        legacy = spec.fingerprint_payload()
+        legacy.pop("accum_repr")
+        assert JobSpec.from_payload(legacy).accum_repr == "dense"
+        # Packed buckets pin H (capacity-sized state); dense buckets
+        # stay H-agnostic.
+        dense_spec = dataclasses.replace(spec, accum_repr="dense")
+        b1 = json.loads(spec.bucket(3, 2, 16))
+        b2 = json.loads(dense_spec.bucket(3, 2, 16))
+        assert "n_iterations" in b1
+        assert "n_iterations" not in b2
+
+    def test_rejects_unknown_repr(self):
+        from consensus_clustering_tpu.serve.executor import (
+            JobSpecError,
+            parse_job_spec,
+        )
+
+        with pytest.raises(JobSpecError, match="accum_repr"):
+            parse_job_spec({
+                "data": [[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]],
+                "config": {"k": [2], "accum_repr": "sparse"},
+            })
+
+    def test_admin_footprints_view(self, tmp_path):
+        from consensus_clustering_tpu.serve.admin import (
+            _footprints_view,
+        )
+
+        store = tmp_path / "store"
+        (store / "payloads").mkdir(parents=True)
+        spec_payload = {
+            "k_values": [2, 3], "n_iterations": 50,
+            "subsampling": 0.8, "dtype": "float32",
+            "stream_h_block": None, "n_pairs": None,
+        }
+        (store / "payloads" / "job1.json").write_text(json.dumps(
+            {"spec": spec_payload, "restart_attempts": 0}
+        ))
+        view = _footprints_view(
+            str(store), "job1", {"shape": [512, 16]}
+        )
+        fps = view["footprints"]
+        assert set(fps) == {"dense", "packed", "estimator"}
+        assert fps["packed"]["total_bytes"] < fps["dense"][
+            "total_bytes"
+        ]
