@@ -1,0 +1,434 @@
+"""Mesh-sharded estimator evidence: parity, lane scaling, packed temps.
+
+ROADMAP item 2's remainder made the sampled-pair estimator mesh-native
+(`estimator/engine.py`: clustering lanes over the ('h', 'n') mesh, the
+M pair slots over 'n', int32 partial counts psum-merged).  This harness
+is the committed evidence, in three phases:
+
+1. **Sharding-invariance parity** (the hard gate, exit 1): pair
+   counts, curves, PAC trajectory — and therefore everything
+   ``result_fingerprint`` covers — BIT-IDENTICAL across >= 3 mesh
+   shapes (1x1 / 2x1 / 1x2, plus 2x2 when four devices exist), in
+   dense AND packed pair-path representation (packed == dense is also
+   asserted: the bit-plane popcount path must be exact, not close).
+2. **Lane scaling**: the estimator's block step is LANE-DOMINATED by
+   design (the O(M) state removed the memory wall; the clustering
+   lanes are the FLOPs).  Measured here: block wall vs per-block lane
+   count (near-linear), the exact per-device lane share local_h =
+   ceil(hb / D) a D-device mesh assigns, and the emulated multi-device
+   wall.  On a MULTI-CORE host the emulated wall shows the real
+   speedup; on a single-core host (this repo's committed record:
+   ``host_cores`` disclosed) emulated devices serialize on one core,
+   so the on-chip projection is the lane-linearity curve composed with
+   the work division — D chips each run 1/D of the lanes, and the
+   measured wall(lanes/D) IS the projected per-chip block wall (the
+   psum epsilon is O(M) ints, noise next to the lanes).
+3. **Packed temp reduction** (ROADMAP item 1 pairing): the packed pair
+   path's only N-proportional temp is one (ceil(hb/32), N) uint32
+   bit-plane where the dense path scatters an (hb, N) int32 labmat —
+   ~32x.  Measured on the EXACT sub-programs the engine's per-K body
+   embeds, via XLA's compiled-plan ``temp_size_in_bytes`` (the full
+   block-step plans are also recorded: they are dominated — equally,
+   in both representations — by the shared no-replacement resample
+   draw's O(hb·N) permutation workspace, which every engine in this
+   repo pays; the pair path's own temp is what the representation
+   changes).  The residual below 32x is the O(hb·n_sub) scatter
+   index-tuple workspace both paths pay; at the committed
+   N=10^6 shape the measured ratio is ~27x.
+
+Run (CPU host-platform device emulation)::
+
+    JAX_PLATFORMS=cpu python benchmarks/estimator_mesh.py \\
+        --out benchmarks/estimator_mesh/ESTIMATOR_MESH.json
+
+``--smoke`` shrinks every shape for the CI leg (estimator-smoke runs
+it under ``--xla_force_host_platform_device_count=2``).  Exit 1 on any
+parity violation or a packed temp ratio below the gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    # Four emulated devices: enough for the 2x2 parity corner.  A
+    # pre-set count (the CI leg pins 2) is respected.
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    )
+
+
+def _engine(n, d, k, h, hb, m, mesh=None, accum_repr="dense"):
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.estimator.engine import (
+        PairConsensusEngine,
+    )
+    from consensus_clustering_tpu.models.kmeans import KMeans
+
+    config = SweepConfig(
+        n_samples=n, n_features=d, k_values=k, n_iterations=h,
+        store_matrices=False, stream_h_block=hb,
+        accum_repr=accum_repr,
+    )
+    return PairConsensusEngine(KMeans(), config, n_pairs=m, mesh=mesh)
+
+
+def parity_phase(smoke: bool):
+    """Phase 1: bit-identical outputs across mesh shapes and pair-path
+    representations."""
+    import jax
+    import numpy as np
+
+    from consensus_clustering_tpu.estimator.validate import blobs
+    from consensus_clustering_tpu.parallel.mesh import resample_mesh
+
+    n, d, h, hb, m = (60, 3, 4, 4, 129) if smoke else (120, 4, 8, 4, 513)
+    k = (2,) if smoke else (2, 3)
+    x = blobs(n, d, seed=7)
+    devices = jax.devices()
+    meshes = [("1x1", None)]
+    if len(devices) >= 2:
+        meshes.append(("2x1", resample_mesh(devices[:2])))
+        meshes.append(("1x2", resample_mesh(devices[:2], row_shards=2)))
+    if len(devices) >= 4:
+        meshes.append(("2x2", resample_mesh(devices[:4], row_shards=2)))
+
+    record = {
+        "shape": {"n": n, "d": d, "h": h, "h_block": hb, "n_pairs": m,
+                  "k_values": list(k)},
+        "mesh_shapes": [name for name, _ in meshes],
+        "families": [],
+    }
+    passed = True
+    ref = None
+    for repr_ in ("dense", "packed"):
+        for name, mesh in meshes:
+            out = _engine(
+                n, d, k, h, hb, m, mesh=mesh, accum_repr=repr_
+            ).run(x, 23, h, return_state=True)
+            if ref is None:
+                ref = out
+                continue
+            ok = (
+                np.array_equal(
+                    ref["pair_state"]["mij"], out["pair_state"]["mij"]
+                )
+                and np.array_equal(
+                    ref["pair_state"]["iij"], out["pair_state"]["iij"]
+                )
+                and np.array_equal(ref["pac_area"], out["pac_area"])
+                and np.array_equal(ref["cdf"], out["cdf"])
+                and ref["streaming"]["pac_trajectory"]
+                == out["streaming"]["pac_trajectory"]
+            )
+            record["families"].append(
+                {
+                    "mesh": name,
+                    "accum_repr": repr_,
+                    "bit_identical": bool(ok),
+                }
+            )
+            passed = passed and ok
+            print(
+                f"  parity {repr_} @ {name}: "
+                f"{'OK' if ok else 'MISMATCH'}",
+                file=sys.stderr,
+            )
+    record["passed"] = passed
+    return record, passed
+
+
+def lane_scaling_phase(smoke: bool):
+    """Phase 2: lane-linearity + mesh work division + emulated wall."""
+    import jax
+
+    from consensus_clustering_tpu.estimator.validate import blobs
+    from consensus_clustering_tpu.parallel.mesh import resample_mesh
+
+    n, d, m = (800, 8, 2048) if smoke else (4000, 16, 8192)
+    k = (2,) if smoke else (2, 3, 4)
+    hb = 16 if smoke else 32
+    reps = 2 if smoke else 3
+    x = blobs(n, d, seed=3)
+    try:
+        host_cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        host_cores = os.cpu_count() or 1
+
+    # Lane-linearity: one block of L lanes per K, L halving — the
+    # measured per-chip block wall at a D-chip mesh's lane share.
+    lane_curve = []
+    base_wall = None
+    lanes = hb
+    while lanes >= max(2, hb // 4):
+        eng = _engine(n, d, k, lanes, lanes, m)
+        eng.warmup(x)
+        best = None
+        for _ in range(reps):
+            out = eng.run(x, 23, lanes)
+            rs = out["timing"]["run_seconds"]
+            best = rs if best is None else min(best, rs)
+        if base_wall is None:
+            base_wall = best
+        lane_curve.append(
+            {
+                "lanes_per_block": lanes,
+                "block_wall_seconds": round(best, 4),
+                "speedup_vs_full_block": round(base_wall / best, 2),
+            }
+        )
+        print(
+            f"  lanes/block {lanes}: {best:.4f}s "
+            f"(x{base_wall / best:.2f})",
+            file=sys.stderr,
+        )
+        lanes //= 2
+
+    # Mesh work division + the emulated multi-device wall.  The lane
+    # share divides EXACTLY (sweep_geometry); the emulated wall only
+    # shows the parallel speedup when the host has cores to run the
+    # devices on — disclosed, never inferred.
+    mesh_rows = []
+    devices = jax.devices()
+    for ndev in (1, 2, 4):
+        if ndev > len(devices):
+            break
+        eng = _engine(
+            n, d, k, hb, hb, m, mesh=resample_mesh(devices[:ndev])
+        )
+        eng.warmup(x)
+        best = None
+        for _ in range(reps):
+            out = eng.run(x, 23, hb)
+            rs = out["timing"]["run_seconds"]
+            best = rs if best is None else min(best, rs)
+        local = -(-hb // ndev)
+        projected = next(
+            (
+                row["block_wall_seconds"]
+                for row in lane_curve
+                if row["lanes_per_block"] == local
+            ),
+            None,
+        )
+        mesh_rows.append(
+            {
+                "devices": ndev,
+                "lanes_per_device": local,
+                "emulated_wall_seconds": round(best, 4),
+                "projected_on_chip_wall_seconds": projected,
+            }
+        )
+        print(
+            f"  mesh {ndev}dev: lanes/dev={local} "
+            f"emulated={best:.4f}s projected={projected}",
+            file=sys.stderr,
+        )
+    speedup2 = None
+    if len(lane_curve) >= 2:
+        speedup2 = lane_curve[1]["speedup_vs_full_block"]
+    return {
+        "shape": {"n": n, "d": d, "h_block": hb, "n_pairs": m,
+                  "k_values": list(k)},
+        "host_cores": host_cores,
+        "lane_linearity": lane_curve,
+        "mesh_division": mesh_rows,
+        "projected_speedup_2dev": speedup2,
+        "note": (
+            "emulated devices share the host cores: with host_cores "
+            ">= devices the emulated wall is the measured speedup; "
+            "below that the on-chip projection is the lane-linearity "
+            "curve composed with the exact per-device lane share "
+            "(each of D chips runs lanes/D; the psum epsilon is O(M) "
+            "ints)"
+        ),
+    }
+
+
+def packed_temp_phase(smoke: bool):
+    """Phase 3: the pair path's N-proportional temp, dense vs packed,
+    from XLA's compiled plan — measured on the exact per-K sub-programs
+    the engine embeds, plus the full block-step plans for context."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.estimator.engine import (
+        PairConsensusEngine,
+    )
+    from consensus_clustering_tpu.ops.bitpack import (
+        pack_label_planes,
+        packed_width,
+    )
+    from consensus_clustering_tpu.parallel.sweep import (
+        compiled_memory_stats,
+    )
+
+    n = 100_000 if smoke else 1_000_000
+    hb, m, k_max = 128, 1024, 3
+    n_sub = 1000
+    wb = packed_width(hb)
+    gate = 4.0 if smoke else 8.0
+
+    def dense_pair_counts(labels, indices, pair_i, pair_j):
+        rows = jnp.arange(hb, dtype=jnp.int32)[:, None]
+        safe = jnp.where(indices >= 0, indices, n)
+        labmat = (
+            jnp.zeros((hb, n), jnp.int32)
+            .at[rows, safe]
+            .set(labels + 1, mode="drop")
+        )
+        li = labmat[:, pair_i]
+        lj = labmat[:, pair_j]
+        return jnp.sum(((li > 0) & (li == lj)).astype(jnp.int32), axis=0)
+
+    def packed_pair_counts(labels, indices, pair_i, pair_j):
+        def cluster_step(c, acc):
+            lab_c = jnp.where(labels == c, 0, -1)
+            plane = pack_label_planes(
+                lab_c, indices, 1, n, n_words=wb
+            )[0]
+            anded = plane[:, pair_i] & plane[:, pair_j]
+            return acc + jnp.sum(
+                jax.lax.population_count(anded).astype(jnp.int32),
+                axis=0,
+            )
+
+        return jax.lax.fori_loop(
+            0, k_max, cluster_step, jnp.zeros((m,), jnp.int32)
+        )
+
+    structs = (
+        jax.ShapeDtypeStruct((hb, n_sub), jnp.int32),
+        jax.ShapeDtypeStruct((hb, n_sub), jnp.int32),
+        jax.ShapeDtypeStruct((m,), jnp.int32),
+        jax.ShapeDtypeStruct((m,), jnp.int32),
+    )
+    plans = {}
+    for name, fn in (
+        ("dense", dense_pair_counts), ("packed", packed_pair_counts),
+    ):
+        plans[name] = compiled_memory_stats(
+            jax.jit(fn).lower(*structs).compile()
+        )
+    ratio = plans["dense"]["temp_size_in_bytes"] / max(
+        1, plans["packed"]["temp_size_in_bytes"]
+    )
+    print(
+        f"  pair-path temps: dense="
+        f"{plans['dense']['temp_size_in_bytes']} packed="
+        f"{plans['packed']['temp_size_in_bytes']} ratio={ratio:.1f}x "
+        f"(gate >= {gate}x; model 32x, residual = O(hb*n_sub) "
+        "scatter index tuples both paths pay)",
+        file=sys.stderr,
+    )
+
+    # Full block-step plans for context: dominated (equally) by the
+    # shared resample permutation draw — the honest denominator.
+    block_n = 20_000 if smoke else 50_000
+    block_plans = {}
+    for repr_ in ("dense", "packed"):
+        config = SweepConfig(
+            n_samples=block_n, n_features=4, k_values=(2,),
+            n_iterations=hb, store_matrices=False, stream_h_block=hb,
+            subsampling=0.05, accum_repr=repr_,
+        )
+        config = dataclasses.replace(config)
+        eng = PairConsensusEngine(KMeans(), config, n_pairs=m)
+        block_plans[repr_] = eng.compiled_memory_stats()
+
+    passed = ratio >= gate
+    return {
+        "shape": {
+            "n": n, "h_block": hb, "n_sub": n_sub, "n_pairs": m,
+            "k_max": k_max,
+        },
+        "pair_path_plan": plans,
+        "temp_ratio": round(ratio, 2),
+        "temp_ratio_gate": gate,
+        "model_ratio": 32,
+        "block_step_plan": {
+            "n": block_n,
+            **{
+                repr_: plan
+                for repr_, plan in block_plans.items()
+            },
+        },
+        "passed": bool(passed),
+    }, passed
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="mesh-sharded estimator: parity + scaling evidence"
+    )
+    parser.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "estimator_mesh", "ESTIMATOR_MESH.json",
+        ),
+    )
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="CI-sized shapes (the estimator-smoke leg)",
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    record = {
+        "harness": "benchmarks/estimator_mesh.py",
+        "generated_at": round(time.time(), 3),
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "device_count": len(jax.devices()),
+        "smoke": bool(args.smoke),
+    }
+    ok = True
+
+    print("[1/3] sharding-invariance parity...", file=sys.stderr)
+    parity, parity_ok = parity_phase(args.smoke)
+    record["parity"] = parity
+    ok = ok and parity_ok
+
+    print("[2/3] lane scaling + mesh work division...", file=sys.stderr)
+    record["lane_scaling"] = lane_scaling_phase(args.smoke)
+
+    print("[3/3] packed pair-path temp reduction...", file=sys.stderr)
+    packed, packed_ok = packed_temp_phase(args.smoke)
+    record["packed_temp"] = packed
+    ok = ok and packed_ok
+    record["passed"] = ok
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True, default=float)
+        f.write("\n")
+    print(json.dumps(
+        {
+            "passed": ok,
+            "out": args.out,
+            "parity": parity_ok,
+            "packed_temp_ratio": packed.get("temp_ratio"),
+            "projected_speedup_2dev": record["lane_scaling"].get(
+                "projected_speedup_2dev"
+            ),
+        },
+        indent=1,
+    ))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,9 @@ def wall_demo():
                     ok = False
                 record["auto_result"] = {
                     "mode": result.get("mode"),
+                    "accum_repr": result.get("streaming", {}).get(
+                        "accum_repr"
+                    ),
                     "best_k": result.get("best_k"),
                     "pac_area": result.get("pac_area"),
                     "estimator": result.get("estimator"),
@@ -215,6 +218,15 @@ def main(argv=None) -> int:
         "generated_at": round(time.time(), 3),
         "backend": jax.default_backend(),
         "jax": jax.__version__,
+        # Engine-configuration stamps, so this record and the
+        # mesh-sharded one (benchmarks/estimator_mesh/) are comparable
+        # rows of ONE trajectory: the serve executor runs the wall
+        # demo single-device in the dense pair-path representation
+        # (the estimator's sharding-invariance gate keeps every count
+        # bit-identical across both axes, so these stamps are
+        # provenance, not identity).
+        "mesh": {"h": 1, "n": 1},
+        "accum_repr": "dense",
     }
     ok = True
 

@@ -12,12 +12,30 @@ references the unit suite uses (histogram: bit-exact; Lloyd sums:
 f32-reduction-order tolerance, counts exact; popcount co-occurrence:
 bit-exact vs the lax path).
 
-Run on TPU:  python benchmarks/tpu_kernel_check.py
+Run on TPU:  python benchmarks/tpu_kernel_check.py --json VERDICT.json
 Exit code 0 = kernels proven on this backend; 1 = mismatch or crash.
+
+``--json`` writes a machine-readable verdict record — per-lane
+``pallas | lax | fail`` plus the first failure's error class — so the
+next healthy TPU window captures the pending Mosaic coassoc verdict in
+ONE command with no human transcription (the record is the thing the
+ROADMAP item-1 remainder asks for; commit it next to the BENCH round it
+was taken in).  Lane verdicts:
+
+- ``pallas`` — the compiled kernel ran and matched the reference.
+- ``lax``    — the probe gate reports the kernel unavailable on this
+  backend (or the backend is CPU, where only interpret mode exists):
+  jobs degrade to the lax path, disclosed, not an error.
+- ``fail``   — compile/execute crashed or mismatched the reference;
+  ``error_class`` carries the exception type (e.g. the Mosaic
+  lowering class), ``error`` the first message.
 """
 
+import argparse
+import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -37,7 +55,53 @@ sys.path.insert(
 from oracle import oracle_block_hist_counts as _numpy_counts  # noqa: E402
 
 
-def _check_lloyd(rng) -> int:
+def _lane_record(cases: int, failures: int, first_error) -> dict:
+    """One lane's verdict block for the machine-readable record."""
+    return {
+        "verdict": "fail" if failures else "pallas",
+        "cases": int(cases),
+        "failures": int(failures),
+        "error_class": (
+            type(first_error).__name__ if first_error is not None else None
+        ),
+        "error": str(first_error) if first_error is not None else None,
+    }
+
+
+def _check_hist(rng):
+    cases = [
+        ((29, 29), 29, 0),        # bundled corr.csv size, sub-tile
+        ((300, 300), 300, 0),     # multi-tile, ragged edges
+        ((40, 130), 119, 80),     # row block with offset + layout padding
+        ((256, 512), 500, 128),   # tile-aligned block of a sharded matrix
+        ((1024, 1024), 1000, 0),  # larger multi-tile grid
+    ]
+    failures = 0
+    first_error = None
+    for shape, n_valid, off in cases:
+        cij = rng.random(shape).astype(np.float32)
+        try:
+            got = np.asarray(
+                consensus_hist_counts(
+                    jnp.asarray(cij), n_valid, off, 20, use_pallas=True
+                )
+            )
+        except Exception as exc:  # noqa: BLE001 — report, keep checking
+            print(f"FAIL {shape} off={off}: {type(exc).__name__}: {exc}")
+            failures += 1
+            first_error = first_error or exc
+            continue
+        want = _numpy_counts(cij, n_valid, off, 20)
+        if (got == want).all():
+            print(f"ok   {shape} n_valid={n_valid} off={off} "
+                  f"sum={got.sum()}")
+        else:
+            print(f"FAIL {shape}: got {got} want {want}")
+            failures += 1
+    return failures, _lane_record(len(cases), failures, first_error)
+
+
+def _check_lloyd(rng):
     from consensus_clustering_tpu.ops.pallas_lloyd import (
         lloyd_step, pad_points,
     )
@@ -47,9 +111,11 @@ def _check_lloyd(rng) -> int:
     from oracle import oracle_lloyd_step as _numpy_lloyd
 
     failures = 0
-    for n, d, k_max, k in [
+    first_error = None
+    cases = [
         (700, 7, 8, 5), (4000, 50, 20, 20), (40, 3, 6, 2), (5, 3, 8, 2),
-    ]:
+    ]
+    for n, d, k_max, k in cases:
         x = rng.normal(size=(n, d)).astype(np.float32)
         c = rng.normal(size=(k_max, d)).astype(np.float32)
         try:
@@ -62,6 +128,7 @@ def _check_lloyd(rng) -> int:
         except Exception as exc:  # noqa: BLE001 — report, keep checking
             print(f"FAIL lloyd n={n} d={d}: {type(exc).__name__}: {exc}")
             failures += 1
+            first_error = first_error or exc
             continue
         _, ref_sums, ref_counts, ref_far = _numpy_lloyd(x, c, k, k_max)
         ok = (
@@ -74,10 +141,10 @@ def _check_lloyd(rng) -> int:
         else:
             print(f"FAIL lloyd n={n} d={d}: sums/counts/far mismatch")
             failures += 1
-    return failures
+    return failures, _lane_record(len(cases), failures, first_error)
 
 
-def _check_coassoc(rng) -> int:
+def _check_coassoc(rng):
     """Compiled-mode verdict on the fused popcount co-occurrence kernel
     (ops/pallas_coassoc.py) — the BENCH_r01 Mosaic-lowering bug class is
     exactly what this lane exists to catch before a bench round does.
@@ -92,6 +159,8 @@ def _check_coassoc(rng) -> int:
     )
 
     failures = 0
+    first_error = None
+    degraded = None
     cases = [
         (1, 8, 32),        # single word, sub-tile
         (13, 264, 300),    # the probe's ragged multi-tile grid
@@ -116,58 +185,109 @@ def _check_coassoc(rng) -> int:
                 jnp.asarray(rows), jnp.asarray(cols), use_kernel=True
             ))
         except Exception as exc:  # noqa: BLE001 — report, keep checking
+            gate = packed_kernel_available()
+            if not gate:
+                # The probe gate already reports the kernel
+                # unavailable here: production jobs run the lax path,
+                # disclosed as packed_kernel=lax — a documented
+                # DEGRADE, not a harness failure (the 'lax' lane
+                # verdict; exit stays 0 so the scripted one-command
+                # capture records it instead of aborting).
+                print(f"lax  coassoc L={l_words} {r}x{c}: "
+                      f"{type(exc).__name__}: {exc}")
+                print("     (probe gate verdict: "
+                      "packed_kernel_available()=False — jobs degrade "
+                      "to the lax popcount path, disclosed as "
+                      "packed_kernel=lax)")
+                degraded = degraded or exc
+                break
             print(f"FAIL coassoc L={l_words} {r}x{c}: "
                   f"{type(exc).__name__}: {exc}")
-            print(f"     (probe gate verdict: packed_kernel_available()"
-                  f"={packed_kernel_available()} — jobs degrade to the "
-                  "lax popcount path, disclosed as packed_kernel=lax)")
+            print(f"     (probe gate says the kernel IS available "
+                  f"(packed_kernel_available()={gate}) yet the "
+                  "compiled call failed — a real verdict failure)")
             failures += 1
+            first_error = first_error or exc
             continue
         if (got == want).all():
             print(f"ok   coassoc L={l_words} {r}x{c} sum={got.sum()}")
         else:
             print(f"FAIL coassoc L={l_words} {r}x{c}: kernel != lax")
             failures += 1
-    return failures
+    record = _lane_record(len(cases), failures, first_error)
+    # The probe gate's verdict rides the record: a failing compiled
+    # kernel means production jobs run the lax path — the degrade the
+    # operator needs to see next to the failure class.
+    record["probe_gate"] = bool(packed_kernel_available())
+    if failures:
+        record["degrade"] = "lax"
+    elif degraded is not None:
+        # Gate-off crash: the documented degrade verdict, with the
+        # lowering error's class preserved for the record.
+        record["verdict"] = "lax"
+        record["error_class"] = type(degraded).__name__
+        record["error"] = str(degraded)
+    return failures, record
 
 
-def main() -> int:
+def _write_verdict(path, record) -> None:
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"verdict written: {path}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="compiled Pallas kernel verdict on the active backend"
+    )
+    parser.add_argument(
+        "--json", default=None, metavar="VERDICT.json",
+        help="write the machine-readable per-lane verdict record here "
+        "(pallas|lax|fail + error class — the one-command capture for "
+        "the next healthy TPU window)",
+    )
+    args = parser.parse_args(argv)
+
     backend = jax.default_backend()
+    record = {
+        "harness": "benchmarks/tpu_kernel_check.py",
+        "generated_at": round(time.time(), 3),
+        "backend": backend,
+        "jax": jax.__version__,
+        "lanes": {},
+        "failures": 0,
+        "passed": True,
+    }
     if backend == "cpu":
         print("kernel_check: CPU backend — compiled Pallas path not "
               "applicable (unit suite covers interpret mode)")
+        # Jobs on this backend run the lax paths behind the probe
+        # gates: the honest lane verdict is the degrade, not a pass.
+        for lane in ("hist", "lloyd", "coassoc"):
+            record["lanes"][lane] = {
+                "verdict": "lax", "cases": 0, "failures": 0,
+                "error_class": None,
+                "error": "cpu backend: compiled Pallas not applicable",
+            }
+        if args.json:
+            _write_verdict(args.json, record)
         return 0
     rng = np.random.default_rng(0)
-    cases = [
-        ((29, 29), 29, 0),        # bundled corr.csv size, sub-tile
-        ((300, 300), 300, 0),     # multi-tile, ragged edges
-        ((40, 130), 119, 80),     # row block with offset + layout padding
-        ((256, 512), 500, 128),   # tile-aligned block of a sharded matrix
-        ((1024, 1024), 1000, 0),  # larger multi-tile grid
-    ]
     failures = 0
-    for shape, n_valid, off in cases:
-        cij = rng.random(shape).astype(np.float32)
-        try:
-            got = np.asarray(
-                consensus_hist_counts(
-                    jnp.asarray(cij), n_valid, off, 20, use_pallas=True
-                )
-            )
-        except Exception as exc:  # noqa: BLE001 — report, keep checking
-            print(f"FAIL {shape} off={off}: {type(exc).__name__}: {exc}")
-            failures += 1
-            continue
-        want = _numpy_counts(cij, n_valid, off, 20)
-        if (got == want).all():
-            print(f"ok   {shape} n_valid={n_valid} off={off} "
-                  f"sum={got.sum()}")
-        else:
-            print(f"FAIL {shape}: got {got} want {want}")
-            failures += 1
-    failures += _check_lloyd(rng)
-    failures += _check_coassoc(rng)
+    for lane, check in (
+        ("hist", _check_hist),
+        ("lloyd", _check_lloyd),
+        ("coassoc", _check_coassoc),
+    ):
+        lane_failures, lane_record = check(rng)
+        failures += lane_failures
+        record["lanes"][lane] = lane_record
+    record["failures"] = failures
+    record["passed"] = failures == 0
     print(f"kernel_check: backend={backend} failures={failures}")
+    if args.json:
+        _write_verdict(args.json, record)
     return 1 if failures else 0
 
 

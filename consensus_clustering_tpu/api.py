@@ -887,6 +887,10 @@ class ConsensusClustering:
             return "store_matrices=True (the estimator never builds them)"
         if self.compute_consensus_labels:
             return "compute_consensus_labels needs the matrices"
+        if self.mesh is not None and dict(self.mesh.shape).get("k", 1) != 1:
+            # The pair engine refuses a 'k'-sharded mesh (its per-K
+            # state is M-sized; lanes shard over ('h', 'n') only).
+            return "k-sharded mesh (the estimator shards over ('h', 'n'))"
         _c = self.clusterer
         if isinstance(_c, HostClusterer) or (
             _c is not None
@@ -1004,6 +1008,10 @@ class ConsensusClustering:
             adaptive_min_h=self.adaptive_min_h,
             integrity_check_every=self.integrity_check_every,
             use_pallas=self.use_pallas,
+            # Packed pair path: the block step carries per-cluster
+            # bit-plane masks instead of the (h_block, N) label
+            # scatter — counts bit-identical (ops/bitpack exactness).
+            accum_repr=self.accum_repr,
             dtype=self.compute_dtype,
         )
         from consensus_clustering_tpu.utils.metrics import MetricsLogger
@@ -1036,6 +1044,10 @@ class ConsensusClustering:
             out = run_pair_estimate(
                 clusterer, config, X, self.random_state,
                 n_pairs=self.n_pairs,
+                # The same ('h', 'n') mesh the dense engines take:
+                # estimate-mode lanes shard with bit-identical output
+                # (the estimator sharding-invariance gate).
+                mesh=self.mesh,
                 block_callback=block_cb,
                 checkpointer=stream_ckpt,
             )

@@ -1,4 +1,5 @@
-"""Sampled-pair streaming consensus engine: O(M) state, any N.
+"""Sampled-pair streaming consensus engine: O(M) state, any N,
+mesh-sharded clustering lanes.
 
 The dense engines (:mod:`~consensus_clustering_tpu.parallel.sweep`,
 :mod:`~consensus_clustering_tpu.parallel.streaming`) accumulate the
@@ -27,26 +28,70 @@ engine but accumulates counts for only ``M`` sampled pairs
 - **O(M) state.**  ``state = {"mij": (nK, M) int32, "iij": (M,)
   int32}`` — about a megabyte per K at the default M, where the dense
   state is 40 GB per K at N = 10^5.  Per block the engine materialises
-  one (h_block, N) label scatter per K (megabytes), never anything
-  N×N — enforced by the ``estimator`` lint rule pack (JL009).
+  one (h_block, N) label scatter per K (megabytes) in dense
+  representation — or ~1/32 of that in packed representation (below)
+  — never anything N×N, enforced by the ``estimator`` lint rule pack
+  (JL009).
+- **Mesh-sharded lanes** (ROADMAP item 2's remainder).  The block step
+  runs under ``shard_map`` over the same ``('h', 'n')`` mesh the dense
+  engines use: resample lanes split over ALL mesh devices (the
+  clustering FLOPs — the estimator's actual wall once memory is O(M) —
+  divide by the device count, same ``h_global`` derivation as the
+  dense engines so every draw stays bit-identical), the ``M`` pair
+  slots shard over ``'n'`` for the gather/compare step (each device
+  gathers and compares only its M/n_r slots against its h-group's
+  label scatter), and the int32 per-pair partial counts ``psum`` over
+  ``'h'``.  Integer sums are order-independent, so the merged counts —
+  and therefore the curves, the PAC bound, ``result_fingerprint`` and
+  every checkpoint frame — are BIT-IDENTICAL across mesh shapes (the
+  sharding-invariance family tests/test_estimator.py pins, the
+  estimator twin of test_sweep's dense families).  Pair slots pad up
+  to a multiple of ``n_r`` (padded slots accumulate a deterministic
+  throwaway pair and are masked out of every curve); resample rows pad
+  to a multiple of the device count exactly as the dense block does.
+  The ``'k'`` axis is NOT taken (a k-sharded mesh is refused): the
+  whole per-K state is M-sized, so the 'k' axis would shard a megabyte
+  while complicating the psum topology — lanes are the FLOPs, and
+  lanes shard over ('h', 'n').
+- **Packed pair path** (``accum_repr="packed"``, ROADMAP item 1
+  pairing).  In packed mode the per-K block step never builds the
+  (h_block, N) int32 label scatter: it packs each cluster's membership
+  into a uint32 bit-plane — resamples 32-per-word along the word axis,
+  one (ceil(h_block/32), N) plane at a time via the shared
+  :func:`~consensus_clustering_tpu.ops.bitpack.pack_label_planes` —
+  and each sampled pair's ``mij`` increment becomes a two-word mask
+  AND + popcount (``popcount(plane[:, i] & plane[:, j])`` summed over
+  words and cluster planes; ``iij`` the same on the co-sampling plane
+  via :func:`~consensus_clustering_tpu.ops.bitpack.
+  pack_cosample_planes`).  Popcount sums are exact integers and the
+  packers drop exactly the entries the dense scatter drops, so packed
+  counts equal dense counts bit for bit (the ops/bitpack exactness
+  contract) — and the per-block N-proportional temp shrinks ~32×: one
+  live (ceil(h_block/32), N) uint32 plane instead of an (h_block, N)
+  int32 scatter (``benchmarks/estimator_mesh.py`` measures the
+  reduction in the compiled-plan bytes).
 - **Same driver contract.**  ``run()`` mirrors
   :meth:`~consensus_clustering_tpu.parallel.streaming.StreamingSweep.
   run`: H-agnostic block program (``h_start``/``h_total`` traced),
-  double-buffer-free simple loop (the state is tiny; there is no HBM
-  round-trip to hide), adaptive early stop on the PAC trajectory,
-  block callbacks, tracer spans, the ``accumulator`` corruption fault
-  point, an O(M) integrity sentinel, and block checkpointing through
-  the same :class:`~consensus_clustering_tpu.resilience.blocks.
+  simple non-donating loop (the state is tiny; there is no HBM
+  round-trip to hide), adaptive early stop, block callbacks, tracer
+  spans, the ``accumulator`` corruption fault point, an O(M) integrity
+  sentinel, and block checkpointing through the same
+  :class:`~consensus_clustering_tpu.resilience.blocks.
   StreamCheckpointer` ring — digest-verified resume included
   (:func:`verify_pair_state_frame`), under its own fingerprint scheme
   (:func:`~consensus_clustering_tpu.utils.checkpoint.
-  estimator_stream_fingerprint`) so estimator state can never resume a
-  dense sweep or vice versa.
-
-Mesh note: the engine runs single-device by design in this PR — the
-wall it removes is MEMORY, not FLOPs, and the clustering lanes (the
-FLOPs) already have their sharded home in the dense engines.  Sharding
-the lane work here pairs with ROADMAP item 1's packed masks.
+  estimator_stream_fingerprint`).  Frames store the CROPPED (nK, M)
+  counts — never the mesh-padded layout — so a frame written under one
+  mesh shape resumes BIT-IDENTICALLY under any other mesh with the
+  SAME padded block size (the fingerprint knows nothing of the mesh;
+  every factorisation of a device count that divides the block shares
+  the grid).  A mesh that pads ``stream_h_block`` differently writes
+  blocks on a different resample grid — resuming across grids would
+  skip or double-count rows, so a non-terminal frame from another grid
+  is REFUSED with a clear error (the pinned contract: bit-identical or
+  loudly refused, never silently wrong; terminal frames replay with
+  zero device work and resume anywhere).
 """
 
 from __future__ import annotations
@@ -58,6 +103,7 @@ from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 if TYPE_CHECKING:  # import cycle guard (resilience never imports us)
     from consensus_clustering_tpu.resilience.blocks import StreamCheckpointer
@@ -71,11 +117,24 @@ from consensus_clustering_tpu.estimator.bounds import (
 from consensus_clustering_tpu.estimator.sampler import pair_key, sample_pairs
 from consensus_clustering_tpu.models.protocol import JaxClusterer
 from consensus_clustering_tpu.ops.analysis import masked_histogram_counts
+from consensus_clustering_tpu.ops.bitpack import (
+    pack_cosample_planes,
+    pack_label_planes,
+    packed_width,
+)
 from consensus_clustering_tpu.ops.resample import resample_indices
+from consensus_clustering_tpu.parallel.mesh import (
+    KSHARD_AXIS,
+    RESAMPLE_AXIS,
+    ROW_AXIS,
+    resample_mesh,
+)
 from consensus_clustering_tpu.parallel.sweep import (
     compiled_memory_stats,
     fit_resample_lanes,
     resample_lane_keys,
+    shard_map,
+    sweep_geometry,
 )
 from consensus_clustering_tpu.resilience.faults import IntegrityError, faults
 from consensus_clustering_tpu.resilience.integrity import (
@@ -101,7 +160,8 @@ def verify_pair_state_frame(
     the decoded state), shaped for (nK, M)/(M,) pair counts instead of
     matrices: ``0 <= mij <= iij <= h_done`` elementwise.  No diagonal
     or symmetry clause — pairs are strictly upper-triangle, so neither
-    exists here.
+    exists here.  Frames carry the mesh-independent CROPPED counts, so
+    the verifier needs no mesh geometry either.
     """
     recorded = header.get("digest")
     if recorded is not None:
@@ -174,10 +234,13 @@ def estimate_curves_from_pair_counts(
 class PairConsensusEngine:
     """One compiled pair-count block step plus its host driver.
 
-    Build once per (shape, config-minus-H, n_pairs) bucket and call
-    :meth:`run` for any ``n_iterations`` — the block program is
+    Build once per (shape, mesh, config-minus-H, n_pairs) bucket and
+    call :meth:`run` for any ``n_iterations`` — the block program is
     H-agnostic exactly like the dense streaming engine's, so the serve
     executor caches warm instances under the same bucket discipline.
+    ``mesh`` defaults to single-device; a multi-device ('h', 'n') mesh
+    shards the clustering lanes and pair slots (module docstring) with
+    bit-identical outputs.
     """
 
     def __init__(
@@ -185,6 +248,7 @@ class PairConsensusEngine:
         clusterer: JaxClusterer,
         config: SweepConfig,
         n_pairs: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
     ):
         if config.stream_h_block is None:
             raise ValueError(
@@ -196,6 +260,17 @@ class PairConsensusEngine:
                 "the pair estimator never materialises matrices; pass "
                 "store_matrices=False (it has nothing N×N to store)"
             )
+        if mesh is None:
+            mesh = resample_mesh([jax.devices()[0]])
+        if dict(mesh.shape).get(KSHARD_AXIS, 1) != 1:
+            raise ValueError(
+                "the pair estimator shards its lane work over the "
+                "('h', 'n') mesh axes only — the per-K state is M-sized "
+                "(a megabyte), so a 'k' axis would shard nothing that "
+                "matters; build the mesh with k_shards=1 and give the "
+                "devices to 'h'/'n'"
+            )
+        self.mesh = mesh
         self.config = config
         self.clusterer = clusterer
         n = config.n_samples
@@ -207,89 +282,254 @@ class PairConsensusEngine:
         )
         if self.n_pairs < 1:
             raise ValueError(f"n_pairs must be >= 1, got {self.n_pairs}")
-        self._hb = int(config.stream_h_block)
+        # Resample-row geometry from the helper SHARED with the dense
+        # engines (SweepGeometry): resamples split over ALL (h × n)
+        # devices with the same padding rule and the same h_global
+        # derivation, which is what keeps every draw — and therefore
+        # every sampled pair's count — bit-identical to the dense
+        # engines AND across mesh shapes.
+        geo = sweep_geometry(config, mesh, config.stream_h_block)
+        self._n_h, self._n_r = geo.n_h, geo.n_r
+        n_r = geo.n_r
+        local_hb = geo.local_h
+        hb_pad = geo.h_pad
+        self._hb_pad = hb_pad
         self._n_ks = len(config.k_values)
         self._k_arr = jnp.asarray(config.k_values, jnp.int32)
         m = self.n_pairs
-        hb = self._hb
+        # Pair-slot sharding over 'n': each device owns m_local slots.
+        # Padded slots (global slot >= M) gather the throwaway pair
+        # (0, 0) — deterministic given the seed, excluded from every
+        # histogram/curve by the slot mask, and CROPPED out of frames
+        # and return_state, so no disclosed artifact depends on n_r.
+        self._m_local = -(-m // n_r)
+        self._m_pad = self._m_local * n_r
+        m_local = self._m_local
+        group_hb = n_r * local_hb
+        self._accum_repr = config.accum_repr
+        packed = self._accum_repr == "packed"
+        # Packed pair path: the h-group's membership bits pack
+        # 32-per-word along the resample axis, so the only live
+        # N-proportional temp is one (wb_group, N) uint32 plane —
+        # ~1/32 the (group_hb, N) int32 scatter's bytes.
+        wb_group = packed_width(group_hb)
 
-        def step(state, x, pair_i, pair_j, key, h_start, h_total):
-            """One H-block over the sampled pairs.
+        mij_spec = P(None, ROW_AXIS)
+        iij_spec = P(ROW_AXIS)
+        pair_spec = P(ROW_AXIS)
+        self._state_shardings = {
+            "mij": NamedSharding(mesh, mij_spec),
+            "iij": NamedSharding(mesh, iij_spec),
+        }
+        self._pair_sharding = NamedSharding(mesh, pair_spec)
+        self._state_shapes = {
+            "mij": ((self._n_ks, self._m_pad), jnp.int32),
+            "iij": ((self._m_pad,), jnp.int32),
+        }
 
-            Resample draw, masking and label derivation are IDENTICAL
-            to the dense streaming engine's (shared helpers, global
-            resample indices), so the pair counts this accumulates are
-            the dense matrix entries at (pair_i, pair_j) — bit-exact.
-            Returns the new state plus per-K (bins,) histogram counts
-            of the M accumulated pair consensus values.
+        def local_step(
+            mij_blk, iij_blk, x, pair_i_blk, pair_j_blk,
+            key_resample, key_cluster, h_start, h_total,
+        ):
+            """Per-device block step.
+
+            ``mij_blk``/``iij_blk``: this device's (nK, m_local)/
+            (m_local,) pair-count slots.  ``pair_i_blk``/``pair_j_blk``:
+            its slice of the (padded) sampled pairs.  The block's
+            resample rows are drawn replicated (the dense engines'
+            rule), each device clusters its local_hb lanes, the
+            h-group's labels ride a cheap all_gather over 'n' (an
+            (group_hb, n_sub) int array — the pair gathers need the
+            whole group's scatter), partial per-pair counts psum over
+            'h', and each K's histogram counts psum over 'n'.  Every
+            merged quantity is an integer sum, so the merge order —
+            and therefore the mesh shape — cannot change any count.
             """
-            x = x.astype(jnp.dtype(config.dtype))
-            key_resample, key_cluster = jax.random.split(key)
-            block_rows = h_start + jnp.arange(hb, dtype=jnp.int32)
-            h_valid = block_rows < h_total
-            indices = resample_indices(
-                key_resample, n, hb, n_sub, h_start=h_start
+            h_idx = jax.lax.axis_index(RESAMPLE_AXIS)
+            r_idx = jax.lax.axis_index(ROW_AXIS)
+            h_global = h_start + (
+                (h_idx * n_r + r_idx) * local_hb
+                + jnp.arange(local_hb, dtype=jnp.int32)
             )
-            indices = jnp.where(h_valid[:, None], indices, -1)
-            rows = jnp.arange(hb, dtype=jnp.int32)[:, None]
+            h_valid = h_global < h_total
+            # This device's pair slots' GLOBAL positions: padding mask
+            # for the histogram (padded slots carry real-but-unwanted
+            # (0, 0) counts).
+            slot_valid = (
+                r_idx * m_local + jnp.arange(m_local, dtype=jnp.int32)
+            ) < m
+
+            indices_full = resample_indices(
+                key_resample, n, hb_pad, n_sub, h_start=h_start
+            )
+            block_rows = h_start + jnp.arange(hb_pad, dtype=jnp.int32)
+            indices_full = jnp.where(
+                (block_rows < h_total)[:, None], indices_full, -1
+            )
+            indices = jax.lax.dynamic_slice(
+                indices_full,
+                (
+                    jnp.asarray(
+                        (h_idx * n_r + r_idx) * local_hb, jnp.int32
+                    ),
+                    jnp.asarray(0, jnp.int32),
+                ),
+                (local_hb, n_sub),
+            )
+            # The whole h-group's resample rows: the pair gathers below
+            # compare against every resample this group contributed.
+            indices_group = jax.lax.dynamic_slice(
+                indices_full,
+                (
+                    jnp.asarray(h_idx * n_r * local_hb, jnp.int32),
+                    jnp.asarray(0, jnp.int32),
+                ),
+                (group_hb, n_sub),
+            )
+            rows_g = jnp.arange(group_hb, dtype=jnp.int32)[:, None]
             # Padding sentinels (-1) redirect to the out-of-bounds
             # column n, which mode="drop" discards — the
-            # indicator_matrix rule.
-            safe_idx = jnp.where(indices >= 0, indices, n)
-            samp = (
-                jnp.zeros((hb, n), jnp.int32)
-                .at[rows, safe_idx]
-                .set(1, mode="drop")
-            )
-            cos = samp[:, pair_i] * samp[:, pair_j]  # (hb, M)
-            iij = state["iij"] + jnp.sum(cos, axis=0, dtype=jnp.int32)
+            # indicator_matrix rule (the packers apply it themselves).
+            safe_idx_g = jnp.where(indices_group >= 0, indices_group, n)
+
+            if packed:
+                coplane = pack_cosample_planes(
+                    indices_group, n, n_words=wb_group
+                )
+                iij_inc = jnp.sum(
+                    jax.lax.population_count(
+                        coplane[:, pair_i_blk] & coplane[:, pair_j_blk]
+                    ).astype(jnp.int32),
+                    axis=0,
+                )
+            else:
+                samp = (
+                    jnp.zeros((group_hb, n), jnp.int32)
+                    .at[rows_g, safe_idx_g]
+                    .set(1, mode="drop")
+                )
+                iij_inc = jnp.sum(
+                    samp[:, pair_i_blk] * samp[:, pair_j_blk],
+                    axis=0, dtype=jnp.int32,
+                )
+            iij_new = iij_blk + jax.lax.psum(iij_inc, RESAMPLE_AXIS)
+
             x_sub = x[jnp.where(indices >= 0, indices, 0)]
 
             def per_k(_, scanned):
                 k, mij_acc = scanned
                 keys = resample_lane_keys(
-                    config, key_cluster, k, block_rows
+                    config, key_cluster, k, h_global
                 )
                 labels = fit_resample_lanes(
                     clusterer, config, keys, x_sub, k, k_max
                 )
                 labels = jnp.where(h_valid[:, None], labels, -1)
-                # label+1 scatter: 0 = not sampled, >= 1 = cluster id.
-                labmat = (
-                    jnp.zeros((hb, n), jnp.int32)
-                    .at[rows, safe_idx]
-                    .set(labels + 1, mode="drop")
+                labels_group = jax.lax.all_gather(
+                    labels, ROW_AXIS, tiled=True, axis=0
                 )
-                li = labmat[:, pair_i]
-                lj = labmat[:, pair_j]
-                co = ((li > 0) & (li == lj)).astype(jnp.int32)
-                mij_new = mij_acc + jnp.sum(co, axis=0, dtype=jnp.int32)
+                if packed:
+                    # Two-word mask AND + popcount per sampled pair:
+                    # one (wb_group, N) uint32 cluster plane live at a
+                    # time (the fori serialises clusters), built by the
+                    # shared packer so the packed counts equal the
+                    # dense scatter's bit for bit (ops/bitpack's
+                    # exactness contract).
+                    def cluster_step(c, acc):
+                        lab_c = jnp.where(labels_group == c, 0, -1)
+                        plane = pack_label_planes(
+                            lab_c, indices_group, 1, n,
+                            n_words=wb_group,
+                        )[0]
+                        anded = (
+                            plane[:, pair_i_blk] & plane[:, pair_j_blk]
+                        )
+                        return acc + jnp.sum(
+                            jax.lax.population_count(anded).astype(
+                                jnp.int32
+                            ),
+                            axis=0,
+                        )
+
+                    co_inc = jax.lax.fori_loop(
+                        0, k_max, cluster_step,
+                        jnp.zeros((m_local,), jnp.int32),
+                    )
+                else:
+                    # label+1 scatter: 0 = not sampled, >= 1 = cluster.
+                    labmat = (
+                        jnp.zeros((group_hb, n), jnp.int32)
+                        .at[rows_g, safe_idx_g]
+                        .set(labels_group + 1, mode="drop")
+                    )
+                    li = labmat[:, pair_i_blk]
+                    lj = labmat[:, pair_j_blk]
+                    co_inc = jnp.sum(
+                        ((li > 0) & (li == lj)).astype(jnp.int32),
+                        axis=0,
+                    )
+                mij_new = mij_acc + jax.lax.psum(
+                    co_inc, RESAMPLE_AXIS
+                )
                 # Consensus at the sampled pairs — the dense
                 # consensus_matrix arithmetic verbatim (f32 divide,
                 # 1e-6 regulariser; no diagonal clause: pairs are
-                # strictly i < j).
+                # strictly i < j).  Elementwise, so sharding cannot
+                # perturb it; the histogram counts are ints, so the
+                # 'n' psum cannot either.
                 cons = mij_new.astype(jnp.float32) / (
-                    iij.astype(jnp.float32) + 1e-6
+                    iij_new.astype(jnp.float32) + 1e-6
                 )
                 counts = masked_histogram_counts(
-                    cons[None, :],
-                    jnp.ones((1, m), dtype=bool),
-                    config.bins,
+                    cons[None, :], slot_valid[None, :], config.bins
                 )
-                return 0, {"mij": mij_new, "counts": counts}
+                return 0, {
+                    "mij": mij_new,
+                    "counts": jax.lax.psum(counts, ROW_AXIS),
+                }
 
-            _, out = jax.lax.scan(per_k, 0, (self._k_arr, state["mij"]))
-            return {"mij": out["mij"], "iij": iij}, out["counts"]
+            _, out = jax.lax.scan(per_k, 0, (self._k_arr, mij_blk))
+            return out["mij"], iij_new, out["counts"]
 
-        self._step = jax.jit(step)
+        sharded_step = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(
+                mij_spec, iij_spec, P(), pair_spec, pair_spec,
+                P(), P(), P(), P(),
+            ),
+            out_specs=(mij_spec, iij_spec, P()),
+            check_vma=False,
+        )
+
+        def step(state, x, pair_i, pair_j, key, h_start, h_total):
+            x = x.astype(jnp.dtype(config.dtype))
+            key_resample, key_cluster = jax.random.split(key)
+            mij, iij, counts = sharded_step(
+                state["mij"], state["iij"], x, pair_i, pair_j,
+                key_resample, key_cluster, h_start, h_total,
+            )
+            return {"mij": mij, "iij": iij}, counts
+
+        # Output state shardings PINNED to the input ones (the dense
+        # engine's rule): on a trivial mesh GSPMD normalises an
+        # output's spec to P(), and the fed-back state would then key
+        # a second (identical) jit cache entry.
+        replicated = NamedSharding(mesh, P())
+        self._step = jax.jit(
+            step,
+            out_shardings=(dict(self._state_shardings), replicated),
+        )
 
         def init_state_fn():
             return {
-                "mij": jnp.zeros((self._n_ks, m), jnp.int32),
-                "iij": jnp.zeros((m,), jnp.int32),
+                name: jnp.zeros(shape, dtype)
+                for name, (shape, dtype) in self._state_shapes.items()
             }
 
-        self._init = jax.jit(init_state_fn)
+        self._init = jax.jit(
+            init_state_fn, out_shardings=dict(self._state_shardings)
+        )
 
         def sample_fn(key):
             return sample_pairs(key, n, m)
@@ -313,17 +553,18 @@ class PairConsensusEngine:
             return dict(self._compiled_memory)
         try:
             cfg = self.config
-            m = self.n_pairs
             state_struct = {
-                "mij": jax.ShapeDtypeStruct(
-                    (self._n_ks, m), jnp.int32
-                ),
-                "iij": jax.ShapeDtypeStruct((m,), jnp.int32),
+                name: jax.ShapeDtypeStruct(
+                    shape, dtype, sharding=self._state_shardings[name]
+                )
+                for name, (shape, dtype) in self._state_shapes.items()
             }
             x_struct = jax.ShapeDtypeStruct(
                 (cfg.n_samples, cfg.n_features), jnp.dtype(cfg.dtype)
             )
-            pair_struct = jax.ShapeDtypeStruct((m,), jnp.int32)
+            pair_struct = jax.ShapeDtypeStruct(
+                (self._m_pad,), jnp.int32, sharding=self._pair_sharding
+            )
             lowered = self._step.lower(
                 state_struct, x_struct, pair_struct, pair_struct,
                 jax.random.PRNGKey(0), jnp.int32(0), jnp.int32(0),
@@ -362,7 +603,9 @@ class PairConsensusEngine:
         mij = np.array(state["mij"])
         flip_array_bits(mij, nbits, seed=block)
         corrupted = dict(state)
-        corrupted["mij"] = jnp.asarray(mij)
+        corrupted["mij"] = jax.device_put(
+            mij, self._state_shardings["mij"]
+        )
         return corrupted
 
     # -- state -----------------------------------------------------------
@@ -372,8 +615,26 @@ class PairConsensusEngine:
 
     def pairs_for_seed(self, seed: int):
         """The (pair_i, pair_j) sample for a run seed — deterministic,
-        device-resident; exposed for the validation harness and tests."""
+        device-resident, UNPADDED (M,); exposed for the validation
+        harness and tests."""
         return self._sample(pair_key(seed))
+
+    def _placed_pairs(self, seed: int):
+        """The mesh-placed (padded) pair arrays the block step takes:
+        the seed's sample padded to m_pad with the throwaway (0, 0)
+        pair and sharded over 'n'.  Host hop is O(M) ints — noise next
+        to a block's lane FLOPs."""
+        pair_i, pair_j = self.pairs_for_seed(seed)
+        pad = self._m_pad - self.n_pairs
+        pi = np.asarray(pair_i)
+        pj = np.asarray(pair_j)
+        if pad:
+            pi = np.concatenate([pi, np.zeros(pad, np.int32)])
+            pj = np.concatenate([pj, np.zeros(pad, np.int32)])
+        return (
+            jax.device_put(pi, self._pair_sharding),
+            jax.device_put(pj, self._pair_sharding),
+        )
 
     def warmup(self, x: Optional[np.ndarray] = None) -> float:
         """Compile the block program (one all-masked block); returns
@@ -385,7 +646,7 @@ class PairConsensusEngine:
             )
         xj = jnp.asarray(x, jnp.dtype(cfg.dtype))
         t0 = time.perf_counter()
-        pair_i, pair_j = self.pairs_for_seed(0)
+        pair_i, pair_j = self._placed_pairs(0)
         state = self.init_state()
         state, counts = self._step(
             state, xj, pair_i, pair_j, jax.random.PRNGKey(0),
@@ -426,10 +687,14 @@ class PairConsensusEngine:
         granularity under the estimator's own fingerprint scheme (same
         (config, seed, data, H, knobs, n_pairs) resumes bit-identically
         — the pair sample is a pure function of the seed, so it needs
-        no checkpointing of its own); ``integrity_check_every`` runs
-        the O(M) pair-count sentinel (collapsing to every-block under
-        adaptive early stop, the dense engine's rule, because any block
-        can become the answer).
+        no checkpointing of its own; frames carry the mesh-independent
+        cropped counts, so the writing and resuming mesh shapes are
+        free to differ AS LONG AS they pad ``stream_h_block`` to the
+        same block grid — a non-terminal frame from a different grid
+        is refused with a clear error, see the module docstring);
+        ``integrity_check_every`` runs the O(M) pair-count sentinel
+        (collapsing to every-block under adaptive early stop, the
+        dense engine's rule, because any block can become the answer).
         """
         if n_iterations < 1:
             raise ValueError(
@@ -453,11 +718,12 @@ class PairConsensusEngine:
         adaptive = adaptive_tol is not None
         lo, hi = config.pac_idx
         n = config.n_samples
+        m = self.n_pairs
         xj = jnp.asarray(x, jnp.dtype(config.dtype))
         key = jax.random.PRNGKey(seed)
-        pair_i, pair_j = self.pairs_for_seed(seed)
+        pair_i, pair_j = self._placed_pairs(seed)
         h_total = jnp.int32(n_iterations)
-        n_blocks = -(-n_iterations // self._hb)
+        n_blocks = -(-n_iterations // self._hb_pad)
 
         t0 = time.perf_counter()
         trajectory: List[List[float]] = []
@@ -488,9 +754,23 @@ class PairConsensusEngine:
             )
             if resume is not None:
                 header, arrays = resume
+                # Frames hold the CROPPED (nK, M) counts: re-pad to
+                # this mesh's slot layout (padded slots restart at
+                # zero — they are masked out of every curve, and the
+                # sentinel's invariants hold at zero trivially).
+                mij_pad = np.zeros(
+                    (self._n_ks, self._m_pad), np.int32
+                )
+                mij_pad[:, :m] = np.asarray(arrays["state_mij"])
+                iij_pad = np.zeros((self._m_pad,), np.int32)
+                iij_pad[:m] = np.asarray(arrays["state_iij"])
                 state = {
-                    name: jnp.asarray(arrays[f"state_{name}"])
-                    for name in ("mij", "iij")
+                    "mij": jax.device_put(
+                        mij_pad, self._state_shardings["mij"]
+                    ),
+                    "iij": jax.device_put(
+                        iij_pad, self._state_shardings["iij"]
+                    ),
                 }
                 trajectory = [
                     [float(v) for v in row]
@@ -514,6 +794,29 @@ class PairConsensusEngine:
                 resume_terminal = (
                     stopped_early or h_effective >= n_iterations
                 )
+                if (
+                    not resume_terminal
+                    and h_effective != start_block * self._hb_pad
+                ):
+                    # The frame was written on a DIFFERENT padded
+                    # block grid (a mesh whose device count pads
+                    # stream_h_block differently): resuming it here
+                    # would skip or double-count resample rows — the
+                    # pinned cross-mesh contract is bit-identical
+                    # resume on the SAME padded grid, loud refusal
+                    # otherwise (a terminal frame replays with zero
+                    # device work, so any mesh may read it).
+                    raise ValueError(
+                        f"checkpoint frame h_done={h_effective} "
+                        f"(writer h_block_padded="
+                        f"{header.get('h_block_padded', 'unknown')}) "
+                        f"does not align with this engine's padded "
+                        f"block of {self._hb_pad} (mesh "
+                        f"{self._n_h}x{self._n_r} pads stream_h_block="
+                        f"{config.stream_h_block}); resume on a mesh "
+                        "with the same padded block size, or point "
+                        "the run at a fresh checkpoint ring"
+                    )
                 logger.info(
                     "resuming pair estimator from checkpoint: block %d "
                     "(h_done=%d of %d%s)",
@@ -534,7 +837,7 @@ class PairConsensusEngine:
         last_eval_done = [time.perf_counter()]
 
         def h_done(b: int) -> int:
-            return min((b + 1) * self._hb, n_iterations)
+            return min((b + 1) * self._hb_pad, n_iterations)
 
         def check_due(b: int) -> bool:
             if integrity_check_every <= 0:
@@ -556,7 +859,7 @@ class PairConsensusEngine:
                 block_wall_start = last_eval_done[0]
                 state, counts = self._step(
                     state, xj, pair_i, pair_j, key,
-                    jnp.int32(b * self._hb), h_total,
+                    jnp.int32(b * self._hb_pad), h_total,
                 )
                 nbits = faults.corrupt("accumulator", index=b)
                 if nbits:
@@ -631,10 +934,12 @@ class PairConsensusEngine:
                     b, n_blocks
                 ):
                     arrays = {
-                        # O(M) host copies: no device-residency games
-                        # needed at this state size.
-                        f"state_{name}": np.asarray(v)
-                        for name, v in state.items()
+                        # O(M) host copies, CROPPED to the mesh-
+                        # independent (nK, M) layout: a frame written
+                        # under any mesh shape is byte-identical and
+                        # resumes under any other.
+                        "state_mij": np.asarray(state["mij"])[:, :m],
+                        "state_iij": np.asarray(state["iij"])[:m],
                     }
                     arrays.update(
                         {
@@ -653,6 +958,12 @@ class PairConsensusEngine:
                             ],
                             "quiet": int(quiet),
                             "stopped": bool(stop),
+                            # The writer's padded block grid: equal
+                            # across every mesh shape that pads
+                            # stream_h_block the same way (the frame-
+                            # identity family), and the resume-time
+                            # grid guard's diagnostic when it is not.
+                            "h_block_padded": int(self._hb_pad),
                             "written_at": round(time.time(), 3),
                         },
                         arrays,
@@ -685,11 +996,13 @@ class PairConsensusEngine:
             # plus the pairs they belong to, for gather-and-compare
             # against the dense engine's matrices (estimator/validate.py
             # proves them bit-identical at exact-feasible shapes).
+            # Cropped to (M,): the mesh-padded slots are an internal
+            # layout detail, never a disclosed artifact.
             out["pair_state"] = {
-                "pair_i": np.asarray(pair_i),
-                "pair_j": np.asarray(pair_j),
-                "mij": np.asarray(state["mij"]),
-                "iij": np.asarray(state["iij"]),
+                "pair_i": np.asarray(pair_i)[:m],
+                "pair_j": np.asarray(pair_j)[:m],
+                "mij": np.asarray(state["mij"])[:, :m],
+                "iij": np.asarray(state["iij"])[:m],
             }
         del state
         run_seconds = time.perf_counter() - t0
@@ -700,8 +1013,8 @@ class PairConsensusEngine:
         )
 
         out["streaming"] = {
-            "h_block": int(self._hb),
-            "h_block_padded": int(self._hb),
+            "h_block": int(config.stream_h_block),
+            "h_block_padded": int(self._hb_pad),
             "h_requested": int(n_iterations),
             "h_effective": int(h_effective),
             "n_blocks_run": len(trajectory),
@@ -714,6 +1027,12 @@ class PairConsensusEngine:
             ),
             "integrity_checks": int(integrity_checks),
             "integrity_check_every": int(integrity_check_every),
+            # Which pair-path representation ran the block step —
+            # production metadata, never identity: the packed path's
+            # popcount counts are bit-identical to the dense scatter's
+            # (ops/bitpack exactness), so result_fingerprint and the
+            # checkpoint frames cannot depend on it.
+            "accum_repr": self._accum_repr,
         }
         out["estimator"] = bound_disclosure(
             self.n_pairs, n,
@@ -727,6 +1046,11 @@ class PairConsensusEngine:
             ),
             "device_memory": device_memory_stats(),
             "compiled_memory": dict(self._compiled_memory or {}),
+            # How the lanes were sharded, never what was computed (the
+            # sharding-invariance gate keeps every count identical
+            # across mesh shapes — that is why this lives in timing,
+            # outside the semantic fingerprint's reach).
+            "mesh": {"h": int(self._n_h), "n": int(self._n_r)},
         }
         return out
 
@@ -737,13 +1061,18 @@ def run_pair_estimate(
     x: np.ndarray,
     seed: int,
     n_pairs: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
     block_callback=None,
     checkpointer: Optional["StreamCheckpointer"] = None,
 ) -> Dict[str, Any]:
     """Build, warm and drive a pair estimator; the estimator twin of
     :func:`~consensus_clustering_tpu.parallel.streaming.
-    run_streaming_sweep` (``timing`` gains ``compile_seconds``)."""
-    engine = PairConsensusEngine(clusterer, config, n_pairs=n_pairs)
+    run_streaming_sweep` (``timing`` gains ``compile_seconds``).
+    ``mesh``: an optional ('h', 'n') device mesh — lanes and pair
+    slots shard, outputs stay bit-identical to single-device."""
+    engine = PairConsensusEngine(
+        clusterer, config, n_pairs=n_pairs, mesh=mesh
+    )
     compile_seconds = engine.warmup(x)
     engine.compiled_memory_stats()
     out = engine.run(

@@ -262,6 +262,13 @@ def add_arguments(parser) -> None:
         "(owner, fencing token, expiry) when one exists"
     )
     show.add_argument("job_id")
+    show.add_argument(
+        "--devices", type=int, default=None, metavar="D",
+        help="also render the estimator's per-device mesh-sharded "
+        "footprint for a D-device ('h', 'n') mesh (pure arithmetic — "
+        "the stdlib pin holds; outputs are bit-identical sharded, so "
+        "this is a capacity view, not a result change)",
+    )
     release = sub.add_parser(
         "release",
         help="re-queue a quarantined job (restart counter zeroed; takes "
@@ -333,7 +340,8 @@ def add_arguments(parser) -> None:
 
 
 def _footprints_view(
-    store_dir: str, job_id: str, record: Dict[str, Any]
+    store_dir: str, job_id: str, record: Dict[str, Any],
+    devices: Optional[int] = None,
 ) -> Dict[str, Any]:
     """The three admission footprint models for a stored job — dense
     vs packed vs estimator — rendered (never persisted) into the
@@ -357,6 +365,7 @@ def _footprints_view(
     try:
         from consensus_clustering_tpu.serve.preflight import (
             estimate_estimator_bytes,
+            estimate_estimator_sharded,
             estimate_job_bytes,
             estimate_packed_bytes,
         )
@@ -374,6 +383,20 @@ def _footprints_view(
             h_block=int(h_block),
             subsampling=float(spec.get("subsampling", 0.8)),
         )
+        estimator = estimate_estimator_bytes(
+            n, d, k_values,
+            n_pairs=spec.get("n_pairs"),
+            accum_repr=spec.get("accum_repr", "dense"),
+            **kwargs,
+        )
+        if devices is not None and devices >= 2:
+            # The mesh-sharded per-device view + mesh hint next to the
+            # single-device model: sharding is bit-identical, so a job
+            # too big solo can be read off as "fits over D devices".
+            estimator = dict(estimator)
+            estimator["sharded"] = estimate_estimator_sharded(
+                estimator, devices
+            )
         return {
             "footprints": {
                 "dense": estimate_job_bytes(n, d, k_values, **kwargs),
@@ -382,11 +405,7 @@ def _footprints_view(
                     n_iterations=int(spec.get("n_iterations", 25)),
                     **kwargs,
                 ),
-                "estimator": estimate_estimator_bytes(
-                    n, d, k_values,
-                    n_pairs=spec.get("n_pairs"),
-                    **kwargs,
-                ),
+                "estimator": estimator,
             }
         }
     except Exception:  # noqa: BLE001 — a sizing-model hiccup must not
@@ -420,7 +439,10 @@ def cmd_serve_admin(args) -> int:
         lease = lease_state(args.store_dir, args.job_id)
         if lease is not None:
             out["lease"] = lease
-        out.update(_footprints_view(args.store_dir, args.job_id, record))
+        out.update(_footprints_view(
+            args.store_dir, args.job_id, record,
+            devices=getattr(args, "devices", None),
+        ))
         print(json.dumps(out, indent=1, sort_keys=True, default=float))
         return 0
     if args.admin_cmd == "release":

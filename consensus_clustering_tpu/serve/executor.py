@@ -250,11 +250,15 @@ class JobSpec:
             payload.pop(field)
         if payload["stream_h_block"] is None:
             payload["stream_h_block"] = h_block
-        if self.accum_repr == "packed":
+        if self.accum_repr == "packed" and self.mode != "estimate":
             # The packed plane state is capacity-sized by H at build
-            # time (StreamingSweep's h_cap), so packed jobs cannot ride
-            # the H-agnostic executable: H goes back into the bucket
-            # and jobs differing only in iterations compile separately.
+            # time (StreamingSweep's h_cap), so packed EXACT jobs
+            # cannot ride the H-agnostic executable: H goes back into
+            # the bucket and jobs differing only in iterations compile
+            # separately.  The estimator's packed pair path has no such
+            # cap (its planes are block-sized temps, the O(M) state is
+            # representation-independent), so packed ESTIMATE jobs keep
+            # the H-agnostic bucket.
             payload["n_iterations"] = int(self.n_iterations)
         payload["shape"] = [int(n), int(d)]
         return json.dumps(payload, sort_keys=True)
@@ -1170,6 +1174,7 @@ class SweepExecutor:
                 h_block=int(resolution.value),
                 subsampling=spec.subsampling,
                 checkpoints=checkpointer is not None,
+                accum_repr=spec.accum_repr,
             )
         else:
             estimate = estimate_job_bytes(

@@ -183,6 +183,7 @@ def estimate_estimator_bytes(
     h_block: int = 16,
     subsampling: float = 0.8,
     checkpoints: bool = True,
+    accum_repr: str = "dense",
 ) -> Dict[str, Any]:
     """Estimated device footprint of the SAMPLED-PAIR estimator for the
     same job — the O(M) twin of :func:`estimate_job_bytes`, and the
@@ -196,6 +197,10 @@ def estimate_estimator_bytes(
     the per-block (h_block, M) gather workspace, plus the same data +
     clustering-lane terms as the exact model (the lanes are shared
     code and dominate the estimator's actual footprint at large N).
+    With ``accum_repr="packed"`` the scatter term uses the bit-plane
+    pair path's live planes — ``ceil(h_block/32)`` uint32 words
+    instead of ``h_block`` int32 rows per element, ~1/32 the bytes
+    (``benchmarks/estimator_mesh.py`` measures the real plan).
     Monotonic in N, M, |K| and h_block by construction.
     """
     from consensus_clustering_tpu.estimator.bounds import (
@@ -212,8 +217,15 @@ def estimate_estimator_bytes(
     state = 4 * (nk + 1) * m
     pin = 1 + (_CHECKPOINT_PIN_GENERATIONS if checkpoints else 0)
     pairs = 2 * 4 * m
-    # labmat + sampled-indicator scatters, int32, doubled for XLA temps.
-    scatter = 2 * int(h_block) * n * (4 + 4)
+    if accum_repr == "packed":
+        # One live (ceil(h_block/32), N) uint32 cluster plane + the
+        # co-sampling plane, doubled for XLA temps — the dense scatter
+        # term with the resample axis packed 32 bits to the word.
+        scatter = 2 * -(-int(h_block) // 32) * n * (4 + 4)
+    else:
+        # labmat + sampled-indicator scatters, int32, doubled for XLA
+        # temps.
+        scatter = 2 * int(h_block) * n * (4 + 4)
     # li/lj gathers + the co-membership comparison, per block.
     pair_workspace = 12 * int(h_block) * m
     data = n * d * itemsize
@@ -228,9 +240,66 @@ def estimate_estimator_bytes(
         "data_bytes": int(data),
         "lane_bytes": int(lanes),
         "n_pairs": int(m),
+        "accum_repr": str(accum_repr),
         "total_bytes": int(total),
         "model": "O(M) pair-count state + per-block (h_block, N) "
         "scatters + data + clustering lanes; see serve/preflight.py",
+    }
+
+
+def estimate_estimator_sharded(
+    estimate: Dict[str, Any], devices: int
+) -> Dict[str, Any]:
+    """Per-device footprint of the MESH-SHARDED estimator — pure
+    arithmetic over an :func:`estimate_estimator_bytes` breakdown, so
+    the stdlib-pinned admin path can render it without jax.
+
+    The engine shards lanes over every ('h' × 'n') device and the M
+    pair slots over 'n' (estimator/engine.py); the two pure layouts
+    trade different terms:
+
+    - ``('h': D, 'n': 1)`` — lanes AND the h-group scatter divide by
+      D; the O(M) state replicates.
+    - ``('h': 1, 'n': D)`` — lanes, the O(M) state and the pair
+      workspace divide by D; the scatter stays whole (the h-group is
+      the full block).
+
+    Both are priced (ceil division — conservative) and the smaller
+    per-device total wins; its layout is the returned ``mesh`` hint.
+    Data replicates either way.  Outputs stay BIT-IDENTICAL across
+    layouts (the engine's sharding-invariance gate), so the hint is a
+    pure capacity statement — a client refused solo can read it and
+    resubmit to a pool where the job fits sharded.
+    """
+    d = max(1, int(devices))
+    state = int(estimate["state_bytes"]) * int(
+        estimate["pinned_state_generations"]
+    )
+    pairs = int(estimate["pair_bytes"])
+    scatter = int(estimate["scatter_bytes"])
+    pair_ws = int(estimate["pair_workspace_bytes"])
+    data = int(estimate["data_bytes"])
+    lanes = int(estimate["lane_bytes"])
+    h_major = (
+        state + pairs + pair_ws + data + -(-(lanes + scatter) // d)
+    )
+    n_major = (
+        -(-(state + pairs + pair_ws) // d)
+        + data + -(-lanes // d) + scatter
+    )
+    if n_major <= h_major:
+        mesh = {"h": 1, "n": d}
+        per_device = n_major
+    else:
+        mesh = {"h": d, "n": 1}
+        per_device = h_major
+    return {
+        "devices": d,
+        "mesh": mesh,
+        "per_device_bytes": int(per_device),
+        "model": "estimator/engine.py ('h', 'n') sharding: lanes over "
+        "all devices, pair slots over 'n'; outputs bit-identical to "
+        "single-device",
     }
 
 
@@ -306,6 +375,17 @@ def check_admission(
             "CCTPU_MEMORY_BUDGET) if the model is wrong for your "
             "backend"
         )
+        sharded = estimate.get("sharded")
+        if sharded and sharded.get("fits_budget"):
+            # Refused solo, fits sharded: the estimator's ('h', 'n')
+            # mesh sharding is bit-identical, so this is pure capacity.
+            hint = (
+                f"the job fits mesh-sharded: per-device footprint "
+                f"{sharded['per_device_bytes']} bytes over "
+                f"{sharded['devices']} devices (mesh hint "
+                f"{sharded['mesh']}, outputs bit-identical to "
+                "single-device — see estimate.sharded) — or " + hint
+            )
     elif "tile_workspace_bytes" in estimate:
         # Packed-representation gate: the mask state is O(nK·k·H·N/32)
         # and the workspace O(N) — the dense hint's "N² accumulator"

@@ -329,6 +329,112 @@ def test_estimator_bytes_monotonic_and_o_m():
     assert est["n_pairs"] == default_n_pairs(100_000)
 
 
+def test_estimator_bytes_packed_scatter_term():
+    """accum_repr='packed' prices the bit-plane pair path: the
+    N-proportional scatter term shrinks ~32x (resamples packed 32 to
+    the uint32 word), everything else identical."""
+    from consensus_clustering_tpu.serve.preflight import (
+        estimate_estimator_bytes,
+    )
+
+    dense = estimate_estimator_bytes(
+        100_000, 8, (2, 3), n_pairs=4096, h_block=128
+    )
+    packed = estimate_estimator_bytes(
+        100_000, 8, (2, 3), n_pairs=4096, h_block=128,
+        accum_repr="packed",
+    )
+    assert dense["scatter_bytes"] == 32 * packed["scatter_bytes"]
+    for term in ("state_bytes", "pair_bytes", "pair_workspace_bytes",
+                 "data_bytes", "lane_bytes"):
+        assert dense[term] == packed[term]
+    assert packed["total_bytes"] < dense["total_bytes"]
+    assert packed["accum_repr"] == "packed"
+
+
+def test_estimator_sharded_footprint_model():
+    """The per-device mesh-sharded view: pure arithmetic over the
+    single-device breakdown, layout hint picks the cheaper of the two
+    pure ('h'/'n') layouts, per-device bytes shrink with devices."""
+    from consensus_clustering_tpu.serve.preflight import (
+        estimate_estimator_bytes,
+        estimate_estimator_sharded,
+    )
+
+    est = estimate_estimator_bytes(50_000, 8, (2, 3), n_pairs=2**20)
+    solo = estimate_estimator_sharded(est, 1)
+    assert solo["devices"] == 1
+    assert solo["per_device_bytes"] <= est["total_bytes"]
+    two = estimate_estimator_sharded(est, 2)
+    four = estimate_estimator_sharded(est, 4)
+    assert two["per_device_bytes"] < est["total_bytes"]
+    assert four["per_device_bytes"] < two["per_device_bytes"]
+    assert set(two["mesh"]) == {"h", "n"}
+    assert two["mesh"]["h"] * two["mesh"]["n"] == 2
+    # At a pair-state-dominated shape (M = 2^20) the 'n'-major layout
+    # must win: it is the axis the O(M) state shards over.
+    assert two["mesh"]["n"] == 2
+    # At a scatter/lane-dominated shape (tiny M, huge N·h_block) the
+    # 'h'-major layout wins instead.
+    est_small_m = estimate_estimator_bytes(
+        1_000_000, 8, (2,), n_pairs=16, h_block=128, checkpoints=False
+    )
+    hint = estimate_estimator_sharded(est_small_m, 2)
+    assert hint["mesh"]["h"] == 2
+
+
+def test_check_admission_estimate_mode_sharded_hint():
+    """An estimate-gated 413 whose sharded per-device footprint fits
+    must say so in the hint — 'refused solo, fits sharded'."""
+    from consensus_clustering_tpu.serve.preflight import (
+        PreflightReject,
+        check_admission,
+    )
+
+    estimate = {
+        "total_bytes": 300, "n_pairs": 64,
+        "sharded": {
+            "fits_budget": True, "per_device_bytes": 120,
+            "devices": 4, "mesh": {"h": 1, "n": 4},
+        },
+    }
+    with pytest.raises(PreflightReject) as e:
+        check_admission(estimate, 200, (10, 2))
+    assert "mesh-sharded" in e.value.payload["hint"]
+    # Without a fitting sharded view the hint stays on the knobs.
+    with pytest.raises(PreflightReject) as e:
+        check_admission(
+            {"total_bytes": 300, "n_pairs": 64}, 200, (10, 2)
+        )
+    assert "mesh-sharded" not in e.value.payload["hint"]
+
+
+def test_footprints_view_renders_sharded_estimator(tmp_path):
+    """serve-admin show --devices: the footprints view gains the
+    estimator's per-device sharded block (stdlib arithmetic — the
+    admin import pin is exercised by test_hostile's subprocess)."""
+    import json as _json
+
+    from consensus_clustering_tpu.serve.admin import _footprints_view
+
+    record = {
+        "job_id": "j1", "status": "queued", "shape": [500, 4],
+    }
+    os.makedirs(tmp_path / "payloads")
+    (tmp_path / "payloads" / "j1.json").write_text(_json.dumps({
+        "spec": {"k_values": [2, 3], "n_iterations": 8},
+        "restart_attempts": 0,
+    }))
+    plain = _footprints_view(str(tmp_path), "j1", record)
+    assert "sharded" not in plain["footprints"]["estimator"]
+    view = _footprints_view(str(tmp_path), "j1", record, devices=4)
+    sharded = view["footprints"]["estimator"]["sharded"]
+    assert sharded["devices"] == 4
+    assert sharded["per_device_bytes"] <= view["footprints"][
+        "estimator"
+    ]["total_bytes"]
+
+
 def test_check_admission_attaches_estimator_path():
     from consensus_clustering_tpu.serve.preflight import (
         PreflightReject,
@@ -481,6 +587,32 @@ def test_preflight_413_payload_carries_both_footprints(tmp_path):
         "estimated_bytes"
     ]
     assert s.preflight_rejects_total == 1
+
+
+def test_preflight_413_carries_sharded_estimator_footprint(tmp_path):
+    """With >= 2 local devices (the suite pins 8 emulated), every 413's
+    estimator block gains the per-device sharded footprint + mesh
+    hint, and an estimate-mode reject carries it inside its own
+    estimate breakdown — the 'refused solo, fits sharded'
+    disclosure."""
+    from consensus_clustering_tpu.serve.preflight import PreflightReject
+
+    s = _scheduler(tmp_path, 1024)
+    x = np.zeros((5000, 3), np.float32)
+    with pytest.raises(PreflightReject) as e:
+        s._preflight(_spec(mode="exact"), x, "fp")
+    sharded = e.value.payload["estimator"]["sharded"]
+    assert sharded["devices"] >= 2
+    assert sharded["mesh"]["h"] * sharded["mesh"]["n"] == sharded[
+        "devices"
+    ]
+    assert sharded["per_device_bytes"] < e.value.payload["estimator"][
+        "estimated_bytes"
+    ]
+    assert sharded["fits_budget"] in (True, False)
+    with pytest.raises(PreflightReject) as e:
+        s._preflight(_spec(mode="estimate"), x, "fp")
+    assert "sharded" in e.value.payload["estimate"]
 
 
 def test_preflight_gates_estimate_mode_on_its_own_model(tmp_path):
@@ -652,7 +784,8 @@ def _blobs(n, d, seed):
     return blobs(n, d, seed)
 
 
-def _engine(n=90, d=4, k=(2, 3), h=9, hb=3, m=512):
+def _engine(n=90, d=4, k=(2, 3), h=9, hb=3, m=512, mesh=None,
+            accum_repr="dense"):
     from consensus_clustering_tpu.config import SweepConfig
     from consensus_clustering_tpu.estimator.engine import (
         PairConsensusEngine,
@@ -662,8 +795,181 @@ def _engine(n=90, d=4, k=(2, 3), h=9, hb=3, m=512):
     config = SweepConfig(
         n_samples=n, n_features=d, k_values=k, n_iterations=h,
         store_matrices=False, stream_h_block=hb,
+        accum_repr=accum_repr,
     )
-    return PairConsensusEngine(KMeans(), config, n_pairs=m), config
+    return PairConsensusEngine(
+        KMeans(), config, n_pairs=m, mesh=mesh
+    ), config
+
+
+def _mesh(n_dev, row_shards=1, k_shards=1):
+    import jax
+
+    from consensus_clustering_tpu.parallel.mesh import resample_mesh
+
+    return resample_mesh(
+        jax.devices()[:n_dev], row_shards=row_shards, k_shards=k_shards
+    )
+
+
+def test_engine_rejects_k_sharded_mesh():
+    """Host-only: the pair engine shards over ('h', 'n'); a 'k'-sharded
+    mesh is refused with a clear error before anything traces."""
+    with pytest.raises(ValueError, match="k_shards=1"):
+        _engine(mesh=_mesh(2, k_shards=2))
+
+
+def _assert_pair_parity(ref, out):
+    for name in ("pair_i", "pair_j", "mij", "iij"):
+        assert np.array_equal(
+            ref["pair_state"][name], out["pair_state"][name]
+        ), name
+    assert np.array_equal(ref["pac_area"], out["pac_area"])
+    assert np.array_equal(ref["cdf"], out["cdf"])
+    assert np.array_equal(ref["hist"], out["hist"])
+    assert (
+        ref["streaming"]["pac_trajectory"]
+        == out["streaming"]["pac_trajectory"]
+    )
+
+
+def test_mesh_parity_two_device_boundary():
+    """The fast boundary case of the sharding-invariance family (the
+    full mesh × repr grid rides the slow lane): a 2-device 'h'-shard at
+    the smallest interesting shape is bit-identical to single-device —
+    pair counts, curves, trajectory."""
+    engine, _ = _engine(n=40, d=3, k=(2,), h=4, hb=2, m=64)
+    sharded, _ = _engine(
+        n=40, d=3, k=(2,), h=4, hb=2, m=64, mesh=_mesh(2)
+    )
+    x = _blobs(40, 3, seed=5)
+    ref = engine.run(x, 23, 4, return_state=True)
+    out = sharded.run(x, 23, 4, return_state=True)
+    _assert_pair_parity(ref, out)
+    assert out["timing"]["mesh"] == {"h": 2, "n": 1}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "h_shards,row_shards", [(1, 2), (2, 2), (4, 2), (2, 4)]
+)
+def test_mesh_sharding_invariance_family(h_shards, row_shards):
+    """The estimator twin of test_sweep's dense invariance families:
+    every ('h', 'n') factorisation merges to bit-identical pair
+    counts, curves and PAC trajectory (integer psums are
+    order-independent; pair choice stays the only error source).
+    The block size divides every tested device product — as in the
+    dense families, the padded block size is part of the schedule, so
+    a mesh wider than the block legitimately reshapes the trajectory
+    (final counts stay identical either way)."""
+    engine, _ = _engine(h=16, hb=8, m=257)
+    x = _blobs(90, 4, seed=7)
+    ref = engine.run(x, 23, 16, return_state=True)
+    sharded, _ = _engine(
+        h=16, hb=8, m=257,
+        mesh=_mesh(h_shards * row_shards, row_shards=row_shards),
+    )
+    out = sharded.run(x, 23, 16, return_state=True)
+    _assert_pair_parity(ref, out)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("row_shards", [1, 2])
+def test_packed_pair_path_parity(row_shards):
+    """accum_repr='packed' (bit-plane AND+popcount pair increments) is
+    bit-identical to the dense label scatter — solo and mesh-sharded:
+    the ops/bitpack exactness contract at estimator shape."""
+    engine, _ = _engine(h=8, hb=4, m=257)
+    x = _blobs(90, 4, seed=7)
+    ref = engine.run(x, 23, 8, return_state=True)
+    packed, _ = _engine(
+        h=8, hb=4, m=257, accum_repr="packed",
+        mesh=None if row_shards == 1 else _mesh(
+            2 * row_shards, row_shards=row_shards
+        ),
+    )
+    out = packed.run(x, 23, 8, return_state=True)
+    _assert_pair_parity(ref, out)
+    assert out["streaming"]["accum_repr"] == "packed"
+
+
+@pytest.mark.slow
+def test_cross_mesh_checkpoint_frames_and_resume(tmp_path):
+    """The pinned cross-mesh resume semantics: frames carry the
+    CROPPED (nK, M) counts, so (a) a frame written under any mesh
+    shape is identical (header minus wall-clock, arrays exactly) to
+    the single-device frame, and (b) a ring written under a 2x2 mesh
+    resumes under 1x1 BIT-IDENTICALLY — works, not refused."""
+    from consensus_clustering_tpu.estimator.engine import (
+        verify_pair_state_frame,
+    )
+    from consensus_clustering_tpu.resilience.blocks import (
+        StreamCheckpointer,
+    )
+
+    from consensus_clustering_tpu.utils.checkpoint import (
+        data_fingerprint,
+        estimator_stream_fingerprint,
+    )
+
+    x = _blobs(90, 4, seed=7)
+    rings = {}
+    outs = {}
+    config = None
+    for name, mesh in [("1x1", None), ("2x2", _mesh(4, row_shards=2))]:
+        engine, config = _engine(h=8, hb=4, m=257, mesh=mesh)
+        ring = str(tmp_path / name)
+        ck = StreamCheckpointer(ring, every=1)
+        outs[name] = engine.run(
+            x, 23, 8, checkpointer=ck, return_state=True
+        )
+        ck.close()
+        rings[name] = ring
+    fp = estimator_stream_fingerprint(
+        config, 23, data_fingerprint(np.asarray(x)),
+        n_pairs=257, n_iterations=8,
+        adaptive_tol=config.adaptive_tol,
+        adaptive_patience=config.adaptive_patience,
+        adaptive_min_h=config.adaptive_min_h,
+    )
+    # (a) frame identity: newest verified generation, header minus the
+    # wall-clock stamp + arrays, equal across meshes.
+    frames = {}
+    for name, ring in rings.items():
+        header, arrays = StreamCheckpointer(ring, every=1).latest(
+            fp, verify=verify_pair_state_frame
+        )
+        header = dict(header)
+        header.pop("written_at")
+        frames[name] = (header, arrays)
+    h1, a1 = frames["1x1"]
+    h2, a2 = frames["2x2"]
+    assert h1 == h2
+    assert sorted(a1) == sorted(a2)
+    for arr_name in a1:
+        assert np.array_equal(a1[arr_name], a2[arr_name]), arr_name
+    # (b) cross-mesh resume: drop the 2x2 ring's newest generation and
+    # finish the run single-device — bit-identical to uninterrupted.
+    ring = rings["2x2"]
+    gens = sorted(f for f in os.listdir(ring) if f.startswith("gen-"))
+    os.remove(os.path.join(ring, gens[-1]))
+    ck = StreamCheckpointer(ring, every=1)
+    engine, _ = _engine(h=8, hb=4, m=257)
+    resumed = engine.run(x, 23, 8, checkpointer=ck, return_state=True)
+    ck.close()
+    assert resumed["streaming"]["resumed_from_block"] > 0
+    _assert_pair_parity(outs["2x2"], resumed)
+    # (c) the other half of the pinned contract: a mesh that PADS the
+    # block differently writes on a different resample grid, and a
+    # non-terminal frame from it is REFUSED loudly (resuming it would
+    # skip rows), never silently mis-resumed.
+    gens = sorted(f for f in os.listdir(ring) if f.startswith("gen-"))
+    os.remove(os.path.join(ring, gens[-1]))
+    wide, _ = _engine(h=8, hb=4, m=257, mesh=_mesh(8))  # pads hb 4->8
+    ck = StreamCheckpointer(ring, every=1)
+    with pytest.raises(ValueError, match="padded block"):
+        wide.run(x, 23, 8, checkpointer=ck)
+    ck.close()
 
 
 @pytest.mark.slow

@@ -723,6 +723,16 @@ class TestServeCrashResume:
             assert done["status"] == "done"
             assert done["from_cache"] is True
             assert done["result"]["best_k"] == 2
+            # The counter lands AFTER the fenced terminal write (a
+            # zombie must not report a completion the store refused),
+            # so poll for it like the lease tests poll for the
+            # tombstone — reading it at first sight of "done" races.
+            deadline = time.time() + 5
+            while (
+                sched.metrics()["cache_hits"] != 1
+                and time.time() < deadline
+            ):
+                time.sleep(0.02)
             assert sched.metrics()["cache_hits"] == 1
         finally:
             sched.stop()
