@@ -39,6 +39,8 @@ Schedules::
 
     python benchmarks/latency_probe.py --schedule smoke   # CI (12 jobs)
     python benchmarks/latency_probe.py --schedule load    # 40 jobs, 2 buckets
+    python benchmarks/latency_probe.py --schedule fair    # fairness A/B
+    python benchmarks/latency_probe.py --schedule progressive  # estimate->exact
 
 Prints a JSON report; exits non-zero on any violation.  CPU-pinned like
 every CI harness.
@@ -843,9 +845,296 @@ def phase_fair(root, report):
     }
 
 
+def _prog_body(seed, n=40, iters=16, priority="high",
+               tenant="interactive"):
+    body = _body(seed, n=n, iters=iters)
+    body["config"]["mode"] = "progressive"
+    body["config"]["priority"] = priority
+    body["config"]["tenant"] = tenant
+    return body
+
+
+def _stream_job(svc, job_id, stop_names, budget=600):
+    """Watch a job's SSE channel; returns [(name, data, t), ...] up to
+    and including the first frame whose name is in ``stop_names``."""
+    import http.client
+
+    host = svc.base[len("http://"):]
+    conn = http.client.HTTPConnection(host, timeout=120)
+    conn.request("GET", f"/jobs/{job_id}/events")
+    resp = conn.getresponse()
+    if resp.status != 200:
+        raise Violation(f"SSE stream for {job_id} got {resp.status}")
+    frames = []
+    deadline = time.time() + budget
+    try:
+        for name, data in _sse_frames(resp.fp):
+            frames.append((name, data, time.time()))
+            if name in stop_names:
+                return frames
+            if time.time() > deadline:
+                break
+    finally:
+        resp.close()
+        conn.close()
+    raise Violation(
+        f"SSE stream for {job_id} ended without any of {stop_names} "
+        f"(saw {[n for n, _, _ in frames]})"
+    )
+
+
+def _frame_index(frames, name):
+    for i, (n, _, _) in enumerate(frames):
+        if n == name:
+            return i
+    raise Violation(
+        f"no {name!r} frame (saw {[n for n, _, _ in frames]})"
+    )
+
+
+def phase_progressive(root, report):
+    """Progressive serving end to end (docs/SERVING.md "Progressive
+    serving runbook"): a ``mode=progressive`` job answers at estimate
+    cost with the DKW band on the wire, its ``job_done`` frame says
+    ``upgrade_pending`` (NOT terminal), and the background tiled
+    continuation delivers a terminal ``result_upgraded`` frame whose
+    refined PAC area is bit-identical to a from-scratch exact oracle —
+    with three pairwise-distinct result fingerprints (estimate /
+    refine / exact: disclosed lineage, never a silent swap).  Under a
+    low-priority flood the first answer still lands within a small
+    multiple of the solo estimate latency; a client cancelling a
+    done-but-pending parent refunds the queued continuation before it
+    ever runs; and serve-admin trace/report retell the whole sequence
+    from the JSONL log alone under the ``-X importtime`` pin."""
+    store = os.path.join(root, "prog_store")
+    events_path = os.path.join(root, "prog_events.jsonl")
+    svc = ServiceProc(
+        store,
+        extra_args=[
+            "--queue-size", "64", "--no-shed",
+            "--schedule", "fair",
+            "--wedge-floor", "30",
+        ],
+        events_path=events_path,
+    )
+    try:
+        # --- Solo arm: full frame sequence + parity + lineage. -------
+        t0 = time.time()
+        code, rec, _ = svc.post("/jobs", _prog_body(5000))
+        if code != 202:
+            raise Violation(f"progressive admission got {code}")
+        parent_id = rec["job_id"]
+        frames = _stream_job(
+            svc, parent_id,
+            stop_names=("result_upgraded", "continuation_settled",
+                        "job_failed", "job_cancelled"),
+        )
+        if frames[0][0] != "state":
+            raise Violation(f"first SSE frame was {frames[0][0]!r}")
+        names = [n for n, _, _ in frames]
+        if "h_block_complete" not in names:
+            raise Violation("no h_block_complete frames on the stream")
+        k_batches = [d for n, d, _ in frames if n == "k_batch_complete"]
+        if not k_batches:
+            raise Violation("no k_batch_complete frames on the stream")
+        for d in k_batches:
+            # Satellite DKW band disclosure: every estimate-phase
+            # k_batch_complete frame prices its own uncertainty.
+            if not (isinstance(d.get("n_pairs"), int) and d["n_pairs"] > 0):
+                raise Violation(f"k_batch_complete without n_pairs: {d}")
+            for key in ("pac_error_bound", "cdf_epsilon", "delta"):
+                v = d.get(key)
+                if not (isinstance(v, (int, float)) and v > 0):
+                    raise Violation(
+                        f"k_batch_complete band field {key}={v!r}"
+                    )
+        i_enq = _frame_index(frames, "continuation_enqueued")
+        i_done = _frame_index(frames, "job_done")
+        i_upg = _frame_index(frames, "result_upgraded")
+        if not i_enq < i_done < i_upg:
+            raise Violation(
+                "frame order continuation_enqueued < job_done < "
+                f"result_upgraded violated: {names}"
+            )
+        done_frame = frames[i_done][1]
+        if done_frame.get("terminal") is not False:
+            raise Violation(
+                "progressive job_done frame must NOT be terminal "
+                "(the upgrade is still pending)"
+            )
+        if not done_frame.get("upgrade_pending"):
+            raise Violation("job_done frame missing upgrade_pending")
+        cont_id = done_frame.get("continuation_job_id")
+        if not cont_id:
+            raise Violation("job_done frame missing continuation_job_id")
+        est_result = done_frame["record"]["result"]
+        if est_result.get("mode") != "estimate":
+            raise Violation(
+                f"estimate answer mode {est_result.get('mode')!r}"
+            )
+        ttfa_solo = frames[i_done][2] - t0
+        tte_solo = frames[i_upg][2] - t0
+        upg_frame = frames[i_upg][1]
+        if upg_frame.get("terminal") is not True:
+            raise Violation("result_upgraded frame must be terminal")
+        if upg_frame.get("pac_error_bound") != 0.0:
+            raise Violation(
+                "result_upgraded band did not collapse to zero: "
+                f"{upg_frame.get('pac_error_bound')!r}"
+            )
+        ref_result = upg_frame["record"]["result"]
+        if ref_result.get("mode") != "exact" or not ref_result.get("refined"):
+            raise Violation(
+                "upgraded result is not a disclosed exact refinement: "
+                f"mode={ref_result.get('mode')!r} "
+                f"refined={ref_result.get('refined')!r}"
+            )
+        cont_rec = svc.get(f"/jobs/{cont_id}")
+        if cont_rec.get("continuation_of") != parent_id:
+            raise Violation("continuation record lost its parent lineage")
+        best_k = int(ref_result["best_k"])
+        # From-scratch exact oracle at the chosen K: same data, seed,
+        # iterations — a DIFFERENT job class (mode=exact), so its
+        # fingerprint lineage must stay distinct while its PAC area is
+        # bit-identical to the tiled refinement.
+        oracle_body = _body(5000, k=(best_k,))
+        _, orec, _ = svc.post("/jobs", oracle_body)
+        oracle = svc.poll_job(orec["job_id"], budget=600)
+        if oracle["status"] != "done":
+            raise Violation(f"exact oracle ended {oracle['status']}")
+        oracle_result = oracle["result"]
+        fps = {
+            "estimate": est_result["result_fingerprint"],
+            "refine": ref_result["result_fingerprint"],
+            "exact": oracle_result["result_fingerprint"],
+        }
+        if len(set(fps.values())) != 3:
+            raise Violation(
+                f"fingerprint lineage collapsed: {fps} — a progressive "
+                "result may never alias a from-scratch one"
+            )
+        refined_area = ref_result["pac_area"][str(best_k)]
+        oracle_area = oracle_result["pac_area"][str(best_k)]
+        if refined_area != oracle_area:
+            raise Violation(
+                f"refined PAC area {refined_area!r} != exact oracle "
+                f"{oracle_area!r} (bit-identical parity gate)"
+            )
+
+        # --- Flood arm: TTFA under load. -----------------------------
+        flood_ids = [
+            svc.post(
+                "/jobs", _fair_body(5100 + i, 56, 96, "low", "bulk")
+            )[1]["job_id"]
+            for i in range(4)
+        ]
+        t1 = time.time()
+        _, rec2, _ = svc.post("/jobs", _prog_body(5200))
+        frames2 = _stream_job(
+            svc, rec2["job_id"],
+            stop_names=("job_done", "job_failed", "job_cancelled"),
+        )
+        i_done2 = _frame_index(frames2, "job_done")
+        if not frames2[i_done2][1].get("upgrade_pending"):
+            raise Violation("flood-arm job_done lost upgrade_pending")
+        ttfa_flood = frames2[i_done2][2] - t1
+        ttfa_bound = max(30.0, 8.0 * ttfa_solo)
+        if ttfa_flood > ttfa_bound:
+            raise Violation(
+                f"time-to-first-answer under flood {ttfa_flood:.1f}s "
+                f"exceeds {ttfa_bound:.1f}s — the estimate phase is "
+                "not jumping the queue"
+            )
+
+        # --- Cancel arm: refund a queued continuation. ---------------
+        # A chunky HIGH job submitted right behind the progressive one
+        # holds the worker the moment the estimate completes (strict
+        # priority: the low-priority continuation cannot be picked
+        # while high work is queued), so the cancel below always finds
+        # the continuation BEFORE execution — no race.
+        _, p3, _ = svc.post("/jobs", _prog_body(5300))
+        svc.post(
+            "/jobs", _fair_body(5301, 56, 96, "high", "interactive")
+        )
+        p3_rec = svc.poll_job(p3["job_id"], budget=600)
+        if p3_rec["status"] != "done":
+            raise Violation(f"cancel-arm parent ended {p3_rec['status']}")
+        cont3_id = p3_rec.get("continuation_job_id")
+        if not cont3_id:
+            raise Violation("cancel-arm parent has no continuation")
+        code, _, _ = svc.post(f"/jobs/{p3['job_id']}/cancel", {})
+        if code != 202:
+            raise Violation(f"cancel of done parent got {code}")
+        cont3 = svc.poll_job(
+            cont3_id, budget=120,
+            terminal=("done", "failed", "timeout", "quarantined",
+                      "cancelled"),
+        )
+        if cont3["status"] != "cancelled":
+            raise Violation(
+                f"cancelled client's continuation ended "
+                f"{cont3['status']} — it must never run"
+            )
+        if cont3.get("result"):
+            raise Violation("cancelled continuation produced a result")
+        if "before execution" not in (cont3.get("error") or ""):
+            raise Violation(
+                "continuation was not refunded before execution: "
+                f"{cont3.get('error')!r}"
+            )
+
+        m = svc.get("/metrics")
+        if m["progressive_jobs_total"] < 3:
+            raise Violation("progressive_jobs_total not counted")
+        if m["continuations_enqueued_total"] < 3:
+            raise Violation("continuations_enqueued_total not counted")
+        if m["continuations_completed_total"] < 1:
+            raise Violation("continuations_completed_total not counted")
+        if m["continuations_cancelled_total"] < 1:
+            raise Violation("continuations_cancelled_total not counted")
+        _check_exposition(svc, {})
+
+        # --- Forensics: the whole sequence from the JSONL log alone. -
+        trace_out = _run_admin([
+            "--store-dir", store, "trace", parent_id,
+            "--events", events_path,
+        ])
+        for needle in (
+            parent_id, "continuation_enqueued", "result_upgraded",
+            "job_done",
+        ):
+            if needle not in trace_out:
+                raise Violation(f"trace output missing {needle!r}")
+        report_out = _run_admin([
+            "--store-dir", store, "report", "--events", events_path,
+        ])
+        for needle in (
+            "estimates_answered=", "continuations: enqueued=",
+            "time_to_first_answer", "time_to_exact",
+        ):
+            if needle not in report_out:
+                raise Violation(f"report output missing {needle!r}")
+
+        report["progressive"] = {
+            "ttfa_solo_seconds": round(ttfa_solo, 1),
+            "tte_solo_seconds": round(tte_solo, 1),
+            "ttfa_flood_seconds": round(ttfa_flood, 1),
+            "ttfa_flood_bound_seconds": round(ttfa_bound, 1),
+            "flood_jobs": len(flood_ids),
+            "best_k": best_k,
+            "fingerprints_distinct": 3,
+            "refined_area_matches_oracle": True,
+            "cancel_refunded_before_execution": True,
+            "admin_stdlib_pinned": True,
+        }
+    finally:
+        svc.stop()
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--schedule", choices=["smoke", "load", "fair"],
+    p.add_argument("--schedule",
+                   choices=["smoke", "load", "fair", "progressive"],
                    default="smoke")
     p.add_argument("--out", default=None, help="write the JSON report here")
     p.add_argument("--root", default=None,
@@ -863,6 +1152,12 @@ def main(argv=None):
         # service lifecycles with a deliberate backlog each — stacking
         # it under the obs phases would blow their budget.
         phases = [("fair", lambda: phase_fair(root, report))]
+    elif args.schedule == "progressive":
+        # Progressive serving is its own lane too (progressive-smoke
+        # CI): one service lifecycle, but a deliberate chunky flood.
+        phases = [
+            ("progressive", lambda: phase_progressive(root, report)),
+        ]
     else:
         phases = [
             ("load", lambda: phase_load(root, report, n_jobs, buckets)),

@@ -424,6 +424,20 @@ class ConsensusClustering:
         self.calibration_dir = calibration_dir
         from consensus_clustering_tpu.config import validate_mode
 
+        if mode == "progressive":
+            # Serving-only (config.SERVING_MODES): the two-phase
+            # estimate-then-refine contract needs a scheduler to run
+            # the background continuation — POST /jobs with
+            # config.mode = "progressive" (docs/SERVING.md
+            # "Progressive serving runbook").  The library call is
+            # synchronous; use mode="estimate" then
+            # estimator.tiled.exact_curves_for_k for the same two
+            # results in-process.
+            raise ValueError(
+                "mode='progressive' is a serving mode (POST /jobs), "
+                "not a library mode — use 'estimate' here and refine "
+                "the chosen K with estimator.tiled.exact_curves_for_k"
+            )
         self.mode = validate_mode(mode)
         if n_pairs is not None and (
             isinstance(n_pairs, bool)

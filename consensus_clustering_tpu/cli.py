@@ -581,7 +581,9 @@ def main(argv=None):
                      "state, PAC with a disclosed DKW error bound in "
                      "metrics.estimator), or 'auto' (exact when the "
                      "dense footprint fits the memory budget, estimate "
-                     "otherwise)")
+                     "otherwise); 'progressive' is serving-only — "
+                     "POST /jobs against cctpu-serve (docs/SERVING.md "
+                     "'Progressive serving runbook')")
     run.add_argument("--n-pairs", type=int, default=None,
                      help="pair-sample size for --mode estimate "
                      "(default: 2^17 capped at the N(N-1)/2 pair "

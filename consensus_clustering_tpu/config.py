@@ -34,6 +34,25 @@ from consensus_clustering_tpu.ops.resample import subsample_size
 #:   mode.
 ESTIMATOR_MODES = ("exact", "estimate", "auto")
 
+#: Job modes the SERVING surface accepts (``config.mode`` in ``POST
+#: /jobs``): the three library modes plus
+#:
+#: - ``progressive`` — estimate-first serving with background exact
+#:   refinement (docs/SERVING.md "Progressive serving runbook").  The
+#:   job itself runs the sampled-pair estimator (admitted and priced
+#:   like ``estimate``); when it completes, the scheduler enqueues a
+#:   low-priority *continuation* job — tiled exact refinement
+#:   (:mod:`consensus_clustering_tpu.estimator.tiled`) of the chosen K —
+#:   and the exactness upgrade is pushed to the parent's SSE channel as
+#:   a disclosed ``result_upgraded`` frame.  Serving-only: the library
+#:   facade (api.py) has no background queue, so it rejects this mode.
+#:
+#: The continuation itself runs under an internal ``refine`` mode that
+#: is deliberately in NEITHER tuple: it can only be constructed by the
+#: scheduler (never submitted over HTTP or via the facade), which keeps
+#: its fingerprint lineage distinct from any client-reachable job.
+SERVING_MODES = ESTIMATOR_MODES + ("progressive",)
+
 #: Exact-mode accumulator representations every surface shares
 #: (api.py ``accum_repr``, the serving ``config.accum_repr`` key,
 #: ``cli run --accum-repr``):

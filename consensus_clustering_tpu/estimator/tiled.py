@@ -137,6 +137,7 @@ def tiled_exact_curves(
     pac_hi_idx: int,
     parity_zeros: bool = True,
     tile_rows: int = 2048,
+    tile_callback=None,
 ) -> Dict[str, np.ndarray]:
     """Exact (hist, cdf, pac_area) for one K from its per-resample
     (indices, labels), streaming (tile_rows, N) consensus tiles.
@@ -147,6 +148,12 @@ def tiled_exact_curves(
     last-bin-right-closed), so at shapes where the dense sweep still
     runs, the curves are bit-identical to its output
     (tests/test_estimator.py).
+
+    ``tile_callback(tile_idx, rows_done)`` fires after each completed
+    tile — the serving refine path's liveness/cancel hook (heartbeat,
+    lease beat, cooperative cancel between tiles).  An exception it
+    raises aborts the loop: tiles carry no cross-tile state beyond the
+    plain ``counts`` vector, so abandoning mid-stream is safe.
     """
     indices = np.asarray(indices)
     labels = np.asarray(labels)
@@ -194,6 +201,8 @@ def tiled_exact_curves(
             np.searchsorted(edges, vals, side="right") - 1, 0, bins - 1
         )
         counts += np.bincount(idx, minlength=bins).astype(np.int64)
+        if tile_callback is not None:
+            tile_callback(r0 // tile_rows, r1)
     return _cdf_pac_from_counts_host(
         counts, n, pac_lo_idx, pac_hi_idx, parity_zeros
     )
@@ -206,6 +215,7 @@ def exact_curves_for_k(
     seed: int,
     k: int,
     tile_rows: int = 2048,
+    tile_callback=None,
 ) -> Dict[str, np.ndarray]:
     """Collect labels for one K and stream the tiled exact curves —
     the estimator's best-K exactness refinement, end to end."""
@@ -216,4 +226,5 @@ def exact_curves_for_k(
     return tiled_exact_curves(
         indices, labels, config.n_samples, config.bins, lo, hi,
         parity_zeros=config.parity_zeros, tile_rows=tile_rows,
+        tile_callback=tile_callback,
     )

@@ -247,6 +247,20 @@ def summarize(
             per_tenant.setdefault(tenant, _lane_row()),
         ]
 
+    # Progressive serving (docs/SERVING.md "Progressive serving
+    # runbook"), reconstructed from the JSONL alone: parents are
+    # job_submitted events with mode="progressive"; their first-answer
+    # latency is submit→job_done (the banded estimate), exactness
+    # latency is submit→result_upgraded (the continuation's refined
+    # twin).  Continuation ids come from continuation_enqueued, so
+    # their cancels can be told apart from ordinary ones.
+    prog_submit_ts: Dict[str, float] = {}
+    prog_done_ts: Dict[str, float] = {}
+    prog_upgrade_ts: Dict[str, float] = {}
+    cont_ids: set = set()
+    cont_counts = {
+        "enqueued": 0, "completed": 0, "cancelled": 0, "shed": 0,
+    }
     retries: Dict[str, int] = {}
     wedges = 0
     drift: Dict[str, int] = {}
@@ -292,7 +306,15 @@ def summarize(
                     str(e["priority"]),
                     str(e.get("tenant") or "default"),
                 )
+            if (
+                e.get("mode") == "progressive" and e.get("job_id")
+                and isinstance(ts, (int, float))
+            ):
+                prog_submit_ts[e["job_id"]] = float(ts)
         if name == "job_done":
+            jid = e.get("job_id")
+            if jid in prog_submit_ts and isinstance(ts, (int, float)):
+                prog_done_ts[jid] = float(ts)
             bucket = e.get("bucket") or "unknown"
             if e.get("job_id"):
                 bucket_of[e["job_id"]] = bucket
@@ -318,9 +340,22 @@ def summarize(
             for lane in lane_rows(e.get("job_id")):
                 lane["failed"] += 1
         elif name == "job_cancelled":
+            if e.get("job_id") in cont_ids:
+                cont_counts["cancelled"] += 1
             for lane in lane_rows(e.get("job_id")):
                 lane["cancelled"] += 1
+        elif name == "continuation_enqueued":
+            cont_counts["enqueued"] += 1
+            if e.get("continuation_job_id"):
+                cont_ids.add(e["continuation_job_id"])
+        elif name == "result_upgraded":
+            cont_counts["completed"] += 1
+            jid = e.get("job_id")
+            if jid in prog_submit_ts and isinstance(ts, (int, float)):
+                prog_upgrade_ts[jid] = float(ts)
         elif name == "job_shed":
+            if e.get("continuation_of"):
+                cont_counts["shed"] += 1
             # Sheds have no job_id (nothing was admitted): the event's
             # own lane fields are the row keys.
             per_priority.setdefault(
@@ -411,11 +446,25 @@ def summarize(
             for key, row in sorted(rows.items())
         }
 
+    ttfa = [
+        max(0.0, prog_done_ts[j] - prog_submit_ts[j])
+        for j in prog_done_ts if j in prog_submit_ts
+    ]
+    tte = [
+        max(0.0, prog_upgrade_ts[j] - prog_submit_ts[j])
+        for j in prog_upgrade_ts if j in prog_submit_ts
+    ]
     return {
         "events": len(events),
         "first_ts": ts_lo,
         "last_ts": ts_hi,
         "jobs": statuses,
+        "progressive": {
+            "estimates_answered": len(prog_done_ts),
+            "continuations": dict(cont_counts),
+            "time_to_first_answer": stats(ttfa),
+            "time_to_exact": stats(tte),
+        },
         "per_bucket": per_bucket,
         "per_priority": lane_section(per_priority),
         "per_tenant": lane_section(per_tenant),
@@ -480,6 +529,29 @@ def render_report(report: Dict[str, Any]) -> str:
                 f" queue p95={fmt_opt(row['queue_wait_p95'])}"
                 f" (n={row['queue_wait_count']})"
             )
+    prog = report.get("progressive") or {}
+    if prog.get("estimates_answered") or any(
+        (prog.get("continuations") or {}).values()
+    ):
+        conts = prog["continuations"]
+        ttfa = prog["time_to_first_answer"]
+        tte = prog["time_to_exact"]
+        lines.append("")
+        lines.append(
+            "progressive (docs/SERVING.md progressive runbook):"
+        )
+        lines.append(
+            f"  estimates_answered={prog['estimates_answered']}"
+            f"  continuations: enqueued={conts['enqueued']}"
+            f" completed={conts['completed']}"
+            f" cancelled={conts['cancelled']} shed={conts['shed']}"
+        )
+        lines.append(
+            f"  time_to_first_answer p50={fmt_opt(ttfa['p50'])}"
+            f" p95={fmt_opt(ttfa['p95'])} (n={ttfa['count']})"
+            f"  time_to_exact p50={fmt_opt(tte['p50'])}"
+            f" p95={fmt_opt(tte['p95'])} (n={tte['count']})"
+        )
     per_worker = report.get("per_worker") or {}
     if per_worker:
         lines.append("")

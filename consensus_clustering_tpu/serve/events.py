@@ -77,6 +77,27 @@ Fair-share / fusion / streamed-results events (docs/SERVING.md
   and its result carries the disclosed error bound — docs/SERVING.md
   "The 413 -> mode=estimate admission path"
 
+Progressive serving events (docs/SERVING.md "Progressive serving
+runbook"):
+
+- ``continuation_enqueued`` — a progressive parent's estimate landed
+  and its low-priority tiled-refinement continuation was admitted
+  (job_id — the PARENT, continuation_job_id, fingerprint — the
+  continuation's own request fingerprint, k — the chosen K being
+  refined, priority, tenant, worker_id); the continuation rides the
+  parent tenant's fair-share lane at the lowest weight, and its own
+  lifecycle emits ordinary ``job_*`` events under its own id (linked
+  back by ``continuation_of`` on its record and the parent's
+  ``continuation_job_id``)
+- ``result_upgraded`` — the continuation finished: the parent's
+  banded estimate now has a bit-identical-to-dense EXACT twin for the
+  chosen K (job_id — the PARENT, continuation_job_id, fingerprint —
+  the REFINED ``result_fingerprint``, distinct by construction from
+  both the estimate's and a from-scratch exact run's, best_k,
+  pac_error_bound — 0.0, the band collapsed, worker_id); the upgrade
+  is DISCLOSED, never a silent swap — the estimate record stands
+  untouched under its own fingerprint
+
 Multi-worker lease events (docs/SERVING.md "Multi-worker runbook"):
 
 - ``lease_takeover``  — this worker claimed an orphan's lease and will

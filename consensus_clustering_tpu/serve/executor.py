@@ -161,6 +161,17 @@ class JobSpec:
     # Pair-sample size for estimate mode (None: the deterministic
     # default, estimator.bounds.default_n_pairs(N)).
     n_pairs: Optional[int] = None
+    # Progressive-serving continuation linkage (docs/SERVING.md
+    # "Progressive serving runbook"): the parent job_id when this spec
+    # is a scheduler-constructed ``mode="refine"`` continuation, else
+    # None.  A scheduling annotation like priority/tenant — excluded
+    # from the fingerprint, the persisted payload, and the bucket
+    # (identical progressive parents must produce identical
+    # continuations that dedup as one result).  The DURABLE linkage is
+    # the job records' ``continuation_of``/``continuation_job_id``
+    # fields, which survive crash-requeue; this field only threads the
+    # parent id through the enqueue call path.
+    refine_parent: Optional[str] = None
     # Exact-mode accumulator representation (config.ACCUM_REPRS):
     # "dense" int32 row blocks or "packed" uint32 bit-plane masks
     # (~1/32 the accumulator bytes; results bit-identical — the packed
@@ -186,6 +197,7 @@ class JobSpec:
         payload.pop("chunk_size")
         payload.pop("priority")
         payload.pop("tenant")
+        payload.pop("refine_parent")
         payload["k_values"] = list(self.k_values)
         payload["pac_interval"] = list(self.pac_interval)
         payload["clusterer_options"] = dict(self.clusterer_options)
@@ -250,7 +262,9 @@ class JobSpec:
             payload.pop(field)
         if payload["stream_h_block"] is None:
             payload["stream_h_block"] = h_block
-        if self.accum_repr == "packed" and self.mode != "estimate":
+        if self.accum_repr == "packed" and self.mode not in (
+            "estimate", "progressive"
+        ):
             # The packed plane state is capacity-sized by H at build
             # time (StreamingSweep's h_cap), so packed EXACT jobs
             # cannot ride the H-agnostic executable: H goes back into
@@ -416,12 +430,17 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
             "config.tenant must be 1-64 chars of [A-Za-z0-9._-], got "
             f"{tenant!r}"
         )
-    from consensus_clustering_tpu.config import ESTIMATOR_MODES
+    # SERVING_MODES, not ESTIMATOR_MODES: the serving surface also
+    # accepts "progressive" (estimate now, exact refinement in the
+    # background — docs/SERVING.md "Progressive serving runbook").
+    # The internal continuation mode "refine" is in neither tuple, so
+    # it stays unreachable over HTTP by construction.
+    from consensus_clustering_tpu.config import SERVING_MODES
 
     mode = cfg.get("mode", "exact")
-    if mode not in ESTIMATOR_MODES:
+    if mode not in SERVING_MODES:
         raise JobSpecError(
-            f"config.mode must be one of {list(ESTIMATOR_MODES)}, got "
+            f"config.mode must be one of {list(SERVING_MODES)}, got "
             f"{mode!r}"
         )
     from consensus_clustering_tpu.config import ACCUM_REPRS
@@ -436,8 +455,9 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
     if n_pairs is not None:
         if mode == "exact":
             raise JobSpecError(
-                "config.n_pairs only applies to mode 'estimate' or "
-                "'auto' (the exact engine has no pair sample)"
+                "config.n_pairs only applies to mode 'estimate', "
+                "'auto' or 'progressive' (the exact engine has no "
+                "pair sample)"
             )
         if (
             not isinstance(n_pairs, int)
@@ -759,11 +779,15 @@ class SweepExecutor:
                     self.executable_cache_hits += 1
                 return hit, 0.0, True, resolution
             t0 = time.perf_counter()
-            if spec.mode == "estimate":
+            if spec.mode in ("estimate", "progressive"):
                 # The O(M) sampled-pair engine (consensus_clustering_
                 # tpu.estimator): same bucket discipline — mode and
                 # n_pairs are in the bucket string, so estimator and
-                # dense engines never collide in this cache.
+                # dense engines never collide in this cache.  A
+                # progressive job's FIRST phase IS an estimate run —
+                # it admits, executes, and is accounted exactly like
+                # one; only the scheduler's continuation enqueue
+                # distinguishes it.
                 from consensus_clustering_tpu.estimator.engine import (
                     PairConsensusEngine,
                 )
@@ -907,6 +931,19 @@ class SweepExecutor:
             PHASE_ENGINE_READY,
         )
 
+        if spec.mode == "refine":
+            # A progressive continuation: tiled exact refinement of the
+            # parent's chosen K (estimator/tiled.py), not a streamed
+            # sweep — no StreamingSweep engine, no checkpoint ring (a
+            # takeover recomputes; the label collection dominates and
+            # is itself one compiled batch).
+            return self._run_refine(
+                spec, x,
+                progress_cb=progress_cb,
+                block_cb=block_cb,
+                heartbeat=heartbeat,
+                tracer=tracer,
+            )
         n, d = x.shape
         engine, compile_seconds, cached, resolution = self._get_engine(
             spec, n, d
@@ -1000,7 +1037,7 @@ class SweepExecutor:
         from consensus_clustering_tpu.autotune.store import shape_bucket
 
         drift_bucket = shape_bucket(n, d, spec.n_iterations, spec.k_values)
-        if spec.mode == "estimate":
+        if spec.mode in ("estimate", "progressive"):
             # Estimate-mode traffic gets its own ledger bucket: its
             # throughput anchors and its preflight model are DIFFERENT
             # quantities from the dense engine's at the same shape, and
@@ -1008,7 +1045,7 @@ class SweepExecutor:
             # EWMA and fire false drift against dense calibration.
             drift_bucket = f"{drift_bucket}-estimate"
         calibrated_rate = None
-        if spec.mode != "estimate" and (
+        if spec.mode not in ("estimate", "progressive") and (
             resolution.provenance == PROVENANCE_CALIBRATED
         ) and (
             resolution.record or {}
@@ -1161,7 +1198,7 @@ class SweepExecutor:
         else:
             mem_after = {}
             compiled_mem = {}
-        if spec.mode == "estimate":
+        if spec.mode in ("estimate", "progressive"):
             # The model the admission gate priced THIS job with: the
             # estimator's O(M) footprint, not the dense O(N²) one —
             # the accountant's accuracy judgement must compare like
@@ -1217,7 +1254,7 @@ class SweepExecutor:
             self.autotune_provenance[resolution.provenance] = (
                 self.autotune_provenance.get(resolution.provenance, 0) + 1
             )
-            if spec.mode == "estimate":
+            if spec.mode in ("estimate", "progressive"):
                 # Estimator accounting, successful executions only
                 # like the H totals: runs, and the cumulative pair
                 # count (the /metrics pair gauge).
@@ -1251,6 +1288,158 @@ class SweepExecutor:
         if progress_cb is not None and _live():
             for k in result["K"]:
                 progress_cb(int(k), float(result["pac_area"][str(k)]))
+        return result
+
+    def _run_refine(
+        self,
+        spec: JobSpec,
+        x: np.ndarray,
+        progress_cb: Optional[Callable[[int, float], None]] = None,
+        block_cb: Optional[Callable[[int, int, list], None]] = None,
+        heartbeat=None,
+        tracer: Optional[Tracer] = None,
+    ) -> Dict[str, Any]:
+        """Execute one progressive CONTINUATION: tiled exact curves for
+        the parent's chosen K (``estimator/tiled.py``), shaped by the
+        same ``_shape_result`` as every other path so the refined
+        answer's semantic block — and its distinct ``mode="refine"``
+        fingerprint lineage — is computed by exactly the code the solo
+        paths use.
+
+        ``block_cb(tile_idx, H, [])`` fires per consensus row tile
+        (there are no H-blocks here; tiles are this path's unit of
+        progress): the scheduler's guarded callback turns each into a
+        lease beat, a cooperative cancel check, and an SSE
+        signs-of-life frame.  No checkpoint ring — a takeover
+        recomputes from scratch (the label collection is one compiled
+        batch and dominates; ring plumbing would buy at most one
+        tile's GEMM).  The drift ledger, block EWMA and memory
+        accountant stay unfed: a host-side tile loop shares no
+        expectation with the streamed device paths keyed by the same
+        shape.
+        """
+        from consensus_clustering_tpu.estimator.tiled import (
+            collect_resample_labels,
+            tiled_exact_curves,
+        )
+        from consensus_clustering_tpu.serve.watchdog import (
+            PHASE_ENGINE_READY,
+        )
+
+        if len(spec.k_values) != 1:
+            raise JobSpecError(
+                f"mode='refine' takes exactly one K (the parent's "
+                f"chosen best_k), got {list(spec.k_values)}"
+            )
+        n, d = (int(v) for v in x.shape)
+        k = int(spec.k_values[0])
+        resolution = self._resolve_h_block(spec, n, d)
+        config = self._config_for(spec, n, d, int(resolution.value))
+        clusterer = self._clusterer_for(spec)
+        if heartbeat is not None:
+            heartbeat.beat(PHASE_ENGINE_READY)
+
+        with self._lock:
+            self._cb_gen += 1
+            gen = self._cb_gen
+
+        def _live() -> bool:
+            with self._lock:
+                return self._cb_gen == gen
+
+        h = int(spec.n_iterations)
+        n_tiles = [0]
+
+        def tile_cb(tile_idx, rows_done):
+            del rows_done
+            n_tiles[0] += 1
+            if not _live():
+                # Same dead-generation rule as the streamed paths:
+                # nothing from an abandoned attempt may beat the
+                # heartbeat or reach the event stream.  The cancel
+                # check lives in the scheduler's block_cb, which a
+                # dead generation no longer owns either.
+                return
+            if heartbeat is not None:
+                heartbeat.beat(f"tile:{tile_idx}")
+            if block_cb is not None:
+                block_cb(tile_idx, h, [])
+
+        t0 = time.perf_counter()
+        indices, labels = collect_resample_labels(
+            clusterer, config, x, spec.seed, k,
+            h_block=int(resolution.value),
+        )
+        if heartbeat is not None:
+            heartbeat.beat("labels_collected")
+        lo, hi = config.pac_idx
+        curves = tiled_exact_curves(
+            indices, labels, n, spec.bins, lo, hi,
+            parity_zeros=spec.parity_zeros,
+            tile_callback=tile_cb,
+        )
+        run_seconds = time.perf_counter() - t0
+
+        # The host dict _shape_result expects, with the refine path's
+        # honest streaming metadata: tiles as the block unit, full H
+        # always (no adaptive stop — the parent already decided H).
+        host = {
+            "pac_area": [float(curves["pac_area"])],
+            "cdf": [np.asarray(curves["cdf"])],
+            "streaming": {
+                "h_block": int(resolution.value),
+                "h_requested": h,
+                "h_effective": h,
+                "n_blocks_run": int(n_tiles[0]),
+                "stopped_early": False,
+                "pac_trajectory": [],
+                "accum_repr": "dense",
+            },
+        }
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_refine_bytes,
+        )
+
+        estimate = estimate_refine_bytes(
+            n, d, k, h,
+            dtype=spec.dtype,
+            h_block=int(resolution.value),
+            subsampling=spec.subsampling,
+        )
+        # Model estimate only, measured fields null — the fused-path
+        # precedent: the tile loop is host-side numpy, so the device
+        # allocator high-water measures the label collection at most,
+        # and a partial measurement would poison the accountant.
+        memory_block = {
+            "estimated_bytes": int(estimate["total_bytes"]),
+            "estimate": {
+                key: value
+                for key, value in estimate.items()
+                if key not in ("total_bytes", "model")
+            },
+            "compiled": {},
+            "device_before": {},
+            "device_after": {},
+            "peak_delta_bytes": None,
+            "peak_masked": False,
+            "measured_bytes": None,
+            "measurement_source": None,
+            "preflight_accuracy": None,
+        }
+        with self._lock:
+            self.run_count += 1
+            self.h_requested_total += h
+            self.h_effective_total += h
+            self.autotune_provenance[resolution.provenance] = (
+                self.autotune_provenance.get(resolution.provenance, 0) + 1
+            )
+        result = self._shape_result(
+            spec, n, d, host, resolution, 0.0, False,
+            run_seconds, memory_block,
+        )
+        if progress_cb is not None and _live():
+            for kk in result["K"]:
+                progress_cb(int(kk), float(result["pac_area"][str(kk)]))
         return result
 
     def _shape_result(
@@ -1310,28 +1499,49 @@ class SweepExecutor:
             "analysis": spec.analysis,
             "h_effective": int(streaming["h_effective"]),
         }
-        if spec.mode == "estimate":
+        if spec.mode in ("estimate", "progressive"):
             # Mode and pair count are part of WHAT was computed — a
             # resumed estimate must reproduce both (exact-mode
-            # fingerprints keep their historical field set).
+            # fingerprints keep their historical field set).  A
+            # progressive parent's first phase IS an estimate run, so
+            # it reuses the estimate semantic lineage verbatim.
             semantic["mode"] = "estimate"
             semantic["n_pairs"] = int(host["estimator"]["n_pairs"])
+        elif spec.mode == "refine":
+            # The continuation's OWN lineage (docs/SERVING.md
+            # "Progressive serving runbook"): the counts are
+            # bit-identical to a dense exact run of the same K, but the
+            # semantic mode field keeps its fingerprint distinct from
+            # both the parent estimate AND a from-scratch exact result
+            # — an exactness upgrade is disclosed, never aliased.
+            semantic["mode"] = "refine"
         result_fingerprint = hashlib.sha256(
             json.dumps(semantic, sort_keys=True).encode()
         ).hexdigest()[:16]
         result_mode = (
-            "estimate" if spec.mode == "estimate" else "exact"
+            "estimate" if spec.mode in ("estimate", "progressive")
+            else "exact"
         )
         return {
             **semantic,
             # Which engine produced this result — "exact" or
             # "estimate"; estimate results ALSO carry the "estimator"
             # error-bound block (never an estimated PAC without its
-            # band in the same payload).
+            # band in the same payload).  A refine continuation reports
+            # "exact" (its counts ARE the dense statistic) with the
+            # "refined" production flag alongside.
             "mode": result_mode,
             **(
                 {"estimator": dict(host["estimator"])}
-                if spec.mode == "estimate" else {}
+                if spec.mode in ("estimate", "progressive") else {}
+            ),
+            **(
+                # Production metadata like "fused": this exact result
+                # was computed as a progressive continuation (tiled
+                # refinement of one chosen K), not a from-scratch
+                # sweep.
+                {"refined": True}
+                if spec.mode == "refine" else {}
             ),
             **(
                 # How the result was produced, never what it is: the

@@ -247,6 +247,63 @@ def estimate_estimator_bytes(
     }
 
 
+def estimate_refine_bytes(
+    n: int,
+    d: int,
+    k: int,
+    n_iterations: int,
+    dtype: str = "float32",
+    h_block: int = 16,
+    subsampling: float = 0.8,
+    tile_rows: int = 2048,
+) -> Dict[str, Any]:
+    """Estimated footprint of one PROGRESSIVE CONTINUATION — the tiled
+    exact refinement of the parent's chosen K
+    (:func:`~consensus_clustering_tpu.estimator.tiled.
+    tiled_exact_curves`) — so a progressive job's 413 body can disclose
+    BOTH phases' footprints at admission (docs/SERVING.md "Progressive
+    serving runbook").
+
+    The model mirrors ``estimator/tiled.py``: the (H, n_sub) int32
+    label/index collection, the three (H, N) host indicators (``labmat``
+    int32, ``samp`` f32, ONE live ``onehot`` f32 — never K of them),
+    ~3 live (tile_rows, N) f32 consensus tiles (Iij, Mij, cons), plus
+    the same data + clustering-lane terms as every other model (the
+    label collection reuses the shared lane helpers).  O(H·N +
+    tile_rows·N) — linear in N where the dense sweep is quadratic,
+    which is the whole reason the continuation is affordable where the
+    parent 413'd.  ``labmat_bytes`` is this model's distinguishing key:
+    :func:`check_admission` branches its hint on it, so the refine
+    model can never be mistaken for the estimator's (``n_pairs``) or
+    the packed one's (``tile_workspace_bytes``).
+    """
+    n = int(n)
+    h = max(1, int(n_iterations))
+    k_max = int(k)
+    itemsize = 8 if dtype == "float64" else 4
+    n_sub = max(1, int(round(n * float(subsampling))))
+
+    labels = 2 * 4 * h * n_sub
+    labmat = 3 * 4 * h * n
+    tile = 3 * 4 * min(int(tile_rows), n) * n
+    data = n * d * itemsize
+    lanes = 2 * int(h_block) * n_sub * (d + k_max) * itemsize
+    total = labels + labmat + tile + data + lanes
+    return {
+        "label_bytes": int(labels),
+        "labmat_bytes": int(labmat),
+        "tile_bytes": int(tile),
+        "data_bytes": int(data),
+        "lane_bytes": int(lanes),
+        "n_iterations": int(h),
+        "k": int(k_max),
+        "total_bytes": int(total),
+        "model": "tiled exact refinement of one K: (H, n_sub) labels + "
+        "(H, N) indicators + (tile_rows, N) consensus tiles + data + "
+        "clustering lanes; see estimator/tiled.py",
+    }
+
+
 def estimate_estimator_sharded(
     estimate: Dict[str, Any], devices: int
 ) -> Dict[str, Any]:
@@ -344,6 +401,7 @@ def check_admission(
     shape: Sequence[int],
     estimator: Optional[Dict[str, Any]] = None,
     packed: Optional[Dict[str, Any]] = None,
+    continuation: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Raise :class:`PreflightReject` when the estimate exceeds the
     budget; no-op otherwise.  Split from the estimate so the scheduler
@@ -360,11 +418,28 @@ def check_admission(
     and a client reads one response and decides without a second
     round-trip (docs/SERVING.md "The 413 -> mode=estimate admission
     path").
+
+    ``continuation`` (the scheduler passes it for progressive jobs) is
+    the SECOND phase's footprint — the tiled-refinement model of
+    :func:`estimate_refine_bytes`, sized pessimistically at full H —
+    attached as pure disclosure: the gate itself compares only
+    ``estimate`` (the phase that admits), but the 413 body then prices
+    both phases, per the progressive admission contract.
     """
     total = int(estimate["total_bytes"])
     if total <= budget_bytes:
         return
-    if "n_pairs" in estimate:
+    if "labmat_bytes" in estimate:
+        # The refine-continuation model (estimate_refine_bytes): H·N
+        # indicators + O(tile_rows·N) tiles — no N² term, no pair
+        # sample.
+        hint = (
+            "shrink iterations (the (H, N) indicator term dominates "
+            "this model) or tile_rows; or raise the budget "
+            "(--memory-budget / CCTPU_MEMORY_BUDGET) if the model is "
+            "wrong for your backend"
+        )
+    elif "n_pairs" in estimate:
         # The gating model is the estimator's O(M) one — there is no
         # N² term to shrink, and pointing at the wrong knobs would
         # have the operator tuning parameters this model ignores.
@@ -437,4 +512,6 @@ def check_admission(
         payload["estimator"] = dict(estimator)
     if packed is not None:
         payload["packed"] = dict(packed)
+    if continuation is not None:
+        payload["continuation"] = dict(continuation)
     raise PreflightReject(payload)

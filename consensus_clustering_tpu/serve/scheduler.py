@@ -130,9 +130,14 @@ from consensus_clustering_tpu.serve.preflight import (
     estimate_estimator_sharded,
     estimate_job_bytes,
     estimate_packed_bytes,
+    estimate_refine_bytes,
 )
 from consensus_clustering_tpu.serve.sched.fairshare import (
     FairShareQueue,
+)
+from consensus_clustering_tpu.serve.sched.progressive import (
+    band_fields,
+    plan_continuation,
 )
 from consensus_clustering_tpu.serve.sched.fusion import (
     MAX_FUSE_HARD_CAP,
@@ -492,6 +497,16 @@ class Scheduler:
         self.sse_streams_total = 0
         self.sse_cancels_total = 0
         self.cache_hits = 0
+        # Progressive serving (docs/SERVING.md "Progressive serving
+        # runbook"), pre-seeded: progressive parents admitted, and the
+        # continuation lifecycle — enqueued after the parent's estimate
+        # completed, refined to done, cancelled (client hung up or
+        # forwarded parent cancel), or shed/refused at enqueue.
+        self.progressive_jobs_total = 0
+        self.continuations_enqueued_total = 0
+        self.continuations_completed_total = 0
+        self.continuations_cancelled_total = 0
+        self.continuations_shed_total = 0
         # Retries by classify_error reason ({"injected": 1, "oom": 2,
         # ...}) — the /metrics retry_total{reason} satellite.
         self.retry_total: Dict[str, int] = {}
@@ -563,10 +578,16 @@ class Scheduler:
         Estimate-mode jobs get a ``-estimate`` suffix: their latency,
         throughput and footprint are different quantities from the
         dense engine's at the same shape, and one bucket name must
-        keep meaning one kind of traffic."""
+        keep meaning one kind of traffic.  A progressive parent IS an
+        estimate run (same engine, same footprint) so it shares the
+        estimate bucket; its continuation is a third kind of traffic —
+        host-tiled exact refinement — and gets ``-refine``."""
         bucket = shape_bucket(n, d, spec.n_iterations, spec.k_values)
-        if getattr(spec, "mode", "exact") == "estimate":
+        mode = getattr(spec, "mode", "exact")
+        if mode in ("estimate", "progressive"):
             bucket = f"{bucket}-estimate"
+        elif mode == "refine":
+            bucket = f"{bucket}-refine"
         return bucket
 
     def _span_sink(self, payload: Dict[str, Any]) -> None:
@@ -665,7 +686,23 @@ class Scheduler:
                 self._data.pop(job_id, None)
                 self._fusion_keys.pop(job_id, None)
         if record is None:
-            return self.store.load_job(job_id)
+            stored = self.store.load_job(job_id)
+            # Cancel forwarding (docs/SERVING.md "Progressive serving
+            # runbook"): a cancel on a DONE progressive parent is the
+            # client saying the estimate was enough — forward it to a
+            # still-pending continuation so the abandoned refinement
+            # refunds its fair-share slot instead of burning idle
+            # capacity on an answer nobody is waiting for.
+            if stored is not None and stored.get("status") == "done":
+                cont_id = stored.get("continuation_job_id")
+                if cont_id:
+                    cont = self.get(cont_id)
+                    if (
+                        cont is not None
+                        and cont.get("status") not in _TERMINAL
+                    ):
+                        self.cancel(cont_id, reason=reason)
+            return stored
         if queued:
             # Free the admission slot too: the queue entry would
             # otherwise keep counting against the global capacity
@@ -1123,6 +1160,12 @@ class Scheduler:
             "priority": spec.priority,
             "tenant": getattr(spec, "tenant", "default"),
         }
+        if getattr(spec, "refine_parent", None):
+            # Durable lineage for a progressive continuation: the spec
+            # field is a scheduling annotation (never fingerprinted);
+            # the RECORDS carry the linkage both ways — this side here,
+            # the parent's continuation_job_id at enqueue time.
+            record["continuation_of"] = spec.refine_parent
         cached = self.store.get_result(fp)
         if cached is not None:
             record["status"] = "done"
@@ -1132,11 +1175,16 @@ class Scheduler:
                 self.cache_hits += 1
             # Born terminal: mirrored to the jobstore only — GET serves
             # it from disk, and _jobs never holds it (see _update's
-            # eviction rationale).
+            # eviction rationale).  NOTE: a progressive parent served
+            # from cache gets NO continuation — the cached estimate's
+            # refined twin either already exists under the
+            # continuation's own fingerprint (dedup served it too) or
+            # was never asked for; re-deriving it here would re-run
+            # admission on a job the client was told is done.
             self.store.save_job(record)
             self.events.emit(
                 "job_submitted", job_id=job_id, fingerprint=fp,
-                shape=record["shape"], cached=True,
+                shape=record["shape"], cached=True, mode=spec.mode,
                 worker_id=self.worker_id,
             )
             return record
@@ -1222,9 +1270,12 @@ class Scheduler:
             raise QueueFull(
                 f"queue full ({self._queue.maxsize} jobs); retry later"
             )
+        if spec.mode == "progressive":
+            with self._lock:
+                self.progressive_jobs_total += 1
         self.events.emit(
             "job_submitted", job_id=job_id, fingerprint=fp,
-            shape=record["shape"], cached=False,
+            shape=record["shape"], cached=False, mode=spec.mode,
             priority=spec.priority,
             tenant=getattr(spec, "tenant", "default"),
             worker_id=self.worker_id,
@@ -1416,9 +1467,10 @@ class Scheduler:
         # every job that is not already packed, so a dense 413 carries
         # the exact-mode escape hatch next to the estimator's — the
         # three-way choice, decided from one response.
+        mode = getattr(spec, "mode", "exact")
         packed_info = None
         if (
-            getattr(spec, "mode", "exact") != "estimate"
+            mode not in ("estimate", "progressive", "refine")
             and getattr(spec, "accum_repr", "dense") != "packed"
         ):
             packed_est = self._packed_estimate(spec, n, d, h_block)
@@ -1436,16 +1488,50 @@ class Scheduler:
                 ),
             }
         sharded = self._sharded_disclosure(estimator_est)
-        if getattr(spec, "mode", "exact") == "estimate":
+        continuation_info = None
+        if mode in ("estimate", "progressive"):
             # Estimate-mode jobs are gated on their own O(M) model
             # (uncorrected: the correction EWMA belongs to the dense
             # model's bucket).  A reject here has no cheaper mode to
             # point at — the estimator IS the cheap mode — but the
             # sharded per-device footprint still rides the body: a job
-            # refused solo may fit mesh-sharded, bit-identically.
+            # refused solo may fit mesh-sharded, bit-identically.  A
+            # progressive parent gates identically (its first phase IS
+            # an estimate run); its SECOND phase is priced below as a
+            # pure disclosure — the continuation is admitted by the
+            # gate when it is actually submitted, but the 413/202 body
+            # must tell the client both phases' footprints up front.
             estimate = dict(estimator_est)
             if sharded is not None:
                 estimate["sharded"] = sharded
+            estimator_info = None
+            if mode == "progressive":
+                refine_est = estimate_refine_bytes(
+                    n, d, max(spec.k_values), spec.n_iterations,
+                    dtype=spec.dtype, h_block=h_block,
+                    subsampling=spec.subsampling,
+                )
+                continuation_info = {
+                    # Pessimistic by construction: priced at the FULL
+                    # requested H and the LARGEST candidate K — the
+                    # actual continuation runs h_effective and best_k,
+                    # both <= these.
+                    "estimated_bytes": int(refine_est["total_bytes"]),
+                    "fits_budget": (
+                        int(refine_est["total_bytes"])
+                        <= self.memory_budget_bytes
+                    ),
+                    "estimate": dict(refine_est),
+                }
+        elif mode == "refine":
+            # The continuation itself: gated on the host tiled-
+            # refinement model — (H, N) indicators plus one row tile,
+            # linear in N where the dense engine is quadratic.
+            estimate = estimate_refine_bytes(
+                n, d, max(spec.k_values), spec.n_iterations,
+                dtype=spec.dtype, h_block=h_block,
+                subsampling=spec.subsampling,
+            )
             estimator_info = None
         else:
             estimate = self._exact_estimate(spec, n, d, h_block)
@@ -1482,6 +1568,7 @@ class Scheduler:
                 estimate, self.memory_budget_bytes, x.shape,
                 estimator=estimator_info,
                 packed=packed_info,
+                continuation=continuation_info,
             )
         except PreflightReject as e:
             with self._lock:
@@ -1528,6 +1615,10 @@ class Scheduler:
             reason=reason, queue_depth=self._queue.qsize(),
             retry_after_seconds=round(retry_after, 3),
             worker_id=self.worker_id,
+            **(
+                {"continuation_of": spec.refine_parent}
+                if getattr(spec, "refine_parent", None) else {}
+            ),
         )
         raise QueueShed(spec.priority, reason, retry_after, basis=basis)
 
@@ -1595,6 +1686,19 @@ class Scheduler:
                 "jobs_cancelled_total": self.jobs_cancelled_total,
                 "sse_streams_total": self.sse_streams_total,
                 "sse_cancels_total": self.sse_cancels_total,
+                # Progressive serving (docs/SERVING.md "Progressive
+                # serving runbook"): parents admitted and the
+                # continuation lifecycle — enqueued / refined to done /
+                # cancelled / shed at enqueue.
+                "progressive_jobs_total": self.progressive_jobs_total,
+                "continuations_enqueued_total":
+                    self.continuations_enqueued_total,
+                "continuations_completed_total":
+                    self.continuations_completed_total,
+                "continuations_cancelled_total":
+                    self.continuations_cancelled_total,
+                "continuations_shed_total":
+                    self.continuations_shed_total,
                 "jobs_completed": self.jobs_completed,
                 "jobs_failed": self.jobs_failed,
                 "jobs_retried": self.jobs_retried,
@@ -1735,13 +1839,140 @@ class Scheduler:
                 self._fusion_keys.pop(job_id, None)
             # Live SSE subscribers get the terminal record as their
             # final frame (best-effort fan-out; the JSONL log is the
-            # durable story).
-            self.bus.publish(job_id, {
+            # durable story).  One exception: a progressive parent
+            # whose continuation is still pending keeps its channel
+            # OPEN — the frame says done + upgrade_pending so the
+            # client has its banded answer now, and the terminal frame
+            # arrives when the continuation settles (result_upgraded
+            # or continuation_settled, published on THIS channel by
+            # _settle_continuation — on whichever worker terminalises
+            # the continuation, takeover included).
+            cont_id = snapshot.get("continuation_job_id")
+            upgrade_pending = (
+                snapshot.get("status") == "done" and bool(cont_id)
+            )
+            frame: Dict[str, Any] = {
                 "event": f"job_{snapshot['status']}",
-                "terminal": True,
+                "terminal": not upgrade_pending,
                 "record": snapshot,
-            })
+            }
+            if upgrade_pending:
+                frame["upgrade_pending"] = True
+                frame["continuation_job_id"] = cont_id
+            self.bus.publish(job_id, frame)
+            if upgrade_pending:
+                cont = self.get(cont_id)
+                if (
+                    cont is not None
+                    and cont.get("status") in _TERMINAL
+                ):
+                    # Dedup edge: the continuation was born done from
+                    # cache (its refined twin already in the store), so
+                    # its own terminal _update never ran — settle the
+                    # parent's story here instead.
+                    self._settle_continuation(job_id, cont)
+            parent_id = snapshot.get("continuation_of")
+            if parent_id:
+                self._settle_continuation(parent_id, snapshot)
         return snapshot
+
+    def _settle_continuation(
+        self, parent_id: str, cont_record: Dict[str, Any]
+    ) -> None:
+        """A progressive continuation reached a terminal state: tell
+        the PARENT's story.  ``done`` → the exactness upgrade: counted,
+        disclosed durably as a JSONL ``result_upgraded`` event (what
+        serve-admin trace reconstructs), and pushed as a terminal
+        ``result_upgraded`` frame on the parent's SSE channel — the
+        DKW band collapses to zero and the refined
+        ``result_fingerprint`` rides the frame, a DISCLOSED upgrade,
+        never a silent swap (the continuation's fingerprint lineage is
+        its own: semantic ``mode="refine"``).  Any other terminal
+        outcome → the refinement will never arrive: count cancels, and
+        close the parent's channel with a bus-only
+        ``continuation_settled`` frame so a watching client is not
+        left hanging."""
+        status = cont_record.get("status")
+        cont_id = cont_record.get("job_id")
+        if status == "done":
+            result = cont_record.get("result") or {}
+            with self._lock:
+                self.continuations_completed_total += 1
+            self.events.emit(
+                "result_upgraded", job_id=parent_id,
+                continuation_job_id=cont_id,
+                fingerprint=result.get("result_fingerprint"),
+                best_k=result.get("best_k"),
+                pac_error_bound=0.0,
+                worker_id=self.worker_id,
+            )
+            self.bus.publish(parent_id, {
+                "event": "result_upgraded", "terminal": True,
+                "job_id": parent_id,
+                "continuation_job_id": cont_id,
+                "pac_error_bound": 0.0,
+                "record": dict(cont_record),
+            })
+        else:
+            if status == "cancelled":
+                with self._lock:
+                    self.continuations_cancelled_total += 1
+            self.bus.publish(parent_id, {
+                "event": "continuation_settled", "terminal": True,
+                "job_id": parent_id,
+                "continuation_job_id": cont_id,
+                "status": status,
+            })
+
+    def _enqueue_continuation(
+        self, job_id: str, spec: JobSpec, x, result: Dict[str, Any]
+    ) -> Optional[str]:
+        """Enqueue a completed progressive parent's refinement
+        continuation through the ORDINARY submit path (preflight on
+        the tiled model, shed gate, fair-share lane, lease, payload —
+        every serving guarantee for free), at ``priority="low"`` on
+        the parent's tenant lane so it consumes only idle capacity.
+        Returns the continuation's job id, or None when admission
+        refused it (counted as shed; the parent is still DONE — the
+        banded estimate IS the answer, exactness was best-effort)."""
+        try:
+            cont_spec = plan_continuation(spec, result, job_id)
+            cont = self.submit(cont_spec, x)
+        except (QueueShed, QueueFull, PreflightReject):
+            # submit already emitted the job_shed / preflight_reject
+            # event (with continuation_of lineage for the shed case).
+            with self._lock:
+                self.continuations_shed_total += 1
+            return None
+        except Exception as e:  # noqa: BLE001 — the parent's answer
+            # must not fail because its best-effort refinement could
+            # not be planned (e.g. a duck-typed stub's result dict
+            # lacking best_k/h_effective).
+            logger.warning(
+                "could not plan continuation for %s: %s", job_id, e
+            )
+            with self._lock:
+                self.continuations_shed_total += 1
+            return None
+        cont_id = cont["job_id"]
+        with self._lock:
+            self.continuations_enqueued_total += 1
+        self.events.emit(
+            "continuation_enqueued", job_id=job_id,
+            continuation_job_id=cont_id,
+            fingerprint=cont["fingerprint"],
+            k=int(cont_spec.k_values[0]),
+            priority=cont_spec.priority,
+            tenant=getattr(cont_spec, "tenant", "default"),
+            worker_id=self.worker_id,
+        )
+        self.bus.publish(job_id, {
+            "event": "continuation_enqueued", "job_id": job_id,
+            "continuation_job_id": cont_id,
+            "k": int(cont_spec.k_values[0]),
+            "priority": cont_spec.priority,
+        })
+        return cont_id
 
     def _run_with_timeout(
         self,
@@ -2002,16 +2233,30 @@ class Scheduler:
             self._note_drain()
             return
 
+        # DKW band fields for estimator-backed runs (docs/SERVING.md
+        # "Progressive serving runbook"): computed ONCE per job — pure
+        # arithmetic over estimator/bounds.py — and merged into every
+        # k_batch_complete frame, so any estimate/progressive client
+        # can watch convergence live without waiting for the terminal
+        # record's estimator block.
+        band = None
+        if getattr(spec, "mode", "exact") in ("estimate", "progressive"):
+            band = band_fields(
+                int(x.shape[0]), spec.n_pairs, spec.parity_zeros
+            )
+
         def progress_cb(k: int, pac: float) -> None:
             # The per-K signal api.py's progress plumbing already emits,
             # surfaced as a service event (name kept aligned with the
             # batch path's k_batch_complete metrics event).
             self.events.emit(
-                "k_batch_complete", job_id=job_id, k=k, pac=pac
+                "k_batch_complete", job_id=job_id, k=k, pac=pac,
+                **(band or {}),
             )
             self.bus.publish(job_id, {
                 "event": "k_batch_complete", "job_id": job_id,
                 "k": int(k), "pac": float(pac),
+                **(band or {}),
             })
 
         def block_cb(block: int, h_done: int, pac_list) -> None:
@@ -2280,9 +2525,23 @@ class Scheduler:
             # always find the result bytes on disk.
             self.store.put_result(fp, result)
             stored = self.store.get_result(fp)
+            # Progressive phase two (docs/SERVING.md "Progressive
+            # serving runbook"): the estimate is in hand — enqueue the
+            # low-priority tiled-refinement continuation BEFORE the
+            # done update, so the terminal record already carries the
+            # linkage and the done SSE frame can say upgrade_pending.
+            cont_id = None
+            if getattr(spec, "mode", "exact") == "progressive":
+                cont_id = self._enqueue_continuation(
+                    job_id, spec, x, stored
+                )
             self._update(
                 job_id, status="done", result=stored,
                 finished_at=round(time.time(), 3), seconds=seconds,
+                **(
+                    {"continuation_job_id": cont_id}
+                    if cont_id else {}
+                ),
             )
             # Success accounting only AFTER the fenced terminal write:
             # a zombie whose job was taken over unwinds on LeaseLost at

@@ -314,7 +314,46 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(sse_event("state", record))
             self.wfile.flush()
             if record.get("status") in _TERMINAL:
-                return
+                # A DONE progressive parent may still owe an upgrade
+                # frame (docs/SERVING.md "Progressive serving
+                # runbook").  Continuation still live → keep the
+                # stream open (result_upgraded / continuation_settled
+                # publish on the PARENT channel).  Continuation
+                # already terminal → synthesize the settlement frame a
+                # live subscriber would have received, then close.
+                cont_id = (
+                    record.get("continuation_job_id")
+                    if record.get("status") == "done" else None
+                )
+                cont = scheduler.get(cont_id) if cont_id else None
+                if cont is not None and cont.get("status") not in (
+                    _TERMINAL
+                ):
+                    pass  # fall through to the live-frame loop below
+                else:
+                    if cont is not None:
+                        if cont.get("status") == "done":
+                            frame = {
+                                "event": "result_upgraded",
+                                "terminal": True,
+                                "job_id": job_id,
+                                "continuation_job_id": cont_id,
+                                "pac_error_bound": 0.0,
+                                "record": cont,
+                            }
+                        else:
+                            frame = {
+                                "event": "continuation_settled",
+                                "terminal": True,
+                                "job_id": job_id,
+                                "continuation_job_id": cont_id,
+                                "status": cont.get("status"),
+                            }
+                        self.wfile.write(sse_event(
+                            frame["event"], frame
+                        ))
+                        self.wfile.flush()
+                    return
             keepalive = self.service.sse_keepalive_seconds
             while True:
                 # Disconnect detection by READING, not just writing: an

@@ -1,0 +1,505 @@
+"""Progressive-precision serving tests (docs/SERVING.md "Progressive
+serving runbook"): the mode=progressive two-phase contract — estimate
+now, exact in the background — at the unit and stub-scheduler level.
+
+Everything here is fast-lane: stub executors, no compile, no engine.
+The end-to-end flow against the REAL engines (banded estimate answer,
+background tiled refinement, parity vs the solo exact oracle) is the
+latency probe's ``--schedule progressive`` phase, run by the
+``progressive-smoke`` CI job.
+
+The load-bearing pins:
+
+- **fingerprint lineage** — a progressive upgrade's refined
+  ``result_fingerprint`` differs from BOTH the parent estimate's and a
+  from-scratch exact run's: an upgrade is disclosed, never aliased.
+- **crash between estimate-done and continuation pickup** — the queued
+  continuation survives worker death through the ordinary
+  lease/reconcile machinery and still settles the parent's story
+  (``result_upgraded`` in the JSONL) after takeover.
+- **cancel refunds the continuation** — a cancel on the DONE parent
+  forwards to the queued continuation, which terminalises "before
+  execution" and frees its fair-share slot.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.config import (
+    ESTIMATOR_MODES,
+    SERVING_MODES,
+)
+from consensus_clustering_tpu.serve import JobStore, Scheduler
+from consensus_clustering_tpu.serve.events import EventLog
+from consensus_clustering_tpu.serve.executor import (
+    JobSpec,
+    JobSpecError,
+    SweepExecutor,
+    parse_job_spec,
+)
+from consensus_clustering_tpu.serve.sched.progressive import (
+    band_fields,
+    plan_continuation,
+)
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+
+
+class _ProgStubExecutor:
+    """Duck-typed executor whose results carry the fields the
+    progressive path consumes (best_k, h_effective) — enough for
+    plan_continuation and _settle_continuation, no engine."""
+
+    def __init__(self):
+        self.run_count = 0
+        self.modes_run = []
+
+    def run(self, spec, x, progress_cb=None, **kwargs):
+        self.run_count += 1
+        self.modes_run.append(spec.mode)
+        return {
+            "seed": spec.seed,
+            "stub_mode": spec.mode,
+            "best_k": 2,
+            "h_effective": int(spec.n_iterations),
+            "result_fingerprint": f"fp-{spec.mode}-{spec.seed}",
+        }
+
+    def backend(self):
+        return "cpu-fallback"
+
+
+def _mk_scheduler(tmp_path, executor=None, **kwargs):
+    kwargs.setdefault("leases", False)
+    return Scheduler(
+        executor or _ProgStubExecutor(),
+        JobStore(str(tmp_path / "store")),
+        **kwargs,
+    )
+
+
+def _prog_spec(seed=1, iters=16, tenant="default"):
+    return JobSpec(
+        k_values=(2, 3), n_iterations=iters, seed=seed,
+        tenant=tenant, mode="progressive",
+    )
+
+
+def _x(seed=0, n=12, d=3):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(
+        np.float32
+    )
+
+
+def _events(path):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mode plumbing
+
+
+class TestModes:
+    def test_serving_modes_superset(self):
+        assert set(ESTIMATOR_MODES) < set(SERVING_MODES)
+        assert "progressive" in SERVING_MODES
+        # The scheduler-internal continuation mode is deliberately in
+        # NEITHER tuple: unreachable over HTTP by construction.
+        assert "refine" not in SERVING_MODES
+        assert "refine" not in ESTIMATOR_MODES
+
+    def test_parse_accepts_progressive(self):
+        spec, _ = parse_job_spec({
+            "data": [[float(i), float(-i)] for i in range(8)],
+            "config": {"mode": "progressive", "n_pairs": 16},
+        })
+        assert spec.mode == "progressive"
+        assert spec.n_pairs == 16
+
+    def test_parse_rejects_refine(self):
+        with pytest.raises(JobSpecError):
+            parse_job_spec({
+                "data": [[1.0, 2.0]] * 8,
+                "config": {"mode": "refine"},
+            })
+
+    def test_job_bucket_suffixes(self):
+        base = JobSpec(k_values=(2, 3), n_iterations=16, seed=1)
+        est = dataclasses.replace(base, mode="estimate")
+        prog = dataclasses.replace(base, mode="progressive")
+        ref = dataclasses.replace(
+            base, mode="refine", k_values=(2,),
+        )
+        exact_bucket = Scheduler._job_bucket(base, 100, 3)
+        assert Scheduler._job_bucket(est, 100, 3).endswith("-estimate")
+        # A progressive parent IS estimate traffic (same engine, same
+        # footprint): shared bucket, shared SLO/drift story.
+        assert (
+            Scheduler._job_bucket(prog, 100, 3)
+            == Scheduler._job_bucket(est, 100, 3)
+        )
+        assert Scheduler._job_bucket(ref, 100, 3).endswith("-refine")
+        assert not exact_bucket.endswith(("-estimate", "-refine"))
+
+    def test_api_refuses_progressive(self):
+        from consensus_clustering_tpu.api import ConsensusClustering
+
+        with pytest.raises(ValueError, match="serving mode"):
+            ConsensusClustering(K_range=(2, 3), mode="progressive")
+
+
+# ---------------------------------------------------------------------------
+# plan_continuation / band_fields units
+
+
+class TestPlanning:
+    def test_plan_continuation_shape(self):
+        parent = _prog_spec(seed=7, iters=32, tenant="acme")
+        result = {"best_k": 3, "h_effective": 24}
+        cont = plan_continuation(parent, result, "parent-id")
+        assert cont.mode == "refine"
+        assert cont.k_values == (3,)
+        assert cont.n_iterations == 24  # what the estimate ACTUALLY ran
+        assert cont.priority == "low"
+        assert cont.tenant == "acme"  # parent's fair-share lane
+        assert cont.seed == parent.seed
+        assert cont.n_pairs is None
+        assert cont.accum_repr == "dense"
+        assert cont.refine_parent == "parent-id"
+
+    def test_refine_parent_never_fingerprinted(self):
+        # The linkage is a scheduling annotation: two continuations of
+        # DIFFERENT parents with identical science must dedup to one
+        # refined result.
+        parent = _prog_spec(seed=7)
+        result = {"best_k": 2, "h_effective": 16}
+        a = plan_continuation(parent, result, "parent-a")
+        b = plan_continuation(parent, result, "parent-b")
+        assert a.refine_parent != b.refine_parent
+        assert a.fingerprint_payload() == b.fingerprint_payload()
+        assert "refine_parent" not in a.fingerprint_payload()
+
+    def test_band_fields(self):
+        from consensus_clustering_tpu.estimator.bounds import (
+            DEFAULT_DELTA,
+            pac_error_bound,
+        )
+
+        fields = band_fields(1000, 512)
+        assert fields["n_pairs"] == 512
+        assert fields["pac_error_bound"] == pytest.approx(
+            pac_error_bound(512, 1000, True)
+        )
+        assert fields["delta"] == DEFAULT_DELTA
+        assert 0 < fields["cdf_epsilon"] < 1
+        # n_pairs=None resolves through the estimator's default
+        # pair-count policy rather than erroring.
+        assert band_fields(1000, None)["n_pairs"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint lineage (satellite c): estimate != refine != exact
+
+
+def test_result_fingerprint_lineage_distinct():
+    """The semantic fingerprints of (parent estimate, refined
+    continuation, from-scratch exact) are pairwise distinct even when
+    every number in them agrees — mode is identity, so a progressive
+    result can never alias a from-scratch one."""
+    executor = SweepExecutor(use_compilation_cache=False)
+
+    class _Res:
+        value = 16
+
+        def disclosure(self):
+            return {"value": 16, "provenance": "default"}
+
+    def shape(spec, ks, with_estimator):
+        bins = 8
+        host = {
+            "pac_area": [0.25 for _ in ks],
+            "cdf": [
+                np.linspace(0.0, 1.0, bins).astype(np.float32)
+                for _ in ks
+            ],
+            "streaming": {
+                "h_block": 16, "h_requested": 16, "h_effective": 16,
+                "n_blocks_run": 1, "stopped_early": False,
+                "pac_trajectory": [], "accum_repr": "dense",
+            },
+        }
+        if with_estimator:
+            host["estimator"] = {"n_pairs": 64}
+        return executor._shape_result(
+            spec, 12, 3, host, _Res(), 0.0, False, 0.1,
+            {"total_bytes": 0},
+        )
+
+    prog = JobSpec(
+        k_values=(2,), n_iterations=16, seed=1,
+        mode="progressive", n_pairs=64,
+    )
+    refine = JobSpec(
+        k_values=(2,), n_iterations=16, seed=1, mode="refine",
+    )
+    exact = JobSpec(k_values=(2,), n_iterations=16, seed=1)
+
+    est_result = shape(prog, (2,), with_estimator=True)
+    ref_result = shape(refine, (2,), with_estimator=False)
+    exact_result = shape(exact, (2,), with_estimator=False)
+
+    fps = {
+        est_result["result_fingerprint"],
+        ref_result["result_fingerprint"],
+        exact_result["result_fingerprint"],
+    }
+    assert len(fps) == 3
+    # And the production metadata tells the three apart for humans too.
+    assert est_result["mode"] == "estimate"
+    assert "estimator" in est_result
+    assert ref_result["mode"] == "exact"  # the counts ARE exact...
+    assert ref_result["refined"] is True  # ...produced by refinement
+    assert exact_result["mode"] == "exact"
+    assert "refined" not in exact_result
+
+
+# ---------------------------------------------------------------------------
+# Scheduler flow (stub executor, worker thread)
+
+
+class TestProgressiveFlow:
+    def test_estimate_then_continuation(self, tmp_path):
+        executor = _ProgStubExecutor()
+        log = tmp_path / "events.jsonl"
+        s = _mk_scheduler(
+            tmp_path, executor, events=EventLog(str(log)),
+        )
+        frames = []
+        s.start()
+        try:
+            rec = s.submit(_prog_spec(), _x())
+            sub = s.bus.subscribe(rec["job_id"])
+            import time as _time
+
+            deadline = _time.time() + 20.0
+            parent = cont_id = None
+            while _time.time() < deadline:
+                parent = s.get(rec["job_id"])
+                cont_id = (parent or {}).get("continuation_job_id")
+                if cont_id and s.get(cont_id)["status"] == "done":
+                    break
+                _time.sleep(0.02)
+            assert parent["status"] == "done"
+            assert cont_id, "no continuation enqueued"
+            cont = s.get(cont_id)
+            assert cont["status"] == "done"
+            # Durable linkage both ways.
+            assert cont["continuation_of"] == rec["job_id"]
+            assert cont["priority"] == "low"
+            assert executor.modes_run == ["progressive", "refine"]
+            while True:
+                try:
+                    frames.append(sub.get_nowait())
+                except Exception:  # noqa: BLE001 — queue drained
+                    break
+        finally:
+            s.stop()
+        m = s.metrics()
+        assert m["progressive_jobs_total"] == 1
+        assert m["continuations_enqueued_total"] == 1
+        assert m["continuations_completed_total"] == 1
+        assert m["continuations_cancelled_total"] == 0
+        assert m["continuations_shed_total"] == 0
+        # The JSONL story (what serve-admin trace reconstructs).
+        names = [e["event"] for e in _events(log)]
+        assert "continuation_enqueued" in names
+        assert "result_upgraded" in names
+        upgraded = [
+            e for e in _events(log) if e["event"] == "result_upgraded"
+        ][0]
+        assert upgraded["job_id"] == rec["job_id"]
+        assert upgraded["continuation_job_id"] == cont_id
+        assert upgraded["pac_error_bound"] == 0.0
+        assert upgraded["fingerprint"] == "fp-refine-1"
+
+    def test_parent_done_frame_says_upgrade_pending(self, tmp_path):
+        """The parent's job_done SSE frame keeps the channel open
+        (terminal=False + upgrade_pending) and the terminal frame is
+        the continuation's result_upgraded."""
+        s = _mk_scheduler(tmp_path)
+        try:
+            rec = s.submit(_prog_spec(), _x())
+            sub = s.bus.subscribe(rec["job_id"])
+            s._execute(rec["job_id"])  # parent; enqueues continuation
+            cont_id = s.get(rec["job_id"])["continuation_job_id"]
+            s._execute(cont_id)  # the refinement
+            frames = []
+            while True:
+                try:
+                    frames.append(sub.get_nowait())
+                except Exception:  # noqa: BLE001 — queue drained
+                    break
+            by_name = {f["event"]: f for f in frames}
+            assert by_name["job_done"]["terminal"] is False
+            assert by_name["job_done"]["upgrade_pending"] is True
+            assert (
+                by_name["job_done"]["continuation_job_id"] == cont_id
+            )
+            assert by_name["result_upgraded"]["terminal"] is True
+            order = [f["event"] for f in frames]
+            assert order.index("continuation_enqueued") < order.index(
+                "job_done"
+            ) < order.index("result_upgraded")
+        finally:
+            s.stop()
+
+    def test_cancel_on_done_parent_refunds_continuation(self, tmp_path):
+        """Cancel forwarding (satellite c): a cancel POSTed on the DONE
+        parent cancels the still-queued continuation BEFORE execution —
+        the refund path — and the continuation never runs."""
+        executor = _ProgStubExecutor()
+        s = _mk_scheduler(tmp_path, executor)
+        try:
+            rec = s.submit(_prog_spec(), _x())
+            # Worker not started: the continuation stays queued.
+            s._execute(rec["job_id"])
+            parent = s.get(rec["job_id"])
+            cont_id = parent["continuation_job_id"]
+            assert s.get(cont_id)["status"] == "queued"
+            out = s.cancel(rec["job_id"], reason="client_cancel")
+            assert out["status"] == "done"  # the parent stays done
+            cont = s.get(cont_id)
+            assert cont["status"] == "cancelled"
+            assert "before execution" in cont["error"]
+            assert executor.modes_run == ["progressive"]
+            m = s.metrics()
+            assert m["continuations_cancelled_total"] == 1
+            assert m["jobs_cancelled_total"] == 1
+        finally:
+            s.stop()
+
+    def test_continuation_shed_leaves_parent_done(self, tmp_path):
+        """A continuation refused at admission is counted as shed and
+        the parent is still a complete, DONE answer (the banded
+        estimate IS the answer; exactness is best-effort)."""
+
+        class _NoPlanStub(_ProgStubExecutor):
+            def run(self, spec, x, progress_cb=None, **kwargs):
+                self.run_count += 1
+                self.modes_run.append(spec.mode)
+                return {"seed": spec.seed}  # no best_k/h_effective
+
+        s = _mk_scheduler(tmp_path, _NoPlanStub())
+        try:
+            rec = s.submit(_prog_spec(), _x())
+            s._execute(rec["job_id"])
+            parent = s.get(rec["job_id"])
+            assert parent["status"] == "done"
+            assert "continuation_job_id" not in parent
+            assert s.metrics()["continuations_shed_total"] == 1
+        finally:
+            s.stop()
+
+    def test_crash_between_estimate_done_and_pickup(self, tmp_path):
+        """Chaos pin (satellite c): worker dies AFTER the parent's
+        estimate completed and its continuation was enqueued, BEFORE
+        the continuation was picked up.  A restarted worker (same
+        restart-stable worker_id, shared store) reconciles the orphan
+        through the ordinary lease machinery, runs it, and still
+        settles the parent's story."""
+        log_b = tmp_path / "events-b.jsonl"
+        store_dir = str(tmp_path / "store")
+        a = Scheduler(
+            _ProgStubExecutor(), JobStore(store_dir),
+            leases=True, worker_id="w1",
+        )
+        rec = a.submit(_prog_spec(seed=5), _x())
+        a._execute(rec["job_id"])  # estimate done, continuation queued
+        cont_id = a.get(rec["job_id"])["continuation_job_id"]
+        assert a.get(cont_id)["status"] == "queued"
+        # "Crash": scheduler A is simply abandoned — never started, so
+        # no worker thread holds anything; its live lease on the queued
+        # continuation is exactly what the restart must reclaim.
+        executor_b = _ProgStubExecutor()
+        b = Scheduler(
+            executor_b, JobStore(store_dir),
+            leases=True, worker_id="w1",
+            events=EventLog(str(log_b)),
+        )
+        b.start()
+        try:
+            import time as _time
+
+            deadline = _time.time() + 20.0
+            while _time.time() < deadline:
+                cont = b.get(cont_id)
+                if cont and cont["status"] == "done":
+                    break
+                _time.sleep(0.02)
+            assert cont["status"] == "done"
+            assert cont["continuation_of"] == rec["job_id"]
+            assert executor_b.modes_run == ["refine"]
+        finally:
+            b.stop()
+        names = [e["event"] for e in _events(log_b)]
+        assert "job_requeued" in names
+        assert "result_upgraded" in names
+        upgraded = [
+            e for e in _events(log_b)
+            if e["event"] == "result_upgraded"
+        ][0]
+        assert upgraded["job_id"] == rec["job_id"]
+        assert upgraded["continuation_job_id"] == cont_id
+
+    def test_estimate_frames_carry_band(self, tmp_path):
+        """Satellite (a): k_batch_complete frames for estimate AND
+        progressive jobs carry the DKW band fields."""
+        log = tmp_path / "events.jsonl"
+        s = _mk_scheduler(
+            tmp_path, _ProgStubExecutor(), events=EventLog(str(log)),
+        )
+
+        class _KStub(_ProgStubExecutor):
+            def run(self, spec, x, progress_cb=None, **kwargs):
+                self.run_count += 1
+                self.modes_run.append(spec.mode)
+                if progress_cb is not None:
+                    for k in spec.k_values:
+                        progress_cb(k, 0.25)
+                return {
+                    "seed": spec.seed, "best_k": 2,
+                    "h_effective": int(spec.n_iterations),
+                    "result_fingerprint": f"fp-{spec.mode}",
+                }
+
+        s.executor = _KStub()
+        for mode in ("estimate", "progressive", "exact"):
+            spec = JobSpec(
+                k_values=(2, 3), n_iterations=16, seed=1, mode=mode,
+                n_pairs=32 if mode != "exact" else None,
+            )
+            rec = s.submit(spec, _x())
+            s._execute(rec["job_id"])
+        s.stop()
+        k_frames = [
+            e for e in _events(log) if e["event"] == "k_batch_complete"
+        ]
+        assert len(k_frames) == 6
+        banded = [e for e in k_frames if "pac_error_bound" in e]
+        # estimate + progressive carry the band; exact does not.
+        assert len(banded) == 4
+        for e in banded:
+            assert e["n_pairs"] == 32
+            assert 0 < e["pac_error_bound"]
+            assert "cdf_epsilon" in e and "delta" in e

@@ -205,6 +205,11 @@ EXPECTED_METRICS_KEYS = frozenset(
         "fused_executions_total", "fused_jobs_total",
         "fusion_degraded_total", "jobs_cancelled_total",
         "sse_streams_total", "sse_cancels_total",
+        # Progressive serving (docs/SERVING.md "Progressive serving
+        # runbook"): parents admitted + continuation lifecycle.
+        "progressive_jobs_total", "continuations_enqueued_total",
+        "continuations_completed_total",
+        "continuations_cancelled_total", "continuations_shed_total",
     }
 )
 
