@@ -319,7 +319,7 @@ def packed_temp_phase(smoke: bool):
         ("dense", dense_pair_counts), ("packed", packed_pair_counts),
     ):
         plans[name] = compiled_memory_stats(
-            jax.jit(fn).lower(*structs).compile()
+            jax.jit(fn).lower(*structs).compile()  # jaxlint: disable=JL004 -- two distinct fns, one AOT jit each
         )
     ratio = plans["dense"]["temp_size_in_bytes"] / max(
         1, plans["packed"]["temp_size_in_bytes"]

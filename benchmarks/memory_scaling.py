@@ -116,7 +116,7 @@ def streaming_plan(n, h, h_block, accum_repr, k_values=(2, 3),
     stats = engine.compiled_memory_stats()
     # AOT lower+compile only, never executed; .compile() blocks on the
     # host, so the wall here is trace+compile.
-    stats["compile_seconds"] = round(time.perf_counter() - t0, 2)  # jaxlint: disable=JL007
+    stats["compile_seconds"] = round(time.perf_counter() - t0, 2)
     stats["packed_kernel"] = engine.packed_kernel
     return stats
 

@@ -10,16 +10,21 @@ Public surface:
 - :func:`lint_paths` / :func:`lint_file` — programmatic linting
 - :func:`main` — the CLI (also the ``jaxlint`` console script)
 - :class:`Finding`, :class:`Baseline` — the data model
-- :class:`Rule`, :func:`register`, :func:`all_rules` — extension API
-  (later PRs add rules by subclassing Rule in lint/rules.py)
+- :class:`Rule`, :class:`ProjectRule`, :func:`register`,
+  :func:`all_rules`, :func:`select_rules` — extension API (per-file
+  rules live in lint/rules.py and lint/packs.py; cross-file contract
+  rules in lint/contracts.py)
 """
 
 from consensus_clustering_tpu.lint.findings import Baseline, Finding
 from consensus_clustering_tpu.lint.registry import (
+    RULE_PACKS,
     ModuleContext,
+    ProjectRule,
     Rule,
     all_rules,
     register,
+    select_rules,
 )
 from consensus_clustering_tpu.lint.runner import (
     lint_file,
@@ -31,9 +36,12 @@ __all__ = [
     "Baseline",
     "Finding",
     "ModuleContext",
+    "ProjectRule",
+    "RULE_PACKS",
     "Rule",
     "all_rules",
     "register",
+    "select_rules",
     "lint_file",
     "lint_paths",
     "main",

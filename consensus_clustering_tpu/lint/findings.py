@@ -25,16 +25,21 @@ entry for a pattern grandfathers exactly one occurrence of it.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Tuple
 
 BASELINE_VERSION = 1
 
-# ``# jaxlint: disable=JL001`` / ``disable=JL001,JL007`` / ``disable=all``;
-# anything after the ID list (e.g. a ``-- why`` justification) is ignored.
+# A ``jaxlint: disable=JL001`` comment / ``disable=JL001,JL007`` /
+# ``disable=all``; anything after the ID list (e.g. a ``-- why``
+# justification) is ignored.  Matched against COMMENT tokens only (see
+# :func:`suppressions_for_source`), so the pattern may safely appear in
+# docstrings, string fixtures and prose without registering.
 _SUPPRESS_RE = re.compile(
     r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)"
 )
@@ -65,6 +70,15 @@ class Finding:
         }
 
 
+def _parse_ids(raw: str) -> set:
+    ids = {tok.strip().upper() for tok in raw.split(",") if tok.strip()}
+    # A trailing justification without a comma separator may glue to
+    # the last ID ("JL007 -- why" splits fine; "JL007 why" would
+    # not) — keep only tokens that look like rule IDs or 'all'.
+    ids = {t.split()[0] for t in ids if t}
+    return {t for t in ids if t == "ALL" or re.fullmatch(r"JL\d{3}", t)}
+
+
 def suppressions_for_source(source: str) -> Dict[int, set]:
     """Map 1-based line number -> set of suppressed rule IDs on that line.
 
@@ -72,26 +86,38 @@ def suppressions_for_source(source: str) -> Dict[int, set]:
     consulted — a suppression comment must sit on the physical line the
     finding is reported at (for a multi-line statement, the statement's
     first line, which is where the ast anchors it).
+
+    Only genuine COMMENT tokens register: the pattern inside a docstring
+    or a string literal (this repo's own lint tests are full of those)
+    is prose, not a suppression — critical now that an unconsumed
+    suppression is itself a finding (JL000 stale-suppression).  If the
+    source does not tokenize (the runner only calls this after a
+    successful ``ast.parse``, but API callers may not), fall back to the
+    historical line-based scan rather than silently dropping
+    suppressions and inventing findings.
     """
     out: Dict[int, set] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(line)
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(source).readline)
+        )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = _parse_ids(m.group(1))
+                if ids:
+                    out[i] = ids
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
         if not m:
             continue
-        ids = {
-            tok.strip().upper()
-            for tok in m.group(1).split(",")
-            if tok.strip()
-        }
-        # A trailing justification without a comma separator may glue to
-        # the last ID ("JL007 -- why" splits fine; "JL007 why" would
-        # not) — keep only tokens that look like rule IDs or 'all'.
-        ids = {
-            t.split()[0] for t in ids if t
-        }
-        ids = {t for t in ids if t == "ALL" or re.fullmatch(r"JL\d{3}", t)}
+        ids = _parse_ids(m.group(1))
         if ids:
-            out[i] = ids
+            out.setdefault(tok.start[0], set()).update(ids)
     return out
 
 
@@ -104,9 +130,23 @@ def is_suppressed(finding: Finding, suppressions: Dict[int, set]) -> bool:
 
 @dataclass
 class Baseline:
-    """Multiset of grandfathered finding fingerprints."""
+    """Multiset of grandfathered finding fingerprints.
+
+    Each entry may carry an optional ``why`` — a one-line human
+    justification for why the finding is deliberate.  ``why`` is
+    documentation only: it never participates in matching, and
+    ``--write-baseline`` preserves the ``why`` of entries that survive
+    the rewrite (see :meth:`adopt_whys`).
+    """
 
     entries: List[Tuple[str, str, str]] = field(default_factory=list)
+    whys: List[str] = field(default_factory=list)  # parallel; "" = none
+
+    def __post_init__(self) -> None:
+        if len(self.whys) < len(self.entries):
+            self.whys.extend(
+                [""] * (len(self.entries) - len(self.whys))
+            )
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
@@ -121,29 +161,48 @@ class Baseline:
                 "with a 'findings' list)"
             )
         entries = []
+        whys = []
         for e in payload["findings"]:
             entries.append(
                 (str(e["rule"]), str(e["path"]), str(e.get("text", "")))
             )
-        return cls(entries)
+            whys.append(str(e.get("why", "")))
+        return cls(entries, whys)
 
     @classmethod
     def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
         return cls([f.fingerprint() for f in findings])
 
+    def adopt_whys(self, other: "Baseline") -> None:
+        """Carry justifications over from ``other`` for matching
+        fingerprints (multiset: each of other's whys is used once)."""
+        pool: Dict[Tuple[str, str, str], List[str]] = {}
+        for e, w in zip(other.entries, other.whys):
+            if w:
+                pool.setdefault(e, []).append(w)
+        for i, e in enumerate(self.entries):
+            if not self.whys[i] and pool.get(e):
+                self.whys[i] = pool[e].pop(0)
+
     def save(self, path: str) -> None:
+        records = []
+        for (r, p, t), w in sorted(
+            zip(self.entries, self.whys), key=lambda it: it[0]
+        ):
+            rec: Dict[str, str] = {"rule": r, "path": p, "text": t}
+            if w:
+                rec["why"] = w
+            records.append(rec)
         payload = {
             "version": BASELINE_VERSION,
             "note": (
                 "jaxlint grandfathered findings; matched by (rule, path, "
                 "source line text), not line numbers.  Goal state: empty "
                 "— fix the code or add a justified per-line suppression "
-                "instead of baselining new findings."
+                "instead of baselining new findings.  'why' is the "
+                "one-line justification for keeping an entry."
             ),
-            "findings": [
-                {"rule": r, "path": p, "text": t}
-                for r, p, t in sorted(self.entries)
-            ],
+            "findings": records,
         }
         tmp = path + ".tmp"
         with open(tmp, "w") as f:

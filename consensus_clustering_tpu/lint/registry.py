@@ -407,9 +407,25 @@ def tainted_names(ctx: ModuleContext, func: FunctionInfo) -> Set[str]:
 #: unpack/materialisation inside it re-erects the 32× HBM cost the
 #: representation removes.  Scope: the ``packed`` directory rule plus
 #: the two flat ops modules (``rules.PACKED_PATH_MODULES``).
+#: ``serve-concurrency``: the scheduler's multi-worker story rests on
+#: three discipline-only invariants that review passes keep re-finding
+#: by hand — every worker-path jobstore write goes through the
+#: ``self._fence(...)`` lease gate (or ``claim_orphan``'s token win),
+#: the lock order is queue-cond BEFORE ``_lock`` (never nested the
+#: other way), and every thread is a supervised daemon.
+#: ``import-hygiene``: the forensic/scheduling layer (obs/*, leases,
+#: fair-share, lint itself) is pinned stdlib-only so it works on a
+#: wedged host with no accelerator stack; PEP-562 lazy ``__init__``s
+#: must not eagerly import what they promise to defer.
+#: ``contract-sync``: prose/test catalogues that must track code —
+#: the events docstring catalogue, the metrics key pin, and the
+#: slow-mark rule protecting the tier-1 time budget.
 RULE_PACKS: Dict[str, Tuple[str, ...]] = {
     "estimator": ("JL009",),
     "packed": ("JL010",),
+    "serve-concurrency": ("JL011", "JL012", "JL013"),
+    "import-hygiene": ("JL014", "JL015"),
+    "contract-sync": ("JL016", "JL017", "JL018"),
 }
 
 
@@ -422,6 +438,43 @@ def in_pack_scope(path: str, pack: str) -> bool:
     return pack in _re.split(r"[\\/]+", path)
 
 
+def path_components(path: str) -> List[str]:
+    """Forward/back-slash agnostic path split, for rule self-scoping."""
+    import re as _re
+
+    return [c for c in _re.split(r"[\\/]+", path) if c]
+
+
+def select_rules(packs: Optional[List[str]]) -> List["Rule"]:
+    """Resolve ``--pack`` selections to rule instances.
+
+    ``None``/empty and ``all`` both mean every registered rule (the
+    historical default).  ``core`` means the rules not claimed by any
+    pack (the universal JAX-hazard set plus JL000).  Unknown pack names
+    raise ``KeyError``.
+    """
+    rules = all_rules()
+    if not packs or "all" in packs:
+        return rules
+    packed_ids = {rid for ids in RULE_PACKS.values() for rid in ids}
+    wanted: Set[str] = set()
+    for pack in packs:
+        if pack == "core":
+            wanted |= {r.id for r in rules if r.id not in packed_ids}
+        elif pack in RULE_PACKS:
+            wanted |= set(RULE_PACKS[pack])
+        else:
+            raise KeyError(pack)
+    return [r for r in rules if r.id in wanted]
+
+
+def pack_of(rule_id: str) -> Optional[str]:
+    for pack, ids in RULE_PACKS.items():
+        if rule_id in ids:
+            return pack
+    return None
+
+
 # -- rule registry ----------------------------------------------------------
 
 class Rule:
@@ -431,9 +484,36 @@ class Rule:
     id: str = ""
     name: str = ""
     summary: str = ""
+    #: Project rules see every linted module at once (cross-file
+    #: contracts); the runner calls :meth:`check_project` after the
+    #: per-file pass instead of :meth:`check`.
+    project: bool = False
 
     def check(self, ctx: ModuleContext) -> List[Finding]:
         raise NotImplementedError
+
+    def check_project(
+        self, contexts: List[ModuleContext]
+    ) -> List[Finding]:
+        return []
+
+
+class ProjectRule(Rule):
+    """A rule over the whole linted file set at once.
+
+    Cross-file contracts (an emit site in one module vs a catalogue in
+    another) cannot be checked per-file.  Subclasses implement
+    :meth:`check_project`; :meth:`check` is a no-op so project rules
+    are harmless if handed to the per-file path.  A project rule MUST
+    return ``[]`` when its contract anchors are absent from the file
+    set (someone linting a single file is not asserting the repo has no
+    catalogue) — prefer missing a finding over inventing one.
+    """
+
+    project = True
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        return []
 
 
 _REGISTRY: Dict[str, Type[Rule]] = {}
@@ -448,10 +528,40 @@ def register(cls: Type[Rule]) -> Type[Rule]:
     return cls
 
 
+@register
+class StaleSuppression(Rule):
+    """JL000 — synthesized by the runner, not by :meth:`check`.
+
+    A ``jaxlint: disable=JL0xx`` comment whose rule no longer fires on
+    that line is dead armor: it documents a hazard that is not there
+    and will silently swallow a FUTURE real finding of that rule on
+    that line.  The runner (``lint_paths``) emits JL000 for every
+    explicitly-named rule ID that was run but produced nothing to
+    suppress on that line; registered here so it appears in
+    ``--list-rules``, participates in the baseline, and can itself be
+    silenced by adding ``JL000`` to the line's ID list.  ``disable=all``
+    is exempt (a blanket gesture carries no per-rule claim to go
+    stale).
+    """
+
+    id = "JL000"
+    name = "stale-suppression"
+    summary = (
+        "a per-line suppression names a rule that no longer fires there"
+    )
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        return []
+
+
 def all_rules() -> List[Rule]:
     """Instantiate every registered rule, sorted by ID."""
-    # Importing the rules module is what populates the registry; done
+    # Importing the rule modules is what populates the registry; done
     # lazily here so `from lint.registry import Rule` never cycles.
     from consensus_clustering_tpu.lint import rules as _rules  # noqa: F401
+    from consensus_clustering_tpu.lint import packs as _packs  # noqa: F401
+    from consensus_clustering_tpu.lint import (  # noqa: F401
+        contracts as _contracts,
+    )
 
     return [_REGISTRY[rid]() for rid in sorted(_REGISTRY)]

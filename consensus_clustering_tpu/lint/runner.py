@@ -16,7 +16,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import Iterable, Iterator, List, Optional, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 from consensus_clustering_tpu.lint.findings import (
     Baseline,
@@ -24,7 +24,13 @@ from consensus_clustering_tpu.lint.findings import (
     is_suppressed,
     suppressions_for_source,
 )
-from consensus_clustering_tpu.lint.registry import ModuleContext, all_rules
+from consensus_clustering_tpu.lint.registry import (
+    RULE_PACKS,
+    ModuleContext,
+    all_rules,
+    pack_of,
+    select_rules,
+)
 from consensus_clustering_tpu.lint.reporters import (
     report_json,
     report_text,
@@ -68,23 +74,19 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
             raise FileNotFoundError(path)
 
 
-def lint_file(
-    path: str, rules=None
-) -> Tuple[List[Finding], List[Finding], Optional[str]]:
-    """Lint one file: returns (active, suppressed, error).
-
-    ``error`` is a human-readable parse failure; an unparseable file
-    yields no findings but must still fail the run (a syntax error in a
-    scanned tree is never 'clean').
-    """
-    if rules is None:
-        rules = all_rules()
+def _analyze_file(path: str, rules):
+    """Per-file pass: returns (active, suppressed, error, ctx,
+    suppressions).  ``ctx``/``suppressions`` are None for unparseable
+    files."""
     with open(path, encoding="utf-8") as f:
         source = f.read()
     try:
         ctx = ModuleContext(path, source)
     except SyntaxError as e:
-        return [], [], f"{path}:{e.lineno}: syntax error: {e.msg}"
+        return (
+            [], [], f"{path}:{e.lineno}: syntax error: {e.msg}",
+            None, None,
+        )
     suppressions = suppressions_for_source(source)
     active: List[Finding] = []
     suppressed: List[Finding] = []
@@ -103,7 +105,71 @@ def lint_file(
                 suppressed.append(finding)
             else:
                 active.append(finding)
-    return active, suppressed, None
+    return active, suppressed, None, ctx, suppressions
+
+
+def lint_file(
+    path: str, rules=None
+) -> Tuple[List[Finding], List[Finding], Optional[str]]:
+    """Lint one file with the per-file rules: returns (active,
+    suppressed, error).
+
+    ``error`` is a human-readable parse failure; an unparseable file
+    yields no findings but must still fail the run (a syntax error in a
+    scanned tree is never 'clean').  Project rules (cross-file
+    contracts) and stale-suppression synthesis need the whole file set
+    and run in :func:`lint_paths` only.
+    """
+    if rules is None:
+        rules = all_rules()
+    active, suppressed, err, _, _ = _analyze_file(path, rules)
+    return active, suppressed, err
+
+
+def _stale_suppressions(
+    contexts: Dict[str, ModuleContext],
+    supp_by_path: Dict[str, Dict[int, set]],
+    suppressed: List[Finding],
+    ran_rule_ids: set,
+) -> List[Finding]:
+    """Synthesize JL000 findings for explicitly-named rule IDs that
+    were RUN this invocation but suppressed nothing on their line.
+
+    ``disable=all`` is exempt (no per-rule claim to go stale), rules
+    excluded by ``--pack`` are exempt (we cannot know), and a line that
+    also names JL000 opts out of staleness reporting entirely.
+    """
+    consumed: Dict[Tuple[str, int], set] = {}
+    for f in suppressed:
+        consumed.setdefault((f.path, f.line), set()).add(f.rule)
+    out: List[Finding] = []
+    for path in sorted(supp_by_path):
+        ctx = contexts[path]
+        for line in sorted(supp_by_path[path]):
+            ids = supp_by_path[path][line]
+            if "JL000" in ids:
+                continue
+            used = consumed.get((path, line), set())
+            for rid in sorted(ids):
+                if rid == "ALL" or rid in used:
+                    continue
+                if rid not in ran_rule_ids:
+                    continue
+                out.append(Finding(
+                    rule="JL000",
+                    path=path,
+                    line=line,
+                    col=0,
+                    message=(
+                        f"stale suppression: {rid} no longer fires on "
+                        "this line — dead armor swallows the next real "
+                        f"{rid} finding here; remove the comment (or "
+                        "add JL000 to the list if the line is "
+                        "intentionally pre-armed)"
+                    ),
+                    text=ctx.line_text(line),
+                ))
+    return out
 
 
 def lint_paths(
@@ -112,21 +178,50 @@ def lint_paths(
     """Lint every .py under ``paths``.
 
     Returns (active, suppressed, errors, n_files); ``active`` has not
-    yet been partitioned against a baseline.
+    yet been partitioned against a baseline.  This is the full
+    pipeline: per-file rules, then project rules over the collected
+    module set, then stale-suppression synthesis (JL000) over every
+    suppression comment the run observed.
     """
     if rules is None:
         rules = all_rules()
+    per_file = [r for r in rules if not getattr(r, "project", False)]
+    project = [r for r in rules if getattr(r, "project", False)]
     active: List[Finding] = []
     suppressed: List[Finding] = []
     errors: List[str] = []
+    contexts: Dict[str, ModuleContext] = {}
+    supp_by_path: Dict[str, Dict[int, set]] = {}
     n_files = 0
     for path in iter_python_files(paths):
         n_files += 1
-        a, s, err = lint_file(path, rules)
+        a, s, err, ctx, supp = _analyze_file(path, per_file)
         active.extend(a)
         suppressed.extend(s)
         if err is not None:
             errors.append(err)
+        if ctx is not None:
+            contexts[path] = ctx
+            supp_by_path[path] = supp
+    ctx_list = [contexts[p] for p in sorted(contexts)]
+    seen = set()
+    for rule in project:
+        for finding in rule.check_project(ctx_list):
+            key = (finding.rule, finding.path, finding.line,
+                   finding.col, finding.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            if is_suppressed(
+                finding, supp_by_path.get(finding.path, {})
+            ):
+                suppressed.append(finding)
+            else:
+                active.append(finding)
+    ran_rule_ids = {r.id for r in rules}
+    active.extend(_stale_suppressions(
+        contexts, supp_by_path, suppressed, ran_rule_ids
+    ))
     return active, suppressed, errors, n_files
 
 
@@ -142,6 +237,17 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--json", action="store_true",
         help="emit the machine-readable JSON report instead of text",
+    )
+    parser.add_argument(
+        "--json-out", metavar="FILE", default=None,
+        help="also write the JSON report to FILE (CI artifact; the "
+        "text/stdout report is unaffected)",
+    )
+    parser.add_argument(
+        "--pack", action="append", default=None, metavar="PACK",
+        help="run only this rule pack (repeatable); 'all' = every "
+        "rule (the default), 'core' = the universal JAX-hazard rules "
+        f"outside any pack; packs: {', '.join(sorted(RULE_PACKS))}",
     )
     parser.add_argument(
         "--baseline", default=DEFAULT_BASELINE,
@@ -164,10 +270,20 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
 
 
 def run(args: argparse.Namespace) -> int:
-    rules = all_rules()
+    try:
+        rules = select_rules(getattr(args, "pack", None))
+    except KeyError as e:
+        print(
+            f"jaxlint: unknown pack: {e.args[0]} (known: "
+            f"{', '.join(sorted(RULE_PACKS))}, plus 'all' and 'core')",
+            file=sys.stderr,
+        )
+        return 2
     if args.list_rules:
         for rule in rules:
-            print(f"{rule.id} {rule.name}: {rule.summary}")
+            pack = pack_of(rule.id)
+            suffix = f"  [pack: {pack}]" if pack else ""
+            print(f"{rule.id} {rule.name}: {rule.summary}{suffix}")
         return 0
 
     paths = args.paths
@@ -189,7 +305,12 @@ def run(args: argparse.Namespace) -> int:
         return 2
 
     if args.write_baseline:
-        Baseline.from_findings(active).save(args.baseline)
+        fresh = Baseline.from_findings(active)
+        try:
+            fresh.adopt_whys(Baseline.load(args.baseline))
+        except (ValueError, KeyError, TypeError):
+            pass  # unreadable old baseline: write without whys
+        fresh.save(args.baseline)
         print(
             f"jaxlint: wrote {len(active)} finding(s) to {args.baseline}",
             file=sys.stderr,
@@ -206,6 +327,12 @@ def run(args: argparse.Namespace) -> int:
             return 2
         new, grandfathered = baseline.partition(active)
 
+    json_out = getattr(args, "json_out", None)
+    if json_out:
+        with open(json_out, "w") as f:
+            report_json(
+                new, grandfathered, suppressed, errors, n_files, f
+            )
     reporter = report_json if args.json else report_text
     reporter(new, grandfathered, suppressed, errors, n_files, sys.stdout)
     return 1 if new or errors else 0

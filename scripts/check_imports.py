@@ -52,6 +52,8 @@ EXPECTED_SUBPACKAGES = (
 # packed accumulation path lives here — both engines and the serving
 # admission gate import it.
 EXPECTED_MODULES = (
+    "consensus_clustering_tpu.lint.contracts",
+    "consensus_clustering_tpu.lint.packs",
     "consensus_clustering_tpu.ops.bitpack",
     "consensus_clustering_tpu.ops.pallas_coassoc",
 )

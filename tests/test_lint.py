@@ -18,6 +18,8 @@ from consensus_clustering_tpu.lint import (
     Baseline,
     all_rules,
     lint_file,
+    lint_paths,
+    select_rules,
 )
 from consensus_clustering_tpu.lint.runner import main as lint_main
 
@@ -230,9 +232,11 @@ def test_rule_does_not_fire(tmp_path, rule_id):
     ]
 
 
-def test_all_ten_rules_registered():
+def test_registry_is_complete():
+    # JL000 (stale-suppression, synthesized by the runner) plus the
+    # per-file/project rules JL001-JL018.
     ids = sorted(r.id for r in all_rules())
-    assert ids == [f"JL{i:03d}" for i in range(1, 11)]
+    assert ids == [f"JL{i:03d}" for i in range(0, 19)]
 
 
 def test_rule_packs_name_registered_rules():
@@ -243,6 +247,26 @@ def test_rule_packs_name_registered_rules():
         assert set(rule_ids_) <= ids, pack
     assert RULE_PACKS["estimator"] == ("JL009",)
     assert RULE_PACKS["packed"] == ("JL010",)
+    assert RULE_PACKS["serve-concurrency"] == ("JL011", "JL012", "JL013")
+    assert RULE_PACKS["import-hygiene"] == ("JL014", "JL015")
+    assert RULE_PACKS["contract-sync"] == ("JL016", "JL017", "JL018")
+
+
+def test_select_rules_resolves_packs():
+    every = {r.id for r in all_rules()}
+    assert {r.id for r in select_rules(None)} == every
+    assert {r.id for r in select_rules(["all"])} == every
+    assert {r.id for r in select_rules(["serve-concurrency"])} == {
+        "JL011", "JL012", "JL013",
+    }
+    assert {r.id for r in select_rules(["estimator", "packed"])} == {
+        "JL009", "JL010",
+    }
+    core = {r.id for r in select_rules(["core"])}
+    assert {"JL000", "JL001", "JL008"} <= core
+    assert core.isdisjoint({"JL009", "JL010", "JL011", "JL016", "JL018"})
+    with pytest.raises(KeyError):
+        select_rules(["no-such-pack"])
 
 
 # JL009 is directory-scoped (the estimator rule pack), so its fixtures
@@ -342,6 +366,684 @@ def test_jl010_clean_in_packed_modules(tmp_path):
 def test_jl010_silent_elsewhere(tmp_path):
     active = _lint_named_module(tmp_path, _JL010_FIRES, "other.py")
     assert "JL010" not in rule_ids(active)
+
+
+# ---------------------------------------------------------------------------
+# serve-concurrency / import-hygiene / contract-sync packs (JL011-JL018)
+#
+# These fixtures go through a raw writer (no _PRELUDE): the import-
+# hygiene rules care about the import list itself, so an implicit
+# `import jax` header would contaminate every clean case.
+
+
+def _lint_tree_file(tmp_path, source, relpath, rules=None):
+    """Write ``source`` verbatim at ``relpath`` under tmp_path and lint
+    just that file with the per-file rules."""
+    path = tmp_path.joinpath(*relpath.split("/"))
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    active, suppressed, error = lint_file(str(path), rules)
+    assert error is None, error
+    return active, suppressed
+
+
+def _write_tree(tmp_path, files):
+    """Seed a fixture tree for project-rule (cross-file) tests."""
+    for rel, src in files.items():
+        path = tmp_path.joinpath(*rel.split("/"))
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+
+
+# -- JL011: unfenced-store-write --------------------------------------------
+
+_JL011_FIRES = """
+import threading
+
+
+class Scheduler:
+    def start(self):
+        self._worker_thread = threading.Thread(
+            target=self._worker_loop, daemon=True
+        )
+        self._worker_thread.start()
+
+    def _worker_loop(self):
+        self.store.save_job("job", {"status": "running"})
+
+    def cancel(self, job_id):
+        # API-side write: no worker thread ever reaches cancel(), so
+        # the rule must stay quiet here.
+        self.store.delete_job(job_id)
+"""
+
+_JL011_CLEAN = """
+import threading
+
+
+class Scheduler:
+    def start(self):
+        self._worker_thread = threading.Thread(
+            target=self._worker_loop, daemon=True
+        )
+        self._worker_thread.start()
+
+    def _worker_loop(self):
+        self._execute("job")
+        self._reconcile()
+
+    def _execute(self, job_id):
+        self._fence(job_id, "save")
+        self.store.save_job(job_id, {"status": "running"})
+
+    def _reconcile(self):
+        if not self.leases.claim_orphan("orphan"):
+            return
+        self.store.delete_job("orphan")
+"""
+
+
+def test_jl011_fires_on_unfenced_worker_write(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL011_FIRES, "consensus_clustering_tpu/serve/sched.py"
+    )
+    hits = [f for f in active if f.rule == "JL011"]
+    assert len(hits) == 1, [(f.line, f.message) for f in active]
+    assert "save_job" in hits[0].message
+
+
+def test_jl011_fence_and_orphan_claim_are_clean(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL011_CLEAN, "consensus_clustering_tpu/serve/sched.py"
+    )
+    assert "JL011" not in rule_ids(active), [
+        (f.line, f.message) for f in active if f.rule == "JL011"
+    ]
+
+
+def test_jl011_suppressible(tmp_path):
+    src = _JL011_FIRES.replace(
+        'self.store.save_job("job", {"status": "running"})',
+        'self.store.save_job("job", {"status": "running"})'
+        "  # jaxlint: disable=JL011 -- first-writer-wins by design",
+    )
+    active, suppressed = _lint_tree_file(
+        tmp_path, src, "consensus_clustering_tpu/serve/sched.py"
+    )
+    assert "JL011" not in rule_ids(active)
+    assert "JL011" in rule_ids(suppressed)
+
+
+def test_jl011_silent_outside_serve(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL011_FIRES,
+        "consensus_clustering_tpu/estimator/sched.py",
+    )
+    assert "JL011" not in rule_ids(active)
+
+
+# -- JL012: lock-order-inversion --------------------------------------------
+
+_JL012_FIRES = """
+class Scheduler:
+    def kick(self, item):
+        with self._lock:
+            self._queue.put_nowait(item)
+"""
+
+_JL012_CLEAN = """
+class Scheduler:
+    def kick(self, item):
+        taken = self._queue.take_matching(item)  # queue first ...
+        with self._lock:                         # ... then the lock
+            self._depth += 1
+        return taken
+"""
+
+
+def test_jl012_fires_on_queue_call_under_lock(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL012_FIRES, "consensus_clustering_tpu/serve/s.py"
+    )
+    hits = [f for f in active if f.rule == "JL012"]
+    assert len(hits) == 1 and "put_nowait" in hits[0].message
+
+
+def test_jl012_sequential_order_is_clean(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL012_CLEAN, "consensus_clustering_tpu/serve/s.py"
+    )
+    assert "JL012" not in rule_ids(active)
+
+
+def test_jl012_suppressible(tmp_path):
+    src = _JL012_FIRES.replace(
+        "self._queue.put_nowait(item)",
+        "self._queue.put_nowait(item)  # jaxlint: disable=JL012",
+    )
+    active, suppressed = _lint_tree_file(
+        tmp_path, src, "consensus_clustering_tpu/serve/s.py"
+    )
+    assert "JL012" not in rule_ids(active)
+    assert "JL012" in rule_ids(suppressed)
+
+
+def test_jl012_silent_outside_serve(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL012_FIRES, "consensus_clustering_tpu/parallel/s.py"
+    )
+    assert "JL012" not in rule_ids(active)
+
+
+# -- JL013: unsupervised-thread ---------------------------------------------
+
+_JL013_FIRES = """
+import threading
+
+
+def start_worker(run):
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+"""
+
+_JL013_CLEAN = """
+import threading
+
+
+def start_worker(run):
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    u = threading.Thread(target=run)
+    u.daemon = False  # explicit decision, either way, is the point
+    u.start()
+    return t, u
+"""
+
+
+def test_jl013_fires_on_undecided_thread(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL013_FIRES, "consensus_clustering_tpu/serve/w.py"
+    )
+    assert len([f for f in active if f.rule == "JL013"]) == 1
+
+
+def test_jl013_daemon_kwarg_or_assignment_is_clean(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL013_CLEAN, "consensus_clustering_tpu/serve/w.py"
+    )
+    assert "JL013" not in rule_ids(active), [
+        (f.line, f.message) for f in active if f.rule == "JL013"
+    ]
+
+
+def test_jl013_suppressible(tmp_path):
+    src = _JL013_FIRES.replace(
+        "t = threading.Thread(target=run)",
+        "t = threading.Thread(target=run)  # jaxlint: disable=JL013",
+    )
+    active, suppressed = _lint_tree_file(
+        tmp_path, src, "consensus_clustering_tpu/serve/w.py"
+    )
+    assert "JL013" not in rule_ids(active)
+    assert "JL013" in rule_ids(suppressed)
+
+
+def test_jl013_silent_outside_serve(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL013_FIRES, "consensus_clustering_tpu/parallel/w.py"
+    )
+    assert "JL013" not in rule_ids(active)
+
+
+# -- JL014: stdlib-pin-violation --------------------------------------------
+
+_JL014_FIRES = """
+import json
+import numpy as np
+
+from jax import numpy as jnp
+
+
+def snapshot():
+    return json.dumps({})
+"""
+
+_JL014_CLEAN = """
+import json
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    import numpy as np
+
+
+def load(path):
+    import numpy as np
+
+    return np.load(path)
+"""
+
+
+def test_jl014_fires_in_stdlib_pinned_dirs(tmp_path):
+    for rel in (
+        "consensus_clustering_tpu/obs/snap.py",
+        "consensus_clustering_tpu/serve/sched/snap.py",
+        "consensus_clustering_tpu/lint/snap.py",
+    ):
+        active, _ = _lint_tree_file(tmp_path, _JL014_FIRES, rel)
+        hits = [f for f in active if f.rule == "JL014"]
+        assert len(hits) == 2, (rel, [(f.line, f.message) for f in active])
+
+
+def test_jl014_fires_on_pinned_file_suffix(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL014_FIRES, "consensus_clustering_tpu/serve/leases.py"
+    )
+    assert len([f for f in active if f.rule == "JL014"]) == 2
+
+
+def test_jl014_deferred_and_type_checking_imports_are_clean(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL014_CLEAN, "consensus_clustering_tpu/obs/snap.py"
+    )
+    assert "JL014" not in rule_ids(active)
+
+
+def test_jl014_suppressible(tmp_path):
+    src = _JL014_FIRES.replace(
+        "import numpy as np",
+        "import numpy as np  # jaxlint: disable=JL014",
+    ).replace(
+        "from jax import numpy as jnp",
+        "from jax import numpy as jnp  # jaxlint: disable=JL014",
+    )
+    active, suppressed = _lint_tree_file(
+        tmp_path, src, "consensus_clustering_tpu/obs/snap.py"
+    )
+    assert "JL014" not in rule_ids(active)
+    assert len([f for f in suppressed if f.rule == "JL014"]) == 2
+
+
+def test_jl014_silent_outside_pinned_set(tmp_path):
+    # serve/ at large is NOT stdlib-pinned (the scheduler imports the
+    # engines); only the named files and sched/ are.
+    active, _ = _lint_tree_file(
+        tmp_path, _JL014_FIRES, "consensus_clustering_tpu/serve/exec.py"
+    )
+    assert "JL014" not in rule_ids(active)
+
+
+def test_jl014_filename_is_not_a_directory_match(tmp_path):
+    # tests/test_lint.py has 'lint' nowhere as a DIRECTORY component;
+    # a file merely named lint.py must not be pinned.
+    active, _ = _lint_tree_file(tmp_path, _JL014_FIRES, "tools/lint.py")
+    assert "JL014" not in rule_ids(active)
+
+
+# -- JL015: eager-subpackage-import -----------------------------------------
+
+_JL015_FIRES = """
+import numpy as np
+import consensus_clustering_tpu.serve.admin
+
+_EXPORTS = {"admin": "consensus_clustering_tpu.serve.admin"}
+
+
+def __getattr__(name):
+    raise AttributeError(name)
+"""
+
+_JL015_CLEAN = """
+import importlib
+
+_EXPORTS = {"admin": "consensus_clustering_tpu.serve.admin"}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        return importlib.import_module(_EXPORTS[name])
+    raise AttributeError(name)
+"""
+
+
+def test_jl015_fires_on_eager_imports_in_lazy_init(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL015_FIRES, "consensus_clustering_tpu/serve/__init__.py"
+    )
+    hits = [f for f in active if f.rule == "JL015"]
+    # One for the heavy numpy import, one for eagerly importing a
+    # module _EXPORTS declares lazy.
+    assert len(hits) == 2, [(f.line, f.message) for f in active]
+    assert any("numpy" in f.message for f in hits)
+    assert any("_EXPORTS" in f.message for f in hits)
+
+
+def test_jl015_lazy_init_without_eager_imports_is_clean(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL015_CLEAN, "consensus_clustering_tpu/serve/__init__.py"
+    )
+    assert "JL015" not in rule_ids(active)
+
+
+def test_jl015_suppressible(tmp_path):
+    src = _JL015_FIRES.replace(
+        "import numpy as np",
+        "import numpy as np  # jaxlint: disable=JL015",
+    ).replace(
+        "import consensus_clustering_tpu.serve.admin",
+        "import consensus_clustering_tpu.serve.admin"
+        "  # jaxlint: disable=JL015",
+    )
+    active, suppressed = _lint_tree_file(
+        tmp_path, src, "consensus_clustering_tpu/serve/__init__.py"
+    )
+    assert "JL015" not in rule_ids(active)
+    assert len([f for f in suppressed if f.rule == "JL015"]) == 2
+
+
+def test_jl015_silent_without_getattr_or_outside_init(tmp_path):
+    # A non-lazy __init__ makes no deferral promise ...
+    src = _JL015_FIRES.replace(
+        "def __getattr__(name):", "def lookup(name):"
+    )
+    active, _ = _lint_tree_file(
+        tmp_path, src, "consensus_clustering_tpu/serve/__init__.py"
+    )
+    assert "JL015" not in rule_ids(active)
+    # ... and an ordinary module is out of scope entirely.
+    active, _ = _lint_tree_file(
+        tmp_path, _JL015_FIRES, "consensus_clustering_tpu/serve/mod.py"
+    )
+    assert "JL015" not in rule_ids(active)
+
+
+# -- JL018: unmarked-compile-bearing-test -----------------------------------
+
+_JL018_FIRES = """
+from consensus_clustering_tpu.api import run_sweep
+from consensus_clustering_tpu.parallel.streaming import StreamingSweep
+
+
+def test_sweep_end_to_end(x, cfg):
+    result = run_sweep(x, cfg)
+    assert result
+
+
+def test_engine_runs(x, cfg, clusterer):
+    engine = StreamingSweep(clusterer, cfg)
+    out = engine.run(x)
+    assert out
+"""
+
+_JL018_CLEAN = """
+import pytest
+
+from consensus_clustering_tpu.api import run_sweep
+from consensus_clustering_tpu.serve.executor import SweepExecutor
+
+
+@pytest.mark.slow
+def test_sweep_end_to_end(x, cfg):
+    assert run_sweep(x, cfg)
+
+
+def test_shapes_only(x, cfg):
+    # Construction is host-only; without .run()/.fit() nothing compiles
+    # (the test_progressive.py _shape_result pattern).
+    executor = SweepExecutor(cfg)
+    assert executor._shape_result(x)
+
+
+def test_driven_by_stub(x, cfg):
+    executor = _stub_executor(cfg)
+    assert run_sweep(x, cfg, executor=executor)
+
+
+def _stub_executor(cfg):
+    return object()
+"""
+
+
+def test_jl018_fires_on_unmarked_compile_tests(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL018_FIRES, "tests/test_snippet.py"
+    )
+    hits = [f for f in active if f.rule == "JL018"]
+    assert len(hits) == 2, [(f.line, f.message) for f in active]
+    assert any("run_sweep" in f.message for f in hits)
+    assert any("StreamingSweep" in f.message for f in hits)
+
+
+def test_jl018_slow_mark_stub_and_construction_are_clean(tmp_path):
+    active, _ = _lint_tree_file(
+        tmp_path, _JL018_CLEAN, "tests/test_snippet.py"
+    )
+    assert "JL018" not in rule_ids(active), [
+        (f.line, f.message) for f in active if f.rule == "JL018"
+    ]
+
+
+def test_jl018_module_pytestmark_exempts(tmp_path):
+    src = (
+        "import pytest\n\n"
+        "from consensus_clustering_tpu.api import run_sweep\n\n"
+        "pytestmark = pytest.mark.slow\n\n\n"
+        "def test_sweep(x, cfg):\n"
+        "    assert run_sweep(x, cfg)\n"
+    )
+    active, _ = _lint_tree_file(tmp_path, src, "tests/test_snippet.py")
+    assert "JL018" not in rule_ids(active)
+
+
+def test_jl018_class_level_slow_mark_exempts(tmp_path):
+    src = (
+        "import pytest\n\n"
+        "from consensus_clustering_tpu.api import run_sweep\n\n\n"
+        "@pytest.mark.slow\n"
+        "class TestSweep:\n"
+        "    def test_sweep(self, x, cfg):\n"
+        "        assert run_sweep(x, cfg)\n"
+    )
+    active, _ = _lint_tree_file(tmp_path, src, "tests/test_snippet.py")
+    assert "JL018" not in rule_ids(active)
+
+
+def test_jl018_suppressible(tmp_path):
+    src = _JL018_FIRES.replace(
+        "def test_sweep_end_to_end(x, cfg):",
+        "def test_sweep_end_to_end(x, cfg):"
+        "  # jaxlint: disable=JL018 -- lane-rebalanced, stays fast",
+    ).replace(
+        "def test_engine_runs(x, cfg, clusterer):",
+        "def test_engine_runs(x, cfg, clusterer):"
+        "  # jaxlint: disable=JL018",
+    )
+    active, suppressed = _lint_tree_file(
+        tmp_path, src, "tests/test_snippet.py"
+    )
+    assert "JL018" not in rule_ids(active)
+    assert len([f for f in suppressed if f.rule == "JL018"]) == 2
+
+
+def test_jl018_silent_outside_test_files(tmp_path):
+    active, _ = _lint_tree_file(tmp_path, _JL018_FIRES, "tests/snippet.py")
+    assert "JL018" not in rule_ids(active)
+
+
+# -- JL016: event-catalogue-drift (project rule) ----------------------------
+
+_EVENTS_CATALOGUE = '''"""Serve event reference.
+
+- ``job_submitted`` — accepted into the queue
+- ``job_deadend`` — never emitted anywhere (stale bullet)
+"""
+
+
+class EventLog:
+    def emit(self, name, **fields):
+        pass
+'''
+
+_EVENT_EMITTER = '''class Scheduler:
+    def submit(self, job):
+        self.events.emit("job_submitted", job_id=job)
+        self.events.emit("job_vanished", job_id=job)
+'''
+
+
+def _project_rules(rule_id):
+    return [r for r in all_rules() if r.id == rule_id]
+
+
+def test_jl016_reports_drift_both_directions(tmp_path):
+    _write_tree(tmp_path, {
+        "pkg/serve/events.py": _EVENTS_CATALOGUE,
+        "pkg/serve/scheduler.py": _EVENT_EMITTER,
+    })
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL016")
+    )
+    assert errors == []
+    assert {f.rule for f in active} == {"JL016"}
+    vanished = [f for f in active if "job_vanished" in f.message]
+    deadend = [f for f in active if "job_deadend" in f.message]
+    assert vanished and vanished[0].path.endswith("scheduler.py")
+    assert deadend and deadend[0].path.endswith("events.py")
+    # The in-sync event produces nothing.
+    assert not any("'job_submitted'" in f.message for f in active)
+
+
+def test_jl016_catalogue_alone_proves_no_dead_entries(tmp_path):
+    # Linting events.py by itself must not declare every event dead.
+    _write_tree(tmp_path, {"pkg/serve/events.py": _EVENTS_CATALOGUE})
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL016")
+    )
+    assert errors == [] and active == []
+
+
+def test_jl016_missing_catalogue_anchor_is_silent(tmp_path):
+    _write_tree(tmp_path, {"pkg/serve/scheduler.py": _EVENT_EMITTER})
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL016")
+    )
+    assert errors == [] and active == []
+
+
+def test_jl016_project_finding_respects_suppression(tmp_path):
+    emitter = _EVENT_EMITTER.replace(
+        'self.events.emit("job_vanished", job_id=job)',
+        'self.events.emit("job_vanished", job_id=job)'
+        "  # jaxlint: disable=JL016",
+    )
+    _write_tree(tmp_path, {
+        "pkg/serve/events.py": _EVENTS_CATALOGUE,
+        "pkg/serve/scheduler.py": emitter,
+    })
+    active, suppressed, _, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL016")
+    )
+    assert not any("job_vanished" in f.message for f in active)
+    assert any(
+        f.rule == "JL016" and "job_vanished" in f.message
+        for f in suppressed
+    )
+    # The other direction (stale bullet) is still active.
+    assert any("job_deadend" in f.message for f in active)
+
+
+# -- JL017: metrics-key-drift (project rule) --------------------------------
+
+_SCHED_METRICS = '''_EXECUTOR_COUNTER_ATTRS = {
+    "executor_started": "_n_started",
+    "executor_done": "_n_done",
+}
+
+
+class Scheduler:
+    def metrics(self):
+        with self._lock:
+            executor_counters = {
+                key: getattr(self, attr)
+                for key, attr in _EXECUTOR_COUNTER_ATTRS.items()
+            }
+            return {
+                "queue_depth": self._depth,
+                "running": self._running,
+                **executor_counters,
+            }
+'''
+
+_METRICS_PIN_IN_SYNC = """EXPECTED_METRICS_KEYS = frozenset({
+    "queue_depth",
+    "running",
+    "executor_started",
+    "executor_done",
+})
+"""
+
+_METRICS_PIN_DRIFTED = """EXPECTED_METRICS_KEYS = frozenset({
+    "queue_depth",
+    "retired",
+    "executor_started",
+    "executor_done",
+})
+"""
+
+
+def test_jl017_in_sync_pin_is_clean(tmp_path):
+    _write_tree(tmp_path, {
+        "pkg/serve/scheduler.py": _SCHED_METRICS,
+        "pkg/tests/test_serve.py": _METRICS_PIN_IN_SYNC,
+    })
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL017")
+    )
+    assert errors == [] and active == []
+
+
+def test_jl017_reports_drift_both_directions(tmp_path):
+    _write_tree(tmp_path, {
+        "pkg/serve/scheduler.py": _SCHED_METRICS,
+        "pkg/tests/test_serve.py": _METRICS_PIN_DRIFTED,
+    })
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL017")
+    )
+    assert errors == []
+    assert {f.rule for f in active} == {"JL017"}
+    unpinned = [f for f in active if "'running'" in f.message]
+    stale = [f for f in active if "'retired'" in f.message]
+    assert unpinned and unpinned[0].path.endswith("scheduler.py")
+    assert stale and stale[0].path.endswith("test_serve.py")
+    # Spread-resolved keys count as written: no false drift for them.
+    assert not any("executor_started" in f.message for f in active)
+
+
+def test_jl017_unresolvable_spread_disables_the_rule(tmp_path):
+    opaque = (
+        "class Scheduler:\n"
+        "    def metrics(self):\n"
+        '        return {"queue_depth": self._depth, **self._extra()}\n'
+    )
+    _write_tree(tmp_path, {
+        "pkg/serve/scheduler.py": opaque,
+        "pkg/tests/test_serve.py": _METRICS_PIN_DRIFTED,
+    })
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL017")
+    )
+    assert errors == [] and active == []
+
+
+def test_jl017_missing_anchor_is_silent(tmp_path):
+    # Scheduler present but no pin file in the linted set: a partial
+    # view must never assert repo-wide drift.
+    _write_tree(tmp_path, {"pkg/serve/scheduler.py": _SCHED_METRICS})
+    active, _, errors, _ = lint_paths(
+        [str(tmp_path / "pkg")], _project_rules("JL017")
+    )
+    assert errors == [] and active == []
 
 
 def test_finding_names_file_line_and_rule(tmp_path):
@@ -767,6 +1469,169 @@ def test_cli_subcommand_end_to_end(tmp_path):
         if line.startswith("import time:")
     }
     assert "jax" not in imported, "lint subcommand imported jax"
+
+
+# ---------------------------------------------------------------------------
+# JL000: stale-suppression synthesis (runner-level, via lint_paths)
+
+
+def test_stale_suppression_fires(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "import time\n\n\n"
+        "def f(x):\n"
+        "    return x + 1  # jaxlint: disable=JL007\n"
+    )
+    active, _, errors, _ = lint_paths([str(path)])
+    assert errors == []
+    jl0 = [f for f in active if f.rule == "JL000"]
+    assert len(jl0) == 1 and "JL007" in jl0[0].message
+    assert jl0[0].line == 5
+
+
+def test_live_suppression_is_not_stale(tmp_path):
+    src = _PRELUDE + CASES["JL001"]["fires"].replace(
+        "b = jax.random.uniform(key, (3,))",
+        "b = jax.random.uniform(key, (3,))  # jaxlint: disable=JL001",
+    )
+    path = tmp_path / "mod.py"
+    path.write_text(src)
+    active, suppressed, _, _ = lint_paths([str(path)])
+    assert "JL000" not in rule_ids(active)
+    assert "JL001" in rule_ids(suppressed)
+
+
+def test_stale_suppression_opt_out_and_all_exemption(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "def f(x):\n"
+        "    a = x + 1  # jaxlint: disable=JL007,JL000 -- pre-armed\n"
+        "    b = x + 2  # jaxlint: disable=all\n"
+        "    return a + b\n"
+    )
+    active, _, errors, _ = lint_paths([str(path)])
+    assert errors == []
+    assert "JL000" not in rule_ids(active)
+
+
+def test_stale_suppression_skips_rules_not_run(tmp_path):
+    # Under --pack estimator, JL007 never ran: its suppression cannot
+    # be judged stale.
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "def f(x):\n"
+        "    return x + 1  # jaxlint: disable=JL007\n"
+    )
+    active, _, _, _ = lint_paths([str(path)], select_rules(["estimator"]))
+    assert "JL000" not in rule_ids(active)
+
+
+def test_suppression_pattern_in_string_is_prose(tmp_path):
+    # The pattern inside a string literal is documentation, not a
+    # suppression — it must neither suppress nor read as stale armor.
+    path = tmp_path / "mod.py"
+    path.write_text(
+        'DOC = "silence with a jaxlint: disable=JL007 comment"\n'
+    )
+    active, suppressed, errors, _ = lint_paths([str(path)])
+    assert errors == []
+    assert active == [] and suppressed == []
+
+
+# ---------------------------------------------------------------------------
+# baseline whys, add-expire, --pack and --json-out
+
+
+def test_baseline_add_expire_roundtrip(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    baseline = str(tmp_path / "b.json")
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    with open(baseline) as f:
+        assert json.load(f)["findings"]
+    # Fix the hazard: the run is clean and a rewrite expires the entry.
+    path.write_text(_PRELUDE + CASES["JL001"]["clean"])
+    assert lint_main([str(path), "--baseline", baseline]) == 0
+    capsys.readouterr()
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    with open(baseline) as f:
+        assert json.load(f)["findings"] == []
+
+
+def test_write_baseline_preserves_whys(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    baseline = str(tmp_path / "b.json")
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    with open(baseline) as f:
+        payload = json.load(f)
+    assert payload["findings"]
+    for entry in payload["findings"]:
+        entry["why"] = "approved hazard"
+    with open(baseline, "w") as f:
+        json.dump(payload, f)
+    # A rewrite keeps the surviving entries' justifications.
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--write-baseline"]) == 0
+    capsys.readouterr()
+    with open(baseline) as f:
+        rewritten = json.load(f)["findings"]
+    assert rewritten and all(
+        e.get("why") == "approved hazard" for e in rewritten
+    )
+
+
+def test_why_never_participates_in_matching(tmp_path):
+    active, _ = lint_source(tmp_path, CASES["JL001"]["fires"])
+    baseline = Baseline.from_findings(active)
+    baseline.whys = ["because"] * len(baseline.entries)
+    new, grandfathered = baseline.partition(active)
+    assert new == [] and len(grandfathered) == len(active)
+
+
+def test_pack_flag_limits_rules(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    baseline = str(tmp_path / "b.json")
+    # JL001 is a core rule: an estimator-only run cannot see it ...
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--pack", "estimator"]) == 0
+    capsys.readouterr()
+    # ... while core (and the default all-rules run) does.
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--pack", "core"]) == 1
+    capsys.readouterr()
+    assert lint_main([str(path), "--baseline", baseline,
+                      "--pack", "all"]) == 1
+    capsys.readouterr()
+
+
+def test_unknown_pack_is_usage_error(tmp_path, capsys):
+    path = _write_clean(tmp_path)
+    assert lint_main([str(path), "--pack", "nope"]) == 2
+    err = capsys.readouterr().err
+    assert "unknown pack" in err and "serve-concurrency" in err
+
+
+def test_json_out_writes_ci_artifact(tmp_path, capsys):
+    path = _write_bad(tmp_path)
+    out_file = tmp_path / "lint-report.json"
+    rc = lint_main([
+        str(path), "--baseline", str(tmp_path / "b.json"),
+        "--json-out", str(out_file),
+    ])
+    text = capsys.readouterr().out
+    assert rc == 1
+    # stdout stays the human text report; the artifact is the JSON.
+    assert "JL001" in text and not text.lstrip().startswith("{")
+    payload = json.loads(out_file.read_text())
+    assert payload["version"] == 1
+    assert payload["summary"]["new"] >= 1
+    assert all(e["status"] in ("new", "baseline", "suppressed")
+               for e in payload["findings"])
 
 
 def test_repo_tree_is_lint_clean():

@@ -9,7 +9,6 @@ duck-typed obs-aware stub executor.  The live end-to-end proof is
 exposition/span checks ride the warm service fixture in test_serve.py.
 """
 
-import ast
 import glob
 import json
 import logging
@@ -1066,47 +1065,37 @@ class TestQueryEngine:
 # Events contract: every emitted name is catalogued, and vice versa
 
 
-def _emitted_event_names():
-    names = set()
-    # Recursive: the serve/sched subpackage's emissions (if any) are
-    # part of the same catalogue contract.
-    for path in glob.glob(
-        os.path.join(SERVE_DIR, "**", "*.py"), recursive=True
-    ):
-        tree = ast.parse(open(path).read(), filename=path)
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "emit"
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-            ):
-                names.add(node.args[0].value)
-    return names
-
-
-def _catalogued_event_names():
-    import re
-
-    return set(
-        re.findall(r"(?m)^- ``([a-z_]+)``", events_mod.__doc__)
-    )
-
-
 def test_event_catalogue_matches_emissions():
-    """Satellite: the events.py docstring catalogue and the event names
-    actually emitted anywhere in serve/ must be the SAME set — operator
-    docs cannot silently drift from the code in either direction."""
-    emitted = _emitted_event_names()
-    catalogued = _catalogued_event_names()
-    assert emitted, "AST scan found no emissions — scanner broken"
-    assert emitted - catalogued == set(), (
-        "events emitted but not documented in serve/events.py"
+    """The events.py docstring catalogue and the event names actually
+    emitted anywhere in serve/ must be the SAME set — operator docs
+    cannot silently drift from the code in either direction.
+
+    One implementation owns the contract: jaxlint's JL016
+    (lint/contracts.py) does the recursive AST scan this test used to
+    do ad hoc; here we just assert a clean JL016 run over serve/ plus
+    sanity-check that the scan saw real emissions (an empty catalogue
+    passing vacuously would hide a broken scanner).
+    """
+    from consensus_clustering_tpu.lint.contracts import (
+        EventCatalogueDrift,
     )
-    assert catalogued - emitted == set(), (
-        "events documented but never emitted"
+    from consensus_clustering_tpu.lint.registry import ModuleContext
+
+    contexts = []
+    for path in sorted(glob.glob(
+        os.path.join(SERVE_DIR, "**", "*.py"), recursive=True
+    )):
+        contexts.append(ModuleContext(path, open(path).read()))
+    rule = EventCatalogueDrift()
+    emitted = {
+        name
+        for ctx in contexts
+        for name, _ in rule._emit_calls(ctx)
+    }
+    assert emitted, "AST scan found no emissions — scanner broken"
+    findings = rule.check_project(contexts)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.message}" for f in findings
     )
 
 
