@@ -1,0 +1,190 @@
+"""Fused-block label-elimination evidence: compiled-plan A/B on CPU.
+
+The fused block megakernel (ops/pallas_fused_block.py) replaces the
+packed block step's label round-trip — per-lane ``(h_block, n_sub)``
+int32 labels written by the clusterer, gathered, and re-read by
+``pack_label_planes`` — with an in-kernel final assignment whose labels
+live only as per-lane VMEM vectors.  This script captures the claim the
+PR-13 way, as committed compiled-plan bytes on a CPU backend (zero
+accelerator seconds; the on-chip A/B rides the ROADMAP item-6 window):
+
+- XLA's static memory plan (arguments/outputs/peak temporaries) for the
+  streaming block executable at the ``packed_scaling`` record's shape,
+  ``fuse_block="off"`` vs ``"on"``;
+- a census of int32 buffers carrying the ``n_sub`` dimension in the
+  optimized HLO: the label-path instructions vanish from the fused
+  plan while the resample-index instructions (both paths need the
+  sample plan) remain.
+
+CPU caveat, stated in the record: with ``fuse_block="on"`` the kernel
+runs in interpret mode here, so its VMEM-resident working set (the
+distance tile, the one-hot GEMM operands) lowers to ordinary XLA temps
+— the ``temp_size_in_bytes`` delta is NOT the accelerator story; the
+instruction census is the backend-independent evidence.  Bit-identity
+of the two plans' RESULTS is the separate, stronger gate
+(tests/test_fused_block.py).
+
+Usage:  python benchmarks/fused_block_plan.py \
+            [--out benchmarks/fused_block/FUSED_BLOCK.json]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+if __name__ == "__main__":
+    # Pin the platform before any backend initialises (see
+    # memory_scaling.py — a wedged tunnel must not hang a CPU capture).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+# The packed_scaling record's shape family — one row, same knobs.
+SHAPE = dict(n=4096, d=16, h=64, h_block=32, k_values=(2, 3))
+
+
+def _block_lowered(fuse):
+    """(engine, lowered block step) at the record shape — the exact
+    call signature run() uses (mirrors compiled_memory_stats)."""
+    import jax
+    import jax.numpy as jnp
+
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.models.kmeans import KMeans
+    from consensus_clustering_tpu.parallel.streaming import StreamingSweep
+
+    config = SweepConfig(
+        n_samples=SHAPE["n"], n_features=SHAPE["d"],
+        k_values=SHAPE["k_values"], n_iterations=SHAPE["h"],
+        store_matrices=False, stream_h_block=SHAPE["h_block"],
+        accum_repr="packed", fuse_block=fuse,
+    )
+    engine = StreamingSweep(KMeans(n_init=1), config)
+    state_struct = {
+        name: jax.ShapeDtypeStruct(
+            shape, dtype, sharding=engine._state_shardings[name]
+        )
+        for name, (shape, dtype) in engine._state_shapes.items()
+    }
+    x_struct = jax.ShapeDtypeStruct(
+        (config.n_samples, config.n_features), jnp.dtype(config.dtype)
+    )
+    lowered = engine._step.lower(
+        state_struct, x_struct, jax.random.PRNGKey(0),
+        jnp.int32(0), jnp.int32(0),
+    )
+    return engine, config, lowered
+
+
+def _s32_census(hlo_text, n_sub):
+    """Instruction-occurrence counts of s32 shapes that carry the
+    ``n_sub`` dimension — the label/index buffer class.  Both paths
+    keep the resample indices; only the unfused path also carries
+    labels, their gather, and the label->plane scatter chain."""
+    counts = {}
+    for m in re.finditer(r"s32\[(\d+(?:,\d+)*)\]", hlo_text):
+        dims = m.group(1)
+        if str(n_sub) in dims.split(","):
+            counts[dims] = counts.get(dims, 0) + 1
+    return dict(sorted(counts.items()))
+
+
+def capture(fuse):
+    from consensus_clustering_tpu.parallel.sweep import (
+        compiled_memory_stats,
+    )
+
+    t0 = time.perf_counter()
+    engine, config, lowered = _block_lowered(fuse)
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+    stats = compiled_memory_stats(compiled)
+    stats["compile_seconds"] = round(compile_s, 2)
+    record = {
+        "fuse_block": fuse,
+        "resolved": engine.fuse_block,
+        "fused_kernel": engine.fused_kernel,
+        "packed_kernel": engine.packed_kernel,
+        "plan": stats,
+        "s32_n_sub_census": _s32_census(compiled.as_text(), config.n_sub),
+    }
+    return record, config
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="fused-block compiled-plan A/B (CPU, committed record)"
+    )
+    parser.add_argument(
+        "--out",
+        default=os.path.join(
+            _REPO, "benchmarks", "fused_block", "FUSED_BLOCK.json"
+        ),
+    )
+    args = parser.parse_args(argv)
+
+    unfused, config = capture("off")
+    fused, _ = capture("on")
+    n_sub = config.n_sub
+    hb = SHAPE["h_block"]
+    label_class = f"{hb},{n_sub}"
+    eliminated = (
+        unfused["s32_n_sub_census"].get(label_class, 0)
+        - fused["s32_n_sub_census"].get(label_class, 0)
+    )
+    record = {
+        "harness": "benchmarks/fused_block_plan.py",
+        "backend": "cpu",
+        "shape": {**SHAPE, "k_values": list(SHAPE["k_values"]),
+                  "n_sub": n_sub},
+        "unfused": unfused,
+        "fused": fused,
+        "label_buffer_elimination": {
+            "s32_shape": label_class,
+            "instructions_unfused": unfused["s32_n_sub_census"].get(
+                label_class, 0
+            ),
+            "instructions_fused": fused["s32_n_sub_census"].get(
+                label_class, 0
+            ),
+            "eliminated": eliminated,
+            # The roofline term the fusion strikes: one write + one
+            # read of int32 labels per lane per block.
+            "label_roundtrip_bytes_per_block": 2 * 4 * hb * n_sub,
+        },
+        "caveats": [
+            "cpu capture: fuse_block='on' runs the kernel in interpret "
+            "mode, so its VMEM working set lowers to XLA temps — "
+            "temp_size_in_bytes is not the accelerator story; the "
+            "instruction census is the backend-independent signal",
+            "on-chip A/B rides the ROADMAP item-6 evidence window "
+            "(tpu_kernel_check.py --json carries the fused_block lane "
+            "verdict)",
+        ],
+    }
+    assert eliminated > 0, (
+        "fused plan did not eliminate the label-class buffers — "
+        "the record would be vacuous; refusing to write it"
+    )
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"label-class s32[{label_class}] instructions: "
+        f"{record['label_buffer_elimination']['instructions_unfused']}"
+        f" -> {record['label_buffer_elimination']['instructions_fused']}"
+        f" (eliminated {eliminated}); record: {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

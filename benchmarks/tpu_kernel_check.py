@@ -10,7 +10,8 @@ exists to catch).  This script is the hardware gate: it compiles each
 kernel for the active accelerator and checks it against the same
 references the unit suite uses (histogram: bit-exact; Lloyd sums:
 f32-reduction-order tolerance, counts exact; popcount co-occurrence:
-bit-exact vs the lax path).
+bit-exact vs the lax path; fused assign+pack block step: bit-exact vs
+its pure-lax reference).
 
 Run on TPU:  python benchmarks/tpu_kernel_check.py --json VERDICT.json
 Exit code 0 = kernels proven on this backend; 1 = mismatch or crash.
@@ -230,6 +231,94 @@ def _check_coassoc(rng):
     return failures, record
 
 
+def _check_fused_block(rng):
+    """Compiled-mode verdict on the fused assign+pack kernel
+    (ops/pallas_fused_block.py).  Reference is the pure-lax
+    ``fused_planes_reference`` — bit-identity is the contract, exactly
+    as for the popcount lane.  A gate-off crash is the documented
+    degrade (jobs run the unfused label path, disclosed in timing as
+    ``fuse_block=unfused``), not a harness failure."""
+    from consensus_clustering_tpu.ops.bitpack import (
+        pack_cosample_planes,
+        packed_width,
+    )
+    from consensus_clustering_tpu.ops.pallas_fused_block import (
+        fused_assign_pack,
+        fused_block_available,
+        fused_planes_reference,
+    )
+
+    failures = 0
+    first_error = None
+    degraded = None
+    cases = [
+        (300, 7, 5, 13, 3, 4),    # the probe's ragged multi-tile grid
+        (128, 4, 3, 8, 0, 2),     # exact tile boundary
+        (517, 20, 8, 29, 37, 8),  # k == k_max, word-crossing row0
+        (77, 3, 4, 5, 2, 3),      # sub-tile
+    ]
+    for n_cols, d, k_max, lanes, row0, k in cases:
+        x_cols = rng.normal(size=(n_cols, d)).astype(np.float32)
+        cents = rng.normal(size=(lanes, k_max, d)).astype(np.float32)
+        n_sub = max(2, int(0.8 * n_cols))
+        idx = np.stack([
+            np.sort(
+                rng.permutation(n_cols)[:n_sub]
+            ).astype(np.int32) for _ in range(lanes)
+        ])
+        if lanes > 1:
+            idx[-1] = -1  # an invalid (h >= h_total) lane drops out
+        n_words = packed_width(row0 + lanes + 3)
+        cop = pack_cosample_planes(
+            jnp.asarray(idx), n_cols, n_words=n_words, row0=row0
+        )
+        args = (
+            jnp.asarray(x_cols), jnp.asarray(cents),
+            jnp.asarray(k, jnp.int32), cop,
+            jnp.asarray(row0, jnp.int32),
+        )
+        want = np.asarray(fused_planes_reference(*args, n_words=n_words))
+        try:
+            got = np.asarray(fused_assign_pack(
+                *args, n_words=n_words, interpret=False
+            ))
+        except Exception as exc:  # noqa: BLE001 — report, keep checking
+            gate = fused_block_available()
+            if not gate:
+                print(f"lax  fused_block n={n_cols} lanes={lanes}: "
+                      f"{type(exc).__name__}: {exc}")
+                print("     (probe gate verdict: "
+                      "fused_block_available()=False — jobs keep the "
+                      "unfused label path, disclosed as "
+                      "fuse_block=unfused)")
+                degraded = degraded or exc
+                break
+            print(f"FAIL fused_block n={n_cols} lanes={lanes}: "
+                  f"{type(exc).__name__}: {exc}")
+            print(f"     (probe gate says the kernel IS available "
+                  f"(fused_block_available()={gate}) yet the compiled "
+                  "call failed — a real verdict failure)")
+            failures += 1
+            first_error = first_error or exc
+            continue
+        if got.tobytes() == want.tobytes():
+            print(f"ok   fused_block n={n_cols} d={d} k={k}/{k_max} "
+                  f"lanes={lanes} row0={row0}")
+        else:
+            print(f"FAIL fused_block n={n_cols} lanes={lanes}: "
+                  "kernel != reference")
+            failures += 1
+    record = _lane_record(len(cases), failures, first_error)
+    record["probe_gate"] = bool(fused_block_available())
+    if failures:
+        record["degrade"] = "unfused"
+    elif degraded is not None:
+        record["verdict"] = "lax"
+        record["error_class"] = type(degraded).__name__
+        record["error"] = str(degraded)
+    return failures, record
+
+
 def _write_verdict(path, record) -> None:
     with open(path, "w") as f:
         json.dump(record, f, indent=1, sort_keys=True)
@@ -264,7 +353,7 @@ def main(argv=None) -> int:
               "applicable (unit suite covers interpret mode)")
         # Jobs on this backend run the lax paths behind the probe
         # gates: the honest lane verdict is the degrade, not a pass.
-        for lane in ("hist", "lloyd", "coassoc"):
+        for lane in ("hist", "lloyd", "coassoc", "fused_block"):
             record["lanes"][lane] = {
                 "verdict": "lax", "cases": 0, "failures": 0,
                 "error_class": None,
@@ -279,6 +368,7 @@ def main(argv=None) -> int:
         ("hist", _check_hist),
         ("lloyd", _check_lloyd),
         ("coassoc", _check_coassoc),
+        ("fused_block", _check_fused_block),
     ):
         lane_failures, lane_record = check(rng)
         failures += lane_failures

@@ -209,10 +209,10 @@ class ConsensusClustering:
         packed planes, materialising int32 ``Mij``/``Iij`` row tiles at
         evaluate/finalize boundaries.  Results are bit-identical to
         ``'dense'`` at every shape (the tested parity gate); the knob
-        never changes the statistic.  ``timing_['packed_kernel']``
-        discloses whether the fused Pallas popcount kernel or the lax
-        fallback ran.  Ignored (with a log message) for host-backend
-        clusterers.
+        never changes the statistic.  ``metrics_['timing']``
+        discloses which kernel paths ran (``packed_kernel``, and with
+        ``fuse_block`` the ``fuse_block``/``fused_kernel`` keys).
+        Ignored (with a log message) for host-backend clusterers.
     adaptive_tol : float, keyword-only, optional
         With ``stream_h_block``: stop the stream early once every K's
         PAC moved less than this for ``adaptive_patience`` consecutive
@@ -332,6 +332,7 @@ class ConsensusClustering:
         stream_h_block: Optional[int] = None,
         accum_repr: str = "dense",
         use_packed_kernel: Optional[bool] = None,
+        fuse_block: str = "auto",
         adaptive_tol: Optional[float] = None,
         adaptive_patience: int = 2,
         adaptive_min_h: int = 0,
@@ -416,6 +417,9 @@ class ConsensusClustering:
 
         self.accum_repr = validate_accum_repr(accum_repr)
         self.use_packed_kernel = use_packed_kernel
+        from consensus_clustering_tpu.config import validate_fuse_block
+
+        self.fuse_block = validate_fuse_block(fuse_block)
         self.adaptive_tol = adaptive_tol
         self.adaptive_patience = adaptive_patience
         self.adaptive_min_h = adaptive_min_h
@@ -690,6 +694,7 @@ class ConsensusClustering:
             stream_h_block=stream_h_block,
             accum_repr=self.accum_repr,
             use_packed_kernel=self.use_packed_kernel,
+            fuse_block=self.fuse_block,
             adaptive_tol=self.adaptive_tol,
             adaptive_patience=self.adaptive_patience,
             adaptive_min_h=self.adaptive_min_h,
@@ -1026,6 +1031,7 @@ class ConsensusClustering:
             # bit-plane masks instead of the (h_block, N) label
             # scatter — counts bit-identical (ops/bitpack exactness).
             accum_repr=self.accum_repr,
+            fuse_block=self.fuse_block,
             dtype=self.compute_dtype,
         )
         from consensus_clustering_tpu.utils.metrics import MetricsLogger
@@ -1327,6 +1333,16 @@ class ConsensusClustering:
             mem = timings[-1].get("device_memory")
             if mem:
                 self.metrics_["device_memory"] = mem
+            # Execution-strategy disclosures (never semantic): which
+            # kernel path actually ran.  Last batch headlines — every
+            # batch of one fit resolves the same gates.
+            strategy = {
+                key: timings[-1][key]
+                for key in ("packed_kernel", "fuse_block", "fused_kernel")
+                if key in timings[-1]
+            }
+            if strategy:
+                self.metrics_["timing"] = strategy
         else:
             # Fully resumed: no compute ran, so there is no rate — None,
             # not inf (json.dumps would emit the non-standard `Infinity`).

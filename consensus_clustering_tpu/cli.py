@@ -174,6 +174,7 @@ def cmd_run(args):
         use_packed_kernel={
             "auto": None, "on": True, "off": False
         }[args.packed_kernel],
+        fuse_block=args.fuse_block,
         adaptive_tol=args.adaptive,
         adaptive_patience=args.adaptive_patience,
         adaptive_min_h=args.adaptive_min_h,
@@ -555,6 +556,14 @@ def main(argv=None):
                           "(auto; any Mosaic lowering failure degrades "
                           "to the lax path, disclosed in timing as "
                           "packed_kernel)")
+    run.add_argument("--fuse-block", choices=["auto", "on", "off"],
+                     default="auto",
+                     help="with --accum-repr packed: fuse the final "
+                          "Lloyd assignment and bit-plane packing into "
+                          "one Pallas kernel so per-lane labels never "
+                          "materialise in HBM (auto probes the backend "
+                          "and falls back to the label round-trip; "
+                          "disclosed in timing as fuse_block)")
     run.add_argument("--stream", type=int, default=0, metavar="H_BLOCK",
                      help="stream the sweep in compiled blocks of this "
                      "many resamples with device-resident accumulators "

@@ -67,6 +67,22 @@ SERVING_MODES = ESTIMATOR_MODES + ("progressive",)
 #:   HBM bytes, never the statistic.
 ACCUM_REPRS = ("dense", "packed")
 
+#: Fused-block-kernel modes for the packed streaming step (api.py
+#: ``fuse_block``, ``cli run --fuse-block``):
+#:
+#: - ``auto`` — fuse when eligible (``accum_repr="packed"``, an f32
+#:   dtype, a clusterer that declares ``supports_fused_assign``) AND the
+#:   kernel's compile-and-run probe passes on the active backend; any
+#:   probe failure (or a CPU backend) keeps the unfused label path.
+#: - ``on``   — require eligibility and fuse unconditionally (interpret
+#:   mode where the compiled kernel is unavailable — the CPU test path).
+#: - ``off``  — always the unfused label path.
+#:
+#: Results are bit-identical across all three (the fused parity gate in
+#: tests/test_fused_block.py), so the knob never enters result or
+#: checkpoint fingerprints.
+FUSE_BLOCK_MODES = ("auto", "on", "off")
+
 
 def validate_accum_repr(accum_repr: str) -> str:
     """Validate (and return) an accumulator representation; shared by
@@ -78,6 +94,18 @@ def validate_accum_repr(accum_repr: str) -> str:
             f"{accum_repr!r}"
         )
     return accum_repr
+
+
+def validate_fuse_block(fuse_block: str) -> str:
+    """Validate (and return) a fused-block mode; shared by the api
+    constructor and the CLI so both reject the same vocabulary the same
+    way."""
+    if fuse_block not in FUSE_BLOCK_MODES:
+        raise ValueError(
+            f"fuse_block must be one of {list(FUSE_BLOCK_MODES)}, got "
+            f"{fuse_block!r}"
+        )
+    return fuse_block
 
 
 def validate_mode(mode: str) -> str:
@@ -246,6 +274,21 @@ class SweepConfig:
         lowering failure degrades to lax, disclosed as
         ``packed_kernel: pallas|lax`` in result timing).  Ignored for
         ``dense``.
+      fuse_block: with ``accum_repr="packed"`` in the STREAMING engine:
+        fuse the per-block final assignment + bit-plane packing into one
+        Pallas kernel (ops.pallas_fused_block) so per-lane labels never
+        leave VMEM — ``auto`` (default) fuses iff eligible and the
+        backend probe passes, ``on`` requires eligibility and forces the
+        fused path (interpret mode off-accelerator — the CPU test path),
+        ``off`` keeps the unfused label path (``FUSE_BLOCK_MODES``).
+        Eligibility: f32 dtype and a clusterer declaring
+        ``supports_fused_assign`` (KMeans); ``on`` raises otherwise.
+        Counts, curves, checkpoint frames and fingerprints are
+        bit-identical either way (tests/test_fused_block.py), so like
+        ``use_packed_kernel`` the knob rides OUTSIDE every fingerprint;
+        the resolved path is disclosed as ``fuse_block:
+        fused|unfused`` (+ ``fused_kernel: pallas|interpret``) in result
+        timing.  Ignored by the monolithic sweep and for ``dense``.
       use_pallas: True forces the Pallas consensus-histogram kernel, False
         forces the XLA fallback, None picks by backend (Pallas on TPU).
       dtype: working float dtype for the data and the inner clusterers
@@ -279,14 +322,26 @@ class SweepConfig:
     integrity_check_every: int = 0
     accum_repr: str = "dense"
     use_packed_kernel: Optional[bool] = None
+    fuse_block: str = "auto"
     use_pallas: Optional[bool] = None
     dtype: str = "float32"
 
     def __post_init__(self):
         validate_accum_repr(self.accum_repr)
+        validate_fuse_block(self.fuse_block)
         if self.dtype not in ("float32", "float64"):
             raise ValueError(
                 f"dtype must be 'float32' or 'float64', got {self.dtype!r}"
+            )
+        if self.fuse_block == "on" and self.accum_repr != "packed":
+            raise ValueError(
+                "fuse_block='on' requires accum_repr='packed': the fused "
+                "assign+pack kernel is a property of the packed block step"
+            )
+        if self.fuse_block == "on" and self.dtype != "float32":
+            raise ValueError(
+                "fuse_block='on' requires dtype='float32': the fused "
+                "kernel is f32-only (Pallas has no f64 path)"
             )
         if self.cluster_batch is not None and (
             isinstance(self.cluster_batch, bool)

@@ -422,7 +422,7 @@ def tainted_names(ctx: ModuleContext, func: FunctionInfo) -> Set[str]:
 #: slow-mark rule protecting the tier-1 time budget.
 RULE_PACKS: Dict[str, Tuple[str, ...]] = {
     "estimator": ("JL009",),
-    "packed": ("JL010",),
+    "packed": ("JL010", "JL019"),
     "serve-concurrency": ("JL011", "JL012", "JL013"),
     "import-hygiene": ("JL014", "JL015"),
     "contract-sync": ("JL016", "JL017", "JL018"),

@@ -27,6 +27,7 @@ from consensus_clustering_tpu.lint.registry import (
     assigned_names,
     function_params,
     in_pack_scope,
+    path_components,
     register,
     tainted_names,
     walk_in_order,
@@ -1083,3 +1084,99 @@ class PackedDenseMaterialize(Rule):
                     "(docs/LINT.md JL010)",
                 ))
         return findings
+
+
+#: File stems that ARE the fused assign+pack path today (a future
+#: ops/fused/ subdirectory lands inside the pack scope automatically).
+FUSED_PATH_MODULES = frozenset({"pallas_fused_block.py"})
+
+#: The round-trip packer the fused kernel exists to bypass: calling it
+#: from the fused path means a dense per-lane labels array was
+#: materialised first — the exact regression JL019 guards against.
+_LABEL_PACKERS = frozenset({
+    "consensus_clustering_tpu.ops.bitpack.pack_label_planes",
+    "pack_label_planes",
+})
+
+
+@register
+class FusedLabelMaterialize(Rule):
+    id = "JL019"
+    name = "fused-label-materialize"
+    summary = (
+        "dense label materialisation inside the fused assign+pack "
+        "path: an (h_block, n)-class int32 allocation or a "
+        "pack_label_planes() call silently re-erects the label "
+        "round-trip the fused kernel removes"
+    )
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        parts = path_components(ctx.path)
+        if not (
+            in_pack_scope(ctx.path, "fused")
+            or (parts and parts[-1] in FUSED_PATH_MODULES)
+        ):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = ctx.resolve_call(node)
+            if qual is None:
+                continue
+            if qual in _LABEL_PACKERS:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "pack_label_planes() consumes a dense per-lane "
+                    "labels array — the fused path's contract is that "
+                    "labels exist only as per-lane VMEM vectors; keep "
+                    "the round-trip packer in the UNFUSED engine "
+                    "branch (docs/LINT.md JL019)",
+                ))
+                continue
+            if qual in _ALLOCATOR_CALLS and self._dense_int32(node):
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "int32 allocation with two or more symbolic "
+                    "dimensions ((h_block, n)-class) inside the fused "
+                    "assign+pack path — that is the dense label "
+                    "buffer the fused kernel exists to eliminate; "
+                    "emit uint32 bit-planes instead, or suppress "
+                    "with a reason if the buffer is not labels "
+                    "(docs/LINT.md JL019)",
+                ))
+        return findings
+
+    @staticmethod
+    def _dense_int32(call: ast.Call) -> bool:
+        """An allocator call whose dtype names int32 AND whose shape
+        carries >= 2 non-constant dimensions — the label-buffer
+        class.  f32 lane/tile buffers and uint32 planes (the packed
+        representation itself) stay clean."""
+        shape = call.args[0] if call.args else None
+        dtype = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg == "shape":
+                shape = kw.value
+            elif kw.arg == "dtype":
+                dtype = kw.value
+        if dtype is None:
+            return False
+        if isinstance(dtype, ast.Attribute):
+            named = dtype.attr
+        elif isinstance(dtype, ast.Name):
+            named = dtype.id
+        elif isinstance(dtype, ast.Constant) and isinstance(
+            dtype.value, str
+        ):
+            named = dtype.value
+        else:
+            return False
+        if named not in ("int32", "i32"):
+            return False
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return False
+        symbolic = [
+            e for e in shape.elts if not isinstance(e, ast.Constant)
+        ]
+        return len(symbolic) >= 2

@@ -143,6 +143,17 @@ class KMeans:
     use_pallas: Optional[bool] = None
     pallas_interpret: bool = False
 
+    # Fused-block contract (ops/pallas_fused_block): ``fit`` returns
+    # (labels, centroids) where labels are EXACTLY the argmin of the
+    # masked ``_pairwise_sqdist`` from those centroids (first-lowest
+    # tie-break, slots >= k at +inf) — so the streaming engine may
+    # recompute the final assignment per element column inside the
+    # fused kernel and pack bit-identical planes without ever
+    # materialising labels.  Clusterers whose labels are not a pure
+    # nearest-centroid function of a returned parameter must NOT set
+    # this.
+    supports_fused_assign = True
+
     def fit_predict(
         self,
         key: jax.Array,

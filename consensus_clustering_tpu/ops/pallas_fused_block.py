@@ -1,0 +1,345 @@
+"""Pallas TPU kernel: fused final-assignment + bit-plane packing.
+
+The packed block step (parallel/streaming.py, ``accum_repr="packed"``)
+used to bridge its two fused kernels with a label round-trip: the Lloyd
+lanes produce per-resample labels, an ``all_gather`` materialises them as
+an (h_block, n_sub) int32 buffer in HBM, and ``ops.bitpack.
+pack_label_planes`` scatter-packs that buffer into uint32 bit-planes for
+the AND+popcount kernel (ops/pallas_coassoc).  That buffer is the last
+N-proportional inter-stage traffic term in PERF.md's roofline (ROADMAP
+item 5).
+
+This kernel closes the seam by changing WHAT crosses it: the Lloyd
+iterations stay in the clusterer's ``while_loop`` (their convergence /
+best-of-n_init semantics are the clusterer's contract, and XLA dead-code
+-eliminates the labels output nobody consumes), and only the tiny final
+(k_max, d) centroids travel to this kernel, which fuses the final
+assignment with the packing — per (128-column, lane) grid step:
+
+    dist    = |x|^2 - 2 x.c + |c|^2      (one MXU GEMM, f32 HIGHEST —
+                                          the models/kmeans.py
+                                          ``masked_dist`` expression,
+                                          term for term)
+    labels  = argmin over slots < k      (VPU; never leaves VMEM)
+    planes |= onehot(labels) & sampled   (MXU transpose-GEMM + the
+              << bit(row)                 co-sample plane bit)
+
+so the only HBM traffic per block is the data tile read (once per
+column tile, resident across the lane grid dimension — Pallas
+double-buffers the per-lane centroid/scalar streams underneath it) and
+the packed int32 plane tile write-back.  Per-element labels exist only
+as one (128,) VMEM vector per grid step; no (h_block, N) label buffer
+appears in the compiled plan (benchmarks/fused_block/ holds the
+measured A/B; jaxlint JL019 guards the property structurally).
+
+Bit-identity with the unfused path is by construction plus a measured
+invariance: the distance expression reuses the clusterer's exact term
+order/precision, and the per-row GEMM result is invariant to the row
+set and zero-padding of the operand (verified bitwise on the test
+backend; the norm reductions are computed OUTSIDE the kernel at
+unpadded width, where the reduction tree IS width-sensitive).  The
+engine-level gates are the fused parity families in
+tests/test_fused_block.py.
+
+Mosaic lessons (BENCH_r01) carried over from the sibling kernels: no
+scalar stores, 2-D shapes in every store, int32 plane words (uint32 is
+bitcast outside; shifts/ANDs are bit-pattern ops), zero-padding outside
+the kernel, and the whole kernel behind the shared compile-and-run
+probe (:func:`fused_block_available`) with the unfused engine path as
+the everywhere-proven fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE_C = 128
+_K_LANES = 128
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return -(-value // multiple) * multiple
+
+
+def _fused_kernel(
+    k_ref, w_ref, s_ref, x_ref, ct_ref, cop_ref, out_ref,
+    *, d, tile_c, k_pad, k_rows, n_words,
+):
+    h = pl.program_id(1)
+
+    @pl.when(h == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    kk = k_ref[0, 0]
+    widx = w_ref[0, 0]
+    shift = s_ref[0, 0]
+    xr = x_ref[:]   # (tile_c, D); lane d holds |x|^2, lanes > d are 0
+    ct = ct_ref[:]  # (D, k_pad); row d is 0, row d+1 holds |c|^2
+
+    # models/kmeans.py masked_dist, term for term: the aug lanes cancel
+    # exactly (x lane d rides against a zero centroid row and vice
+    # versa), and both norms were reduced at unpadded width outside.
+    cross = jax.lax.dot_general(
+        xr, ct, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # (tile_c, k_pad)
+    x_sq = xr[:, d:d + 1]
+    c_sq = ct[d + 1:d + 2, :]
+    dist = jnp.maximum(x_sq - 2.0 * cross + c_sq, 0.0)
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (tile_c, k_pad), 1)
+    dist = jnp.where(lane_k < kk, dist, jnp.inf)
+    labels = jnp.argmin(dist, axis=1).astype(jnp.int32)  # (tile_c,)
+    onehot = (labels[:, None] == lane_k).astype(jnp.float32)
+
+    # This lane's co-sample word row: static unrolled select over the
+    # (small) word axis — no dynamic VMEM indexing lowers at all.
+    samp = jnp.zeros((1, tile_c), jnp.int32)
+    for w in range(n_words):
+        samp = jnp.where(widx == w, cop_ref[w:w + 1, :], samp)
+    mask = jnp.left_shift(jnp.int32(1), shift)
+    sampled = (samp & mask) != 0  # (1, tile_c)
+
+    # Transpose onehot to (k, element) orientation on the MXU — the
+    # identity GEMM with the sampled bit folded onto its diagonal, so
+    # one dot yields onehot^T masked to the lane's resample.  Entries
+    # are exact 0.0/1.0 sums of at most one term.
+    col = jax.lax.broadcasted_iota(jnp.int32, (tile_c, tile_c), 0)
+    row = jax.lax.broadcasted_iota(jnp.int32, (tile_c, tile_c), 1)
+    diag = jnp.where((col == row) & sampled, 1.0, 0.0)
+    sel = jax.lax.dot_general(
+        onehot, diag, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # (k_pad, tile_c)
+    word_bit = jnp.where(sel > 0.5, mask, 0)[:k_rows, :]
+
+    # OR into the resident plane tile at this lane's word row: static
+    # unrolled 2-D stores, one k_rows-row band per word.
+    for w in range(n_words):
+        band = out_ref[w * k_rows:(w + 1) * k_rows, :]
+        out_ref[w * k_rows:(w + 1) * k_rows, :] = band | jnp.where(
+            widx == w, word_bit, 0
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("d", "k_max", "n_words", "interpret"),
+)
+def _pallas_fused_planes(
+    x_aug: jax.Array,
+    ct_aug: jax.Array,
+    cop: jax.Array,
+    word_idx: jax.Array,
+    shift: jax.Array,
+    k: jax.Array,
+    d: int,
+    k_max: int,
+    n_words: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Padded-layout fused assign+pack call; see :func:`fused_assign_pack`."""
+    nc_pad, big_d = x_aug.shape
+    lanes_d, k_pad = ct_aug.shape
+    n_lanes = lanes_d // big_d
+    tile_c = min(_TILE_C, nc_pad)
+    k_rows = _round_up(k_max, 8)
+    grid = (nc_pad // tile_c, n_lanes)
+
+    kernel = functools.partial(
+        _fused_kernel,
+        d=d, tile_c=tile_c, k_pad=k_pad, k_rows=k_rows, n_words=n_words,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1), lambda t, h: (0, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (1, 1), lambda t, h: (h, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (1, 1), lambda t, h: (h, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (tile_c, big_d), lambda t, h: (t, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (big_d, k_pad), lambda t, h: (h, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (cop.shape[0], tile_c), lambda t, h: (0, t),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (n_words * k_rows, tile_c), lambda t, h: (0, t),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_words * k_rows, nc_pad), jnp.int32
+        ),
+        interpret=interpret,
+    )(
+        jnp.asarray(k, jnp.int32).reshape(1, 1),
+        word_idx, shift, x_aug, ct_aug, cop,
+    )
+
+
+def fused_assign_pack(
+    x_cols: jax.Array,
+    centroids: jax.Array,
+    k: jax.Array,
+    coplanes: jax.Array,
+    row0: jax.Array,
+    *,
+    n_words: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Final assignment + bit-plane packing for one device's columns.
+
+    Args:
+      x_cols: (n_cols, d) f32 — this device's element rows (padding rows
+        carry no co-sample bits and are ignored wherever they land).
+      centroids: (n_lanes, k_max, d) f32 — final per-lane centroids from
+        the clusterer's Lloyd loop (``KMeans.fit(...)[1]``).
+      k: traced active-cluster count (slots >= k masked to +inf, the
+        clusterer's ``valid`` rule).
+      coplanes: (n_words, n_cols) uint32 — THIS DEVICE'S co-sample plane
+        contribution (``pack_cosample_planes(..., row0=row0)`` before
+        any psum): bit ``row0 + l`` of column j says element j is in
+        lane l's resample.
+      row0: traced bit offset of lane 0 within the block's planes.
+      n_words: static word count of the block's planes.
+      interpret: run the kernel in interpreter mode (CPU testing).
+
+    Returns:
+      (k_max, n_words, n_cols) uint32 plane contribution — bit-identical
+      to ``pack_label_planes`` fed this device's lanes' labels, with the
+      labels never materialised (they live and die inside the kernel's
+      VMEM).  Sum/OR over devices exactly like the unfused contribution.
+    """
+    n_cols, d = x_cols.shape
+    n_lanes, k_max, d_c = centroids.shape
+    assert d_c == d, (d_c, d)
+    k_pad = _round_up(k_max, _K_LANES)
+    k_rows = _round_up(k_max, 8)
+    big_d = _round_up(d + 2, _K_LANES)
+    tile_c = min(_TILE_C, _round_up(n_cols, _TILE_C))
+    nc_pad = _round_up(n_cols, tile_c)
+
+    # Norm reductions at UNPADDED width d (the reduction tree is width-
+    # sensitive; the GEMM below is invariant to the zero padding).
+    x_f = x_cols.astype(jnp.float32)
+    c_f = centroids.astype(jnp.float32)
+    x_sq = jnp.sum(x_f * x_f, axis=1)
+    c_sq = jnp.sum(c_f * c_f, axis=-1)  # (n_lanes, k_max)
+
+    x_aug = jnp.zeros((nc_pad, big_d), jnp.float32)
+    x_aug = x_aug.at[:n_cols, :d].set(x_f)
+    x_aug = x_aug.at[:n_cols, d].set(x_sq)
+    ct_aug = jnp.zeros((n_lanes, big_d, k_pad), jnp.float32)
+    ct_aug = ct_aug.at[:, :d, :k_max].set(
+        jnp.transpose(c_f, (0, 2, 1))
+    )
+    ct_aug = ct_aug.at[:, d + 1, :k_max].set(c_sq)
+    ct_aug = ct_aug.reshape(n_lanes * big_d, k_pad)
+
+    cop = jax.lax.bitcast_convert_type(coplanes, jnp.int32)
+    cop = jnp.pad(
+        cop,
+        ((0, _round_up(n_words, 8) - n_words), (0, nc_pad - n_cols)),
+    )
+    rows = jnp.asarray(row0, jnp.int32) + jnp.arange(
+        n_lanes, dtype=jnp.int32
+    )
+    word_idx = (rows // 32).reshape(n_lanes, 1)
+    shift = (rows % 32).reshape(n_lanes, 1)
+
+    out = _pallas_fused_planes(
+        x_aug, ct_aug, cop, word_idx, shift, k,
+        d, k_max, n_words, interpret=interpret,
+    )
+    planes = out[:, :n_cols].reshape(n_words, k_rows, n_cols)
+    planes = jnp.transpose(planes[:, :k_max, :], (1, 0, 2))
+    return jax.lax.bitcast_convert_type(planes, jnp.uint32)
+
+
+def fused_planes_reference(
+    x_cols: jax.Array,
+    centroids: jax.Array,
+    k: jax.Array,
+    coplanes: jax.Array,
+    row0: jax.Array,
+    *,
+    n_words: int,
+) -> jax.Array:
+    """Pure-lax oracle for :func:`fused_assign_pack` (tests and
+    ``benchmarks/tpu_kernel_check.py`` only — the ENGINE's fallback is
+    the unfused label path, not this).  Same distance expression, same
+    masking, same bit placement; materialises what the kernel keeps in
+    VMEM."""
+    n_cols, d = x_cols.shape
+    n_lanes, k_max, _ = centroids.shape
+    x_f = x_cols.astype(jnp.float32)
+    c_f = centroids.astype(jnp.float32)
+    x_sq = jnp.sum(x_f * x_f, axis=1, keepdims=True)  # (n_cols, 1)
+    c_sq = jnp.sum(c_f * c_f, axis=-1)  # (n_lanes, k_max)
+    cross = jax.vmap(
+        lambda c: jnp.matmul(x_f, c.T, precision=jax.lax.Precision.HIGHEST)
+    )(c_f)  # (n_lanes, n_cols, k_max)
+    dist = jnp.maximum(x_sq[None] - 2.0 * cross + c_sq[:, None, :], 0.0)
+    valid = jnp.arange(k_max, dtype=jnp.int32) < jnp.asarray(k, jnp.int32)
+    dist = jnp.where(valid[None, None, :], dist, jnp.inf)
+    labels = jnp.argmin(dist, axis=-1).astype(jnp.int32)  # (n_lanes, n_cols)
+
+    rows = jnp.asarray(row0, jnp.int32) + jnp.arange(
+        n_lanes, dtype=jnp.int32
+    )
+    words = coplanes[jnp.clip(rows // 32, 0, n_words - 1)]
+    sampled = (words >> (rows % 32).astype(jnp.uint32)[:, None]) & 1
+    onehot = (
+        labels[:, None, :] == jnp.arange(k_max, dtype=jnp.int32)[None, :, None]
+    ) & (sampled[:, None, :] != 0)
+    vals = onehot.astype(jnp.uint32) << (
+        (rows % 32).astype(jnp.uint32)[:, None, None]
+    )
+    planes = jnp.zeros((k_max, n_words, n_cols), jnp.uint32)
+    # Disjoint bits per (plane, word, column): integer add == bitwise OR.
+    return planes.at[:, rows // 32, :].add(
+        jnp.transpose(vals, (1, 0, 2)), mode="drop"
+    )
+
+
+def fused_block_available() -> bool:
+    """True iff the fused assign+pack kernel compiles and runs on the
+    active backend.
+
+    Shared probe mechanism (ops.probe): one compile-and-run on a ragged
+    multi-tile grid — 300 columns (partial edge tile), 13 lanes, d=7,
+    k_max=5, a 2-word plane with a non-word-aligned ``row0`` — cached
+    per backend.  Any failure (the BENCH_r01 Mosaic class) keeps the
+    engine on the unfused label path with a logged warning; CPU is
+    always False (interpret mode is the CPU test path)."""
+    from consensus_clustering_tpu.ops.probe import probe_cached
+
+    def _probe():
+        cols = jnp.ones((300, 7), jnp.float32)
+        cents = jnp.ones((13, 5, 7), jnp.float32)
+        cop = jnp.ones((2, 300), jnp.uint32)
+        return fused_assign_pack(
+            cols, cents, jnp.int32(4), cop, jnp.int32(3), n_words=2
+        )
+
+    return probe_cached("fused_block", _probe)

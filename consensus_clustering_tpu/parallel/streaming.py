@@ -90,6 +90,10 @@ from consensus_clustering_tpu.ops.pallas_coassoc import (
     packed_coassoc_counts,
     packed_kernel_available,
 )
+from consensus_clustering_tpu.ops.pallas_fused_block import (
+    fused_assign_pack,
+    fused_block_available,
+)
 from consensus_clustering_tpu.ops.pallas_hist import (
     consensus_hist_counts,
     kernel_available,
@@ -197,6 +201,19 @@ class StreamingSweep:
         packed = self._accum_repr == "packed"
         self.packed_kernel = None
         popcount_fn = None
+        # Fused block step (ops.pallas_fused_block, ROADMAP item 5):
+        # fold the per-block final assignment + bit-plane packing into
+        # one Pallas kernel so per-lane labels never reach HBM — the
+        # (h_block, n_sub) ``labels_row`` all_gather below is replaced
+        # by an all_gather of the tiny per-lane centroids.  Resolved
+        # here, OUTSIDE the traced program, exactly like the popcount
+        # kernel above, and disclosed as ``fuse_block: fused|unfused``
+        # (+ ``fused_kernel: pallas|interpret``) in result timing.
+        # Bit-identity with the unfused path is the parity gate in
+        # tests/test_fused_block.py; any probe failure keeps the
+        # everywhere-proven unfused path.
+        self.fuse_block = None
+        self.fused_kernel = None
         if packed:
             use_pk = config.use_packed_kernel
             if use_pk is None:
@@ -205,6 +222,42 @@ class StreamingSweep:
             popcount_fn = partial(
                 packed_coassoc_counts, use_kernel=bool(use_pk)
             )
+            eligible = (
+                getattr(clusterer, "supports_fused_assign", False)
+                and config.dtype == "float32"
+            )
+            if config.fuse_block == "on":
+                if not eligible:
+                    raise ValueError(
+                        "fuse_block='on' needs an f32 dtype and a "
+                        "clusterer declaring supports_fused_assign "
+                        "(labels a pure nearest-centroid function of "
+                        f"fit()'s centroids); got dtype={config.dtype!r}"
+                        f", clusterer {type(clusterer).__name__}"
+                    )
+                self.fuse_block = "fused"
+                self.fused_kernel = (
+                    "pallas" if fused_block_available() else "interpret"
+                )
+                if self.fused_kernel == "interpret" and (
+                    jax.default_backend() != "cpu"
+                ):
+                    logger.warning(
+                        "fuse_block='on' but the fused kernel failed its "
+                        "probe on backend %r; running in interpret mode "
+                        "(slow) — use fuse_block='auto' to fall back to "
+                        "the unfused path instead",
+                        jax.default_backend(),
+                    )
+            elif (
+                config.fuse_block == "auto"
+                and eligible
+                and fused_block_available()
+            ):
+                self.fuse_block = "fused"
+                self.fused_kernel = "pallas"
+            else:
+                self.fuse_block = "unfused"
             # Capacity: the plane words are sized by the BUILD config's
             # n_iterations (rounded up to whole blocks) — H stays a
             # runtime argument below that cap, so the executable remains
@@ -446,13 +499,11 @@ class StreamingSweep:
             # whole words, so a traced h_start maps exactly.
             word0 = (h_start // hb_pad) * self._wb
 
-            blk_coplanes = jax.lax.psum(
-                pack_cosample_planes(
-                    indices_row_local, self._n_local_pack,
-                    n_words=self._wb, row0=g0,
-                ),
-                RESAMPLE_AXIS,
+            my_coplanes = pack_cosample_planes(
+                indices_row_local, self._n_local_pack,
+                n_words=self._wb, row0=g0,
             )
+            blk_coplanes = jax.lax.psum(my_coplanes, RESAMPLE_AXIS)
             coplanes_new = jax.lax.dynamic_update_slice(
                 coplanes_blk, blk_coplanes,
                 (word0, jnp.asarray(0, jnp.int32)),
@@ -465,26 +516,64 @@ class StreamingSweep:
             )
 
             x_sub = x[jnp.where(indices >= 0, indices, 0)]
+            if self.fuse_block == "fused":
+                # This device's element columns, padded to the packed
+                # column capacity (identity placement: element j at
+                # padded-global position j; pad rows carry no co-sample
+                # bits, so their in-kernel labels are dead values).
+                x_cols = jax.lax.dynamic_slice(
+                    jnp.pad(
+                        x.astype(jnp.float32),
+                        ((0, self._n_pad2 - n), (0, 0)),
+                    ),
+                    (col_start, jnp.asarray(0, jnp.int32)),
+                    (self._n_local_pack, config.n_features),
+                )
 
             def per_k(_, scanned):
                 k, planes_k = scanned
                 keys = resample_lane_keys(
                     config, key_cluster, k, h_global
                 )
-                labels = fit_resample_lanes(
-                    clusterer, config, keys, x_sub, k, k_max
-                )
-                labels = jnp.where(h_valid[:, None], labels, -1)
-                labels_row = jax.lax.all_gather(
-                    labels, ROW_AXIS, tiled=True, axis=0
-                )
-                blk_planes = jax.lax.psum(
-                    pack_label_planes(
-                        labels_row, indices_row_local, k_max,
-                        self._n_local_pack, n_words=self._wb, row0=g0,
-                    ),
-                    RESAMPLE_AXIS,
-                )
+                if self.fuse_block == "fused":
+                    # Fused path: only the (lanes, k_max, d) centroids
+                    # cross devices; the final assignment and packing
+                    # run inside the kernel over this device's element
+                    # columns, against its own co-sample contribution
+                    # (rows [g0, g0 + lanes) of the block planes) —
+                    # bit-identical to the label path by the clusterer's
+                    # supports_fused_assign contract.
+                    cents = fit_resample_lanes(
+                        clusterer, config, keys, x_sub, k, k_max,
+                        return_centroids=True,
+                    )
+                    cents_row = jax.lax.all_gather(
+                        cents, ROW_AXIS, tiled=True, axis=0
+                    )
+                    blk_planes = jax.lax.psum(
+                        fused_assign_pack(
+                            x_cols, cents_row, k, my_coplanes, g0,
+                            n_words=self._wb,
+                            interpret=self.fused_kernel == "interpret",
+                        ),
+                        RESAMPLE_AXIS,
+                    )
+                else:
+                    labels = fit_resample_lanes(
+                        clusterer, config, keys, x_sub, k, k_max
+                    )
+                    labels = jnp.where(h_valid[:, None], labels, -1)
+                    labels_row = jax.lax.all_gather(
+                        labels, ROW_AXIS, tiled=True, axis=0
+                    )
+                    blk_planes = jax.lax.psum(
+                        pack_label_planes(
+                            labels_row, indices_row_local, k_max,
+                            self._n_local_pack, n_words=self._wb,
+                            row0=g0,
+                        ),
+                        RESAMPLE_AXIS,
+                    )
                 planes_new = jax.lax.dynamic_update_slice(
                     planes_k, blk_planes,
                     (
@@ -1322,6 +1411,15 @@ class StreamingSweep:
             # degrades silently at the probe gate, so the result must
             # say so (ops/pallas_coassoc.py).
             out["timing"]["packed_kernel"] = self.packed_kernel
+        if self.fuse_block is not None:
+            # Whether the block step ran the fused assign+pack kernel
+            # ("fused") or the label round-trip path ("unfused"), and —
+            # when fused — which lowering served it ("pallas" |
+            # "interpret").  Same disclosure contract as packed_kernel:
+            # probe-gate degradation must be visible in the result.
+            out["timing"]["fuse_block"] = self.fuse_block
+            if self.fused_kernel is not None:
+                out["timing"]["fused_kernel"] = self.fused_kernel
         return out
 
     # -- fused (batch-axis) driver ---------------------------------------
@@ -1643,6 +1741,10 @@ class StreamingSweep:
             }
             if self.packed_kernel is not None:
                 out["timing"]["packed_kernel"] = self.packed_kernel
+            if self.fuse_block is not None:
+                out["timing"]["fuse_block"] = self.fuse_block
+                if self.fused_kernel is not None:
+                    out["timing"]["fused_kernel"] = self.fused_kernel
             outs.append(out)
         return outs
 

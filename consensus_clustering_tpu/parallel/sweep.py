@@ -221,6 +221,7 @@ def fit_resample_lanes(
     x_sub: jax.Array,
     k: jax.Array,
     k_max: int,
+    return_centroids: bool = False,
 ) -> jax.Array:
     """Cluster one device's resample lanes for one K, honouring the
     ``cluster_batch``/``split_init`` sub-batching semantics.
@@ -231,11 +232,23 @@ def fit_resample_lanes(
     (frozen lanes never change), so sharing the code is what makes the
     engines' full-H parity a structural property rather than a test
     coincidence.
+
+    ``return_centroids=True`` returns the per-lane FINAL centroids
+    ((local_h, k_max, d), the fused block path's input) instead of
+    labels, via the clusterer's ``fit`` hook; key derivation and
+    grouping are identical, so the centroids are exactly the ones the
+    label path's final assignment used — XLA dead-code-eliminates the
+    unread labels output.
     """
     local_h = x_sub.shape[0]
-    fit_batch = jax.vmap(
-        lambda kk, xs: clusterer.fit_predict(kk, xs, k, k_max)
-    )
+    if return_centroids:
+        fit_batch = jax.vmap(
+            lambda kk, xs: clusterer.fit(kk, xs, k, k_max)[1]
+        )
+    else:
+        fit_batch = jax.vmap(
+            lambda kk, xs: clusterer.fit_predict(kk, xs, k, k_max)
+        )
     batch = config.cluster_batch
     if batch is None or batch >= local_h:
         return fit_batch(keys, x_sub)
@@ -259,11 +272,18 @@ def fit_resample_lanes(
             lambda kk, xs: clusterer.init_centroids(kk, xs, k, k_max)
         )(keys, x_sub)
         inits_g = pad_to_lane_groups(inits, batch)
-        fit_from = jax.vmap(
-            lambda kk, xs, c0: clusterer.fit_predict(
-                kk, xs, k, k_max, init_centroids=c0
+        if return_centroids:
+            fit_from = jax.vmap(
+                lambda kk, xs, c0: clusterer.fit(
+                    kk, xs, k, k_max, init_centroids=c0
+                )[1]
             )
-        )
+        else:
+            fit_from = jax.vmap(
+                lambda kk, xs, c0: clusterer.fit_predict(
+                    kk, xs, k, k_max, init_centroids=c0
+                )
+            )
         labels_g = jax.lax.map(
             lambda args: fit_from(*args),
             (

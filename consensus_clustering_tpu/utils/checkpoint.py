@@ -46,6 +46,11 @@ def _fingerprint(config: SweepConfig, seed: int) -> str:
     # invalidate per-K result checkpoints.
     payload.pop("accum_repr", None)
     payload.pop("use_packed_kernel", None)
+    # fuse_block picks how the packed block step computes its plane
+    # contribution (fused assign+pack kernel vs the label round-trip);
+    # both produce bit-identical planes (tests/test_fused_block.py), so
+    # it may not invalidate per-K result checkpoints either.
+    payload.pop("fuse_block", None)
     # stream_h_block is an execution strategy, not a semantic: the
     # streamed sweep is bit-exact to the monolithic one at full H (the
     # PR-3 parity proof), so block size must not invalidate per-K
@@ -128,6 +133,11 @@ def stream_fingerprint(
     payload.pop("use_pallas", None)
     payload.pop("use_packed_kernel", None)
     payload.pop("integrity_check_every", None)
+    # fuse_block is popped for the same reason as use_packed_kernel: the
+    # fused assign+pack kernel and the label round-trip write the same
+    # planes bit for bit, so a fused run must resume an unfused ring
+    # (and vice versa) without orphaning it.
+    payload.pop("fuse_block", None)
     payload["n_iterations"] = (
         config.n_iterations if n_iterations is None else int(n_iterations)
     )

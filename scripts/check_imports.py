@@ -56,6 +56,7 @@ EXPECTED_MODULES = (
     "consensus_clustering_tpu.lint.packs",
     "consensus_clustering_tpu.ops.bitpack",
     "consensus_clustering_tpu.ops.pallas_coassoc",
+    "consensus_clustering_tpu.ops.pallas_fused_block",
 )
 
 

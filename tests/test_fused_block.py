@@ -1,0 +1,333 @@
+"""Fused block megakernel (ops.pallas_fused_block): parity, gating,
+resume.
+
+The gate is the same int32 BIT-IDENTITY bar as the packed
+representation itself (tests/test_packed_parity.py): ``fuse_block="on"``
+must produce byte-equal curves/matrices/``result_fingerprint`` to
+``fuse_block="off"`` at every tested shape family, and a checkpoint ring
+written by either path must resume under the other.  Compile-bearing
+engine cases are slow-marked per the tier-1 budget rule; the fast lane
+keeps the config/fingerprint/gating surface plus one tiny interpret-mode
+kernel case.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.models.kmeans import KMeans
+from consensus_clustering_tpu.ops import probe as probe_mod
+from consensus_clustering_tpu.ops.bitpack import (
+    pack_cosample_planes,
+    pack_label_planes,
+    packed_width,
+)
+from consensus_clustering_tpu.ops.pallas_fused_block import (
+    fused_assign_pack,
+    fused_planes_reference,
+)
+from consensus_clustering_tpu.parallel.mesh import resample_mesh
+from consensus_clustering_tpu.parallel.streaming import StreamingSweep
+
+N, D = 29, 4
+KV = (2, 3)
+
+
+def _x(seed=0, n=N, d=D):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(
+        np.float32
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        n_samples=N, n_features=D, k_values=KV, n_iterations=12,
+        store_matrices=True, stream_h_block=4, accum_repr="packed",
+    )
+    base.update(kw)
+    return SweepConfig(**base)
+
+
+_CURVE_KEYS = ("hist", "cdf", "pac_area")
+_ALL_KEYS = _CURVE_KEYS + ("mij", "iij", "cij")
+
+
+def _assert_bit_equal(a, b, keys):
+    for k in keys:
+        av, bv = np.asarray(a[k]), np.asarray(b[k])
+        assert av.dtype == bv.dtype, k
+        assert av.tobytes() == bv.tobytes(), f"{k} not byte-identical"
+
+
+def _run(fuse, mesh=None, n_init=1, h=12, seed=7, **cfg_kw):
+    eng = StreamingSweep(
+        KMeans(n_init=n_init), _cfg(fuse_block=fuse, **cfg_kw), mesh
+    )
+    return eng.run(_x(), seed, h)
+
+
+class TestConfigSurface:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="fuse_block"):
+            SweepConfig(n_samples=10, n_features=2, fuse_block="yes")
+        # "on" is only meaningful for the packed block step ...
+        with pytest.raises(ValueError, match="accum_repr"):
+            SweepConfig(
+                n_samples=10, n_features=2, fuse_block="on"
+            )
+        # ... and the kernel's GEMM-exactness argument is f32-only.
+        with pytest.raises(ValueError, match="float32"):
+            SweepConfig(
+                n_samples=10, n_features=2, accum_repr="packed",
+                fuse_block="on", dtype="float64",
+            )
+        cfg = _cfg(fuse_block="on")
+        assert cfg.fuse_block == "on"
+        assert _cfg().fuse_block == "auto"
+
+    def test_engine_rejects_non_assign_clusterer(self):
+        class NoFuse(KMeans):
+            supports_fused_assign = False
+
+        with pytest.raises(ValueError, match="supports_fused_assign"):
+            StreamingSweep(NoFuse(n_init=1), _cfg(fuse_block="on"))
+
+    def test_fingerprints_ignore_fuse_block(self):
+        # The fused kernel writes the same planes bit for bit, so it
+        # must not invalidate per-K result checkpoints nor orphan a
+        # streamed ring (same contract as use_packed_kernel).
+        from consensus_clustering_tpu.utils.checkpoint import (
+            _fingerprint,
+            stream_fingerprint,
+        )
+
+        for fuse in ("on", "off"):
+            assert _fingerprint(_cfg(fuse_block=fuse), 7) == (
+                _fingerprint(_cfg(), 7)
+            )
+            assert stream_fingerprint(
+                _cfg(fuse_block=fuse), 7, "sha"
+            ) == stream_fingerprint(_cfg(), 7, "sha")
+
+
+class TestProbeGate:
+    def test_auto_unfused_on_cpu(self):
+        # CPU probes are always False (compiled Pallas is an
+        # accelerator artifact), so "auto" must keep the label path.
+        eng = StreamingSweep(KMeans(n_init=1), _cfg())
+        assert eng.fuse_block == "unfused"
+        assert eng.fused_kernel is None
+
+    def test_auto_fused_when_probe_passes(self, monkeypatch):
+        key = ("fused_block", jax.default_backend())
+        monkeypatch.setitem(probe_mod._PROBE_CACHE, key, True)
+        eng = StreamingSweep(KMeans(n_init=1), _cfg())
+        assert eng.fuse_block == "fused"
+        assert eng.fused_kernel == "pallas"
+
+    def test_auto_falls_back_on_probe_failure(self, monkeypatch):
+        # A Mosaic lowering failure is cached as False by probe_cached;
+        # "auto" must degrade to the unfused path, not interpret mode.
+        key = ("fused_block", jax.default_backend())
+        monkeypatch.setitem(probe_mod._PROBE_CACHE, key, False)
+        eng = StreamingSweep(KMeans(n_init=1), _cfg())
+        assert eng.fuse_block == "unfused"
+
+    def test_on_runs_interpret_where_probe_fails(self, monkeypatch):
+        key = ("fused_block", jax.default_backend())
+        monkeypatch.setitem(probe_mod._PROBE_CACHE, key, False)
+        eng = StreamingSweep(KMeans(n_init=1), _cfg(fuse_block="on"))
+        assert eng.fuse_block == "fused"
+        assert eng.fused_kernel == "interpret"
+
+
+def _oracle_planes(x_cols, cents, k, idx_local, row0, n, n_words):
+    """Independent oracle: explicit per-lane labels through the
+    PROVEN unfused packer (ops.bitpack.pack_label_planes)."""
+    lanes, k_max, d = cents.shape
+    labels = []
+    for lane in range(lanes):
+        dist = np.maximum(
+            (x_cols * x_cols).sum(1)[:, None]
+            - 2.0 * (x_cols @ cents[lane].T)
+            + (cents[lane] * cents[lane]).sum(1)[None, :],
+            0.0,
+        )
+        dist = np.where(np.arange(k_max)[None, :] < k, dist, np.inf)
+        labels.append(dist.argmin(1).astype(np.int32))
+    labels = np.stack(labels)
+    # pack_label_planes consumes (lanes, n_sub) labels gathered at the
+    # sampled columns; emulate the engine's gather.
+    gath = np.where(
+        idx_local >= 0,
+        np.take_along_axis(
+            labels, np.clip(idx_local, 0, x_cols.shape[0] - 1), axis=1
+        ),
+        -1,
+    )
+    return np.asarray(pack_label_planes(
+        jnp.asarray(gath), jnp.asarray(idx_local), int(k_max), n,
+        n_words=n_words, row0=row0,
+    ))
+
+
+class TestKernelParity:
+    def _case(self, n_cols, d, k_max, lanes, row0, k, seed):
+        rng = np.random.default_rng(seed)
+        x_cols = rng.normal(size=(n_cols, d)).astype(np.float32)
+        cents = rng.normal(size=(lanes, k_max, d)).astype(np.float32)
+        n_sub = max(2, int(0.8 * n_cols))
+        idx = np.stack([
+            np.sort(rng.permutation(n_cols)[:n_sub]).astype(np.int32)
+            for _ in range(lanes)
+        ])
+        if lanes > 1:
+            idx[-1] = -1  # an invalid (h >= h_total) lane drops out
+        n_words = packed_width(row0 + lanes + 3)
+        cop = pack_cosample_planes(
+            jnp.asarray(idx), n_cols, n_words=n_words, row0=row0
+        )
+        args = (
+            jnp.asarray(x_cols), jnp.asarray(cents),
+            jnp.asarray(k, jnp.int32), cop,
+            jnp.asarray(row0, jnp.int32),
+        )
+        got = np.asarray(fused_assign_pack(
+            *args, n_words=n_words, interpret=True
+        ))
+        ref = np.asarray(fused_planes_reference(*args, n_words=n_words))
+        assert got.tobytes() == ref.tobytes()
+        oracle = _oracle_planes(
+            x_cols, cents, k, idx, row0, n_cols, n_words
+        )
+        assert got.tobytes() == oracle.tobytes()
+
+    def test_small_ragged_shape(self):
+        # The one fast compile-bearing case (tier-1 budget rule).
+        self._case(77, 3, 4, 5, 2, 3, 0)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "n_cols,d,k_max,lanes,row0,k,seed",
+        [
+            (300, 7, 5, 13, 3, 4, 1),    # multi-tile, ragged edge
+            (128, 4, 3, 8, 0, 2, 2),     # exact tile boundary
+            (517, 20, 8, 29, 37, 8, 3),  # k == k_max, word-crossing row0
+        ],
+    )
+    def test_shape_family(self, n_cols, d, k_max, lanes, row0, k, seed):
+        self._case(n_cols, d, k_max, lanes, row0, k, seed)
+
+
+class TestEngineParity:
+    @pytest.mark.slow
+    def test_single_device_bit_identity(self):
+        off, on = _run("off"), _run("on")
+        _assert_bit_equal(off, on, _ALL_KEYS)
+        assert on["timing"]["fuse_block"] == "fused"
+        assert on["timing"]["fused_kernel"] == "interpret"
+        assert off["timing"]["fuse_block"] == "unfused"
+        assert "fused_kernel" not in off["timing"]
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "devices,row_shards,k_shards",
+        [(4, 2, 1), (4, 4, 1), (8, 2, 2)],
+    )
+    def test_sharded_mesh_bit_identity(
+        self, devices, row_shards, k_shards
+    ):
+        mesh = resample_mesh(
+            jax.devices()[:devices], row_shards=row_shards,
+            k_shards=k_shards,
+        )
+        _assert_bit_equal(
+            _run("off", mesh), _run("on", mesh), _ALL_KEYS
+        )
+
+    @pytest.mark.slow
+    def test_ragged_h_and_restarts(self):
+        # Partial final block (h=7 under h_block=4) and the best-restart
+        # selector (n_init=2): labels must remain a pure function of the
+        # WINNING restart's centroids.
+        _assert_bit_equal(
+            _run("off", n_init=2, h=7), _run("on", n_init=2, h=7),
+            _ALL_KEYS,
+        )
+
+    @pytest.mark.slow
+    def test_result_fingerprint_identity(self):
+        from consensus_clustering_tpu.autotune.policy import Resolution
+        from consensus_clustering_tpu.serve.executor import (
+            JobSpec,
+            SweepExecutor,
+        )
+
+        class _Fake:
+            backend = staticmethod(lambda: "cpu")
+
+        fps = []
+        for fuse in ("off", "on"):
+            host = _run(fuse, store_matrices=False)
+            spec = JobSpec(
+                k_values=KV, n_iterations=12, accum_repr="packed"
+            )
+            result = SweepExecutor._shape_result(
+                _Fake(), spec, N, D, host,
+                Resolution("stream_h_block", 4, "user-pinned"),
+                0.0, False, 1.0, {},
+            )
+            fps.append(result["result_fingerprint"])
+        assert fps[0] == fps[1]
+
+    @pytest.mark.slow
+    def test_run_fused_discloses(self):
+        eng = StreamingSweep(KMeans(n_init=1), _cfg(fuse_block="on"))
+        solo = eng.run(_x(), 3, 12)
+        fused = eng.run_fused([_x(), _x(1)], [3, 4], 12)
+        assert fused[0]["timing"]["fuse_block"] == "fused"
+        assert fused[0]["timing"]["fused_kernel"] == "interpret"
+        _assert_bit_equal(solo, fused[0], _CURVE_KEYS)
+
+
+class TestResume:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("writer,resumer", [
+        ("on", "off"), ("off", "on"),
+    ])
+    def test_cross_path_resume_bit_identical(
+        self, tmp_path, writer, resumer
+    ):
+        # A ring written under one path must resume under the other and
+        # land byte-equal to a clean run: the planes ARE the state, and
+        # both paths write identical planes.
+        from consensus_clustering_tpu.resilience.blocks import (
+            StreamCheckpointer,
+        )
+        from consensus_clustering_tpu.resilience.faults import faults
+
+        x = _x()
+        clean = StreamingSweep(
+            KMeans(n_init=1), _cfg(fuse_block="off")
+        ).run(x, 7, 12)
+        ck = StreamCheckpointer(str(tmp_path / "ring"), every=1)
+        try:
+            faults.configure("block_start=2")
+            with pytest.raises(Exception):
+                StreamingSweep(
+                    KMeans(n_init=1), _cfg(fuse_block=writer)
+                ).run(x, 7, 12, checkpointer=ck)
+            faults.configure("")
+            resumed = StreamingSweep(
+                KMeans(n_init=1), _cfg(fuse_block=resumer)
+            ).run(x, 7, 12, checkpointer=ck)
+        finally:
+            faults.configure("")
+            ck.close()
+        assert resumed["streaming"]["resumed_from_block"] > 0
+        _assert_bit_equal(clean, resumed, _CURVE_KEYS)

@@ -236,7 +236,7 @@ def test_registry_is_complete():
     # JL000 (stale-suppression, synthesized by the runner) plus the
     # per-file/project rules JL001-JL018.
     ids = sorted(r.id for r in all_rules())
-    assert ids == [f"JL{i:03d}" for i in range(0, 19)]
+    assert ids == [f"JL{i:03d}" for i in range(0, 20)]
 
 
 def test_rule_packs_name_registered_rules():
@@ -246,7 +246,7 @@ def test_rule_packs_name_registered_rules():
     for pack, rule_ids_ in RULE_PACKS.items():
         assert set(rule_ids_) <= ids, pack
     assert RULE_PACKS["estimator"] == ("JL009",)
-    assert RULE_PACKS["packed"] == ("JL010",)
+    assert RULE_PACKS["packed"] == ("JL010", "JL019")
     assert RULE_PACKS["serve-concurrency"] == ("JL011", "JL012", "JL013")
     assert RULE_PACKS["import-hygiene"] == ("JL014", "JL015")
     assert RULE_PACKS["contract-sync"] == ("JL016", "JL017", "JL018")
@@ -260,7 +260,7 @@ def test_select_rules_resolves_packs():
         "JL011", "JL012", "JL013",
     }
     assert {r.id for r in select_rules(["estimator", "packed"])} == {
-        "JL009", "JL010",
+        "JL009", "JL010", "JL019",
     }
     core = {r.id for r in select_rules(["core"])}
     assert {"JL000", "JL001", "JL008"} <= core
@@ -366,6 +366,65 @@ def test_jl010_clean_in_packed_modules(tmp_path):
 def test_jl010_silent_elsewhere(tmp_path):
     active = _lint_named_module(tmp_path, _JL010_FIRES, "other.py")
     assert "JL010" not in rule_ids(active)
+
+
+# JL019 guards the fused assign+pack path (FUSED_PATH_MODULES or a
+# fused/ directory): labels must never materialise as a dense int32
+# buffer there, and the round-trip packer must stay in the unfused
+# engine branch.
+
+_JL019_FIRES = """
+from consensus_clustering_tpu.ops.bitpack import pack_label_planes
+
+def bad(hb, n, labels, idx, k_max):
+    buf = jnp.zeros((hb, n), jnp.int32)  # dense label buffer
+    return buf, pack_label_planes(labels, idx, k_max, n)
+"""
+
+_JL019_CLEAN = """
+def good(k_max, wb, n, tile_c, d, lanes):
+    planes = jnp.zeros((k_max, wb, n), jnp.uint32)   # bit-planes
+    samp = jnp.zeros((1, tile_c), jnp.int32)         # one symbolic dim
+    x_aug = jnp.zeros((n, d), jnp.float32)           # f32 data tile
+    cents = jnp.zeros((lanes, k_max, d), jnp.float32)
+    return planes, samp, x_aug, cents
+"""
+
+
+def test_jl019_fires_in_fused_module(tmp_path):
+    active = _lint_named_module(
+        tmp_path, _JL019_FIRES, "pallas_fused_block.py"
+    )
+    lines = [f for f in active if f.rule == "JL019"]
+    assert len(lines) == 2, [(f.line, f.message) for f in active]
+
+
+def test_jl019_fires_in_fused_directory(tmp_path):
+    active = _lint_in_pack(tmp_path, _JL019_FIRES, "fused")
+    assert len([f for f in active if f.rule == "JL019"]) == 2
+
+
+def test_jl019_clean_in_fused_module(tmp_path):
+    active = _lint_named_module(
+        tmp_path, _JL019_CLEAN, "pallas_fused_block.py"
+    )
+    assert "JL019" not in rule_ids(active)
+
+
+def test_jl019_silent_elsewhere(tmp_path):
+    # The unfused engine branch (streaming.py) and the packed modules
+    # legitimately carry labels + pack_label_planes.
+    for filename in ("other.py", "bitpack.py"):
+        active = _lint_named_module(tmp_path, _JL019_FIRES, filename)
+        assert "JL019" not in rule_ids(active)
+
+
+def test_jl019_real_fused_module_is_clean():
+    import consensus_clustering_tpu.ops.pallas_fused_block as mod
+
+    active, _, error = lint_file(mod.__file__)
+    assert error is None
+    assert "JL019" not in rule_ids(active)
 
 
 # ---------------------------------------------------------------------------
