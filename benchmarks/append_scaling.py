@@ -1,0 +1,282 @@
+"""Oracle parity + marginal-cost gate for the append subsystem.
+
+For each shape the harness plays the whole append story end to end:
+
+1. **Bootstrap** a parent run at ``N_old`` rows (packed exact sweep,
+   planes captured into a :class:`PlaneStore` as generation 0) — on
+   the bundled ``corr.csv`` the parent is the dataset with its last
+   rows DROPPED, so the append puts back exactly the rows the full
+   dataset carries, and on synthetic blobs the parent is a prefix of
+   a larger draw.
+2. **Append** the held-out rows (``run_append``): only the marginal
+   lanes touch the device, the stored generation is widened and
+   merged with exact integer Iij accounting, and the DKW staleness
+   verdict judges old-vs-new drift.
+3. **Oracle**: a from-scratch packed run over the full ``N_new`` rows
+   at the cumulative lane budget ``H_total`` — the statistic the
+   append approximates.
+4. **Gates** (all must hold at every shape for ``passed``):
+   - parity: per-K sup-norm CDF distance and |PAC delta| between the
+     append and the oracle within the DISCLOSED bound (two DKW bands
+     composed by triangle inequality — the merged statistic's
+     weakest-pair band at ``H_new`` plus the oracle's at ``H_total``,
+     both on the pairs-only scale; heuristic model, disclosed not
+     proven — see append/staleness.py);
+   - staleness: bound >= observed drift (``refresh_recommended`` is
+     False — the append is servable at marginal cost);
+   - accounting: merged Iij == widened old + new, bit-identical
+     (``run_append`` raises otherwise);
+   - cost: the WARM-engine marginal wall beats the warm full-recompute
+     wall at every ΔN/N <= 0.25 shape (engines are run twice and the
+     second wall is recorded, so one-time compile does not drown the
+     per-lane story at CPU smoke shapes).
+
+The committed record follows the adaptive_tol calibration grammar:
+top-level ``{harness, gate, generated_at, passed, shapes}`` with a
+``parity`` block per shape (``{gate, k_values_compared, max_pac_delta,
+max_cdf_error, bound, passed}``) and the marginal-cost curve rows.
+
+Run (CPU is fine; the gates are statistical + relative-wall)::
+
+    JAX_PLATFORMS=cpu python benchmarks/append_scaling.py \\
+        --out benchmarks/append_scaling/APPEND_SCALING.json
+
+Exit 1 when any gate fails at any shape.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+#: (name, n_old, n_new, h_old, h_new, stream_h_block).  ΔN/N <= 0.25
+#: everywhere — the regime the acceptance gate prices.  corr.csv is
+#: 29 rows; its parent drops the last 5 and the append restores them.
+SHAPES = (
+    ("corr_drop5", 24, 29, 40, 10, 5),
+    ("blobs_96_to_120", 96, 120, 40, 10, 5),
+    ("blobs_160_to_200", 160, 200, 48, 12, 6),
+)
+
+K_VALUES = (2, 3)
+SEED = 23
+D_BLOBS = 4
+
+
+def _blobs(n, d, rng):
+    half = n // 2
+    return np.concatenate([
+        rng.normal(0.0, 0.3, (half, d)),
+        rng.normal(3.0, 0.3, (n - half, d)),
+    ]).astype(np.float32)
+
+
+def _data_for(name, n_new):
+    if name.startswith("corr"):
+        from consensus_clustering_tpu import load_corr
+
+        x = np.asarray(load_corr(transform=True), dtype=np.float32)
+        if x.shape[0] < n_new:
+            raise SystemExit(
+                f"corr.csv has {x.shape[0]} rows, shape wants {n_new}"
+            )
+        return x[:n_new]
+    return _blobs(n_new, D_BLOBS, np.random.default_rng(SEED))
+
+
+def _config(n, d, h, h_block):
+    from consensus_clustering_tpu.config import SweepConfig
+
+    return SweepConfig(
+        n_samples=n, n_features=d, k_values=K_VALUES,
+        n_iterations=h, subsampling=0.8, store_matrices=False,
+        accum_repr="packed", stream_h_block=h_block,
+        adaptive_tol=None,
+    )
+
+
+def _warm_wall(clusterer, config, x, seed, h):
+    """Second-run wall of ONE engine instance: the first run pays the
+    block-program compile, the second is the warm per-lane truth."""
+    from consensus_clustering_tpu.parallel.streaming import (
+        StreamingSweep,
+    )
+
+    engine = StreamingSweep(clusterer, config)
+    engine.run(x, seed, h)
+    t0 = time.perf_counter()
+    engine.run(x, seed, h)
+    return time.perf_counter() - t0
+
+
+def run_shape(name, n_old, n_new, h_old, h_new, h_block):
+    from consensus_clustering_tpu.append import (
+        PlaneStore, bootstrap_generation, generation_seed, run_append,
+    )
+    from consensus_clustering_tpu.append.staleness import (
+        generation_epsilon,
+    )
+    from consensus_clustering_tpu.estimator.bounds import (
+        pair_cdf_scale,
+    )
+    from consensus_clustering_tpu.models.kmeans import KMeans
+
+    import tempfile
+
+    x_full = _data_for(name, n_new)
+    x_old = x_full[:n_old]
+    d = int(x_full.shape[1])
+    clusterer = KMeans(max_iter=8)
+    h_total = h_old + h_new
+
+    store = PlaneStore(
+        os.path.join(tempfile.mkdtemp(prefix="append_scaling_"), "pl")
+    )
+    cfg_old = _config(n_old, d, h_old, h_block)
+    bootstrap_generation(
+        x_old, config=cfg_old, clusterer=clusterer, seed=SEED,
+        store=store,
+        clusterer_meta={"name": "kmeans", "options": {}},
+    )
+
+    appended = run_append(
+        store, x_full, h_new=h_new, clusterer=clusterer,
+        stream_h_block=h_block,
+        k_values=K_VALUES, subsampling=0.8,
+        clusterer_name="kmeans", clusterer_options={},
+    )
+    ap = appended["append"]
+
+    cfg_full = _config(n_new, d, h_total, h_block)
+    oracle = bootstrap_generation(
+        x_full, config=cfg_full, clusterer=clusterer, seed=SEED,
+        n_iterations=h_total,
+    )
+
+    pac_append = [float(v) for v in np.asarray(appended["pac_area"])]
+    pac_oracle = [float(v) for v in np.asarray(oracle["pac_area"])]
+    cdf_append = [np.asarray(c, dtype=np.float64)
+                  for c in appended["cdf"]]
+    cdf_oracle = [np.asarray(c, dtype=np.float64)
+                  for c in np.asarray(oracle["cdf"])]
+    cdf_sup = [float(np.max(np.abs(a - o)))
+               for a, o in zip(cdf_append, cdf_oracle)]
+    pac_abs = [abs(a - o) for a, o in zip(pac_append, pac_oracle)]
+    # Disclosed append-vs-oracle band: the merged statistic's weakest
+    # pairs (new rows) carry only the h_new fresh lanes, the oracle's
+    # carry h_total — two DKW bands through the truth.
+    scale = float(pair_cdf_scale(n_new, True))
+    bound = (
+        generation_epsilon(h_new, 0.8)
+        + generation_epsilon(h_total, 0.8)
+    ) * scale
+
+    # Warm-engine walls: marginal lanes at N_new vs full H_total at
+    # N_new, both on their second run.
+    seed_g = generation_seed(SEED, int(ap["generation"]))
+    cfg_marginal = _config(n_new, d, h_new, h_block)
+    wall_append = _warm_wall(clusterer, cfg_marginal, x_full,
+                             seed_g, h_new)
+    wall_full = _warm_wall(clusterer, cfg_full, x_full, SEED, h_total)
+
+    staleness = ap["staleness"]
+    parity = {
+        "gate": "dkw_bound",
+        "k_values_compared": len(K_VALUES),
+        "max_pac_delta": max(pac_abs),
+        "max_cdf_error": max(cdf_sup),
+        "bound": bound,
+        "passed": max(cdf_sup) <= bound and max(pac_abs) <= bound,
+    }
+    cost = {
+        "dn_over_n": round((n_new - n_old) / n_new, 4),
+        "marginal_lane_fraction": ap["marginal_lane_fraction"],
+        "wall_append_warm_seconds": round(wall_append, 4),
+        "wall_full_warm_seconds": round(wall_full, 4),
+        "wall_ratio": round(wall_append / max(wall_full, 1e-9), 4),
+        "passed": wall_append < wall_full,
+    }
+    stale_gate = {
+        "drift": staleness["drift"],
+        "bound": staleness["bound"],
+        "refresh_recommended": staleness["refresh_recommended"],
+        "passed": not staleness["refresh_recommended"],
+    }
+    return {
+        "shape": name,
+        "n_old": n_old, "n_new": n_new,
+        "h_old": int(ap["h_old"]), "h_new": int(ap["h_new"]),
+        "h_total": int(ap["h_total"]),
+        "k_values": list(K_VALUES),
+        "seed": SEED,
+        "pac_append": [round(v, 6) for v in pac_append],
+        "pac_oracle": [round(v, 6) for v in pac_oracle],
+        "iij_bit_identical": bool(ap["iij_bit_identical"]),
+        "parity": parity,
+        "cost": cost,
+        "staleness": stale_gate,
+        "staleness_report": staleness,
+        "passed": (
+            parity["passed"] and cost["passed"] and stale_gate["passed"]
+            and bool(ap["iij_bit_identical"])
+        ),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "append_scaling", "APPEND_SCALING.json",
+        ),
+    )
+    args = parser.parse_args(argv)
+
+    import jax
+
+    shapes = []
+    for shape in SHAPES:
+        print(f"[append_scaling] {shape[0]} ...", flush=True)
+        row = run_shape(*shape)
+        print(
+            f"[append_scaling]   parity max_cdf="
+            f"{row['parity']['max_cdf_error']:.4f} "
+            f"bound={row['parity']['bound']:.4f} | "
+            f"wall {row['cost']['wall_append_warm_seconds']:.3f}s vs "
+            f"{row['cost']['wall_full_warm_seconds']:.3f}s | "
+            f"drift {row['staleness']['drift']:.4f} <= "
+            f"{row['staleness']['bound']:.4f} | "
+            f"passed={row['passed']}", flush=True,
+        )
+        shapes.append(row)
+
+    record = {
+        "harness": "benchmarks/append_scaling.py",
+        "gate": "append_parity+marginal_cost+staleness_bound",
+        "generated_at": round(time.time(), 3),
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "passed": all(row["passed"] for row in shapes),
+        "shapes": shapes,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"[append_scaling] wrote {args.out} "
+          f"passed={record['passed']}")
+    return 0 if record["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

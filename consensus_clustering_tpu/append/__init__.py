@@ -1,0 +1,71 @@
+"""Incremental consensus for growing datasets (ROADMAP item 2).
+
+The reference implementation recomputes everything per ``fit``; this
+subsystem turns a completed PACKED exact run into a reusable artifact —
+a digest-verified **plane store** of per-K uint32 co-membership
+bit-planes plus the Iij co-sampling plane — and answers row-append
+requests (``N -> N + dN``) at marginal cost: only the NEW resample
+lanes run on device, the old generations' counts are reused exactly.
+
+- :mod:`.store`     — the persistent plane store: per-generation packed
+  planes + manifest with per-array digests, written atomically next to
+  the checkpoint ring; torn writes refuse verification (the loader
+  falls back to the previous verified generation, or refuses outright
+  — never a silent mix of generations).
+- :mod:`.mixing`    — numpy-only exact count mixing: widen old planes
+  over the grown element axis (exact — old resamples never sampled the
+  new rows), merge lane generations along the word axis, popcount out
+  Mij/Iij with bit-identical integer accounting, and port the curve
+  semantics of :mod:`~consensus_clustering_tpu.ops.analysis` bit for
+  bit (f32 consensus divide, edge-comparison histogram, parity-zeros
+  dilution).
+- :mod:`.staleness` — DKW-backed "has the clustering moved?" verdict:
+  old-generation vs new-generation CDFs over the OLD rows, drift
+  judged against a disclosed bound from
+  :mod:`~consensus_clustering_tpu.estimator.bounds`, emitting
+  ``refresh_recommended`` so the service schedules full recomputes
+  only when the bound says to.
+- :mod:`.engine`    — the append engine: verify the parent store and
+  the data prefix, draw the new generation's lanes with a
+  generation-tagged ``fold_in`` seed stream through the EXISTING
+  packed streaming block step, merge, judge staleness, and write the
+  next store generation atomically.
+
+PEP-562 lazy like :mod:`~consensus_clustering_tpu.estimator`:
+importing the package must not pull jax/numpy, so the no-dependency
+CLI paths (lint, serve-admin) keep their import-time pins.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "PlaneStore": "consensus_clustering_tpu.append.store",
+    "PlaneStoreError": "consensus_clustering_tpu.append.store",
+    "STORE_SCHEMA": "consensus_clustering_tpu.append.store",
+    "merge_generations": "consensus_clustering_tpu.append.mixing",
+    "pair_counts": "consensus_clustering_tpu.append.mixing",
+    "curves_from_counts": "consensus_clustering_tpu.append.mixing",
+    "widen_planes": "consensus_clustering_tpu.append.mixing",
+    "staleness_report": "consensus_clustering_tpu.append.staleness",
+    "run_append": "consensus_clustering_tpu.append.engine",
+    "bootstrap_generation": "consensus_clustering_tpu.append.engine",
+    "generation_seed": "consensus_clustering_tpu.append.engine",
+    "check_compat": "consensus_clustering_tpu.append.engine",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,412 @@
+"""The append engine: marginal-cost consensus for a grown dataset.
+
+``run_append`` answers ``N_old -> N_new`` with only ``h_new`` fresh
+resample lanes on device: the parent's digest-verified plane store
+supplies every old lane's counts exactly (:mod:`.store`), the fresh
+generation runs through the EXISTING packed streaming block step
+(:class:`~consensus_clustering_tpu.parallel.streaming.StreamingSweep`
+— same mesh axes, same kernels, same per-block callbacks), and
+:mod:`.mixing` merges the generations with bit-identical integer
+accounting.  The result carries :mod:`.staleness`'s DKW-backed
+``refresh_recommended`` verdict and, unless disabled, the merged state
+is written back as the store's next generation — atomically, so a
+crash mid-append leaves the previous generation verifiable.
+
+Seed discipline: generation ``g``'s lanes draw from a seed derived by
+``fold_in``-ing the ROOT seed with the generation number
+(:func:`generation_seed`), so no appended lane can ever replay a
+previous generation's resample stream — the same global-index
+fold-in discipline the streaming driver already uses within a run.
+
+Any verification failure — missing store, torn write, schema skew,
+data-prefix mismatch, config mismatch — raises
+:class:`~consensus_clustering_tpu.append.store.PlaneStoreError`; the
+serving executor's contract is to fall back to a FULL recompute with
+the failure reason disclosed in the result, never to mix generations
+that did not verify.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from consensus_clustering_tpu.append.mixing import (
+    curves_for_planes,
+    iij_counts,
+    merge_generations,
+    widen_planes,
+)
+from consensus_clustering_tpu.append.staleness import staleness_report
+from consensus_clustering_tpu.append.store import (
+    PlaneStore,
+    PlaneStoreError,
+)
+from consensus_clustering_tpu.config import SweepConfig
+from consensus_clustering_tpu.estimator.bounds import DEFAULT_DELTA
+from consensus_clustering_tpu.utils.checkpoint import data_fingerprint
+
+#: SweepConfig fields that must MATCH between the parent's stored
+#: config and an append request for the generations to measure the
+#: same statistic (everything that shapes counts or curves; execution
+#: knobs like stream_h_block / kernels are free to differ).
+_COMPAT_FIELDS = (
+    "k_values",
+    "subsampling",
+    "bins",
+    "pac_interval",
+    "parity_zeros",
+    "dtype",
+)
+
+
+def generation_seed(seed: int, generation: int) -> int:
+    """Derive generation ``g``'s lane seed from the root seed.
+
+    Generation 0 IS the parent run (its seed is the root seed
+    verbatim); later generations fold the generation number into the
+    root key and draw an int seed from it — deterministic, and
+    disjoint from every other generation's stream by the same
+    ``fold_in`` discipline the resample plan uses per lane.
+    """
+    if int(generation) == 0:
+        return int(seed)
+    import jax
+
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(int(seed)), int(generation)
+    )
+    return int(jax.random.randint(key, (), 0, 2**31 - 1))
+
+
+def config_payload(config: SweepConfig) -> Dict[str, Any]:
+    """The JSON-able SweepConfig payload a manifest stores."""
+    return dataclasses.asdict(config)
+
+
+def config_from_manifest(
+    manifest: Dict[str, Any],
+    *,
+    n_samples: int,
+    n_iterations: int,
+    stream_h_block: Optional[int] = None,
+) -> SweepConfig:
+    """Rebuild the new generation's SweepConfig from the manifest.
+
+    Statistic-shaping fields come from the STORE (they are the compat
+    contract); shape and lane budget are the append's own; execution
+    knobs (block size) may be overridden; matrices/adaptive stay off
+    (the append path needs the packed planes, not dense outputs, and
+    generation H accounting requires the full budget to run).
+    """
+    payload = dict(manifest["config"])
+    payload["n_samples"] = int(n_samples)
+    payload["n_iterations"] = int(n_iterations)
+    payload["k_values"] = tuple(
+        int(k) for k in payload["k_values"]
+    )
+    payload["pac_interval"] = tuple(payload["pac_interval"])
+    payload["store_matrices"] = False
+    payload["adaptive_tol"] = None
+    payload["accum_repr"] = "packed"
+    if stream_h_block is not None:
+        payload["stream_h_block"] = int(stream_h_block)
+    if payload.get("stream_h_block") is None:
+        payload["stream_h_block"] = max(
+            1, min(32, int(n_iterations))
+        )
+    return SweepConfig(**payload)
+
+
+def check_compat(
+    manifest: Dict[str, Any],
+    x: np.ndarray,
+    **expected: Any,
+) -> Optional[str]:
+    """Reason the append CANNOT reuse this store, or None if it can.
+
+    ``expected`` holds the request's statistic-shaping fields (any of
+    ``_COMPAT_FIELDS``); each given one must equal the stored config's.
+    The data contract is prefix identity: the first ``n_old`` rows of
+    ``x`` must be BYTE-identical to the parent's data (same dtype,
+    same values — :func:`~consensus_clustering_tpu.utils.checkpoint.
+    data_fingerprint`), because the old lanes' counts are only exact
+    for exactly those rows.
+    """
+    n_old = int(manifest.get("n", -1))
+    n_new = int(x.shape[0])
+    if n_old < 1:
+        return "manifest_missing_n"
+    if n_new < n_old:
+        return f"shrunk_dataset:{n_new}<{n_old}"
+    if int(x.shape[1]) != int(manifest.get("n_features", -1)):
+        return "feature_count_mismatch"
+    meta = manifest.get("clusterer") or {}
+    want_name = expected.pop("clusterer_name", None)
+    if want_name is not None and meta.get("name") != want_name:
+        return "config_mismatch:clusterer"
+    want_opts = expected.pop("clusterer_options", None)
+    if want_opts is not None and dict(want_opts) != dict(
+        meta.get("options") or {}
+    ):
+        return "config_mismatch:clusterer_options"
+    stored = manifest.get("config") or {}
+    for field in _COMPAT_FIELDS:
+        want = expected.get(field)
+        if want is None:
+            continue
+        have = stored.get(field)
+        if isinstance(have, list):
+            have = tuple(have)
+        if isinstance(want, (list, tuple)):
+            want = tuple(want)
+        if have != want:
+            return f"config_mismatch:{field}"
+    prefix_sha = data_fingerprint(np.ascontiguousarray(x[:n_old]))
+    if prefix_sha != manifest.get("data_sha"):
+        return "data_prefix_mismatch"
+    return None
+
+
+def _base_manifest(
+    config: SweepConfig,
+    seed: int,
+    data_sha: str,
+    h_done: int,
+    generations: List[Dict[str, Any]],
+    clusterer_meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    return {
+        "n": int(config.n_samples),
+        "n_features": int(config.n_features),
+        "k_values": [int(k) for k in config.k_values],
+        "seed": int(seed),
+        "h_done": int(h_done),
+        "data_sha": data_sha,
+        "config": config_payload(config),
+        # Clusterer identity rides OUTSIDE SweepConfig, so it must be
+        # recorded explicitly or cross-clusterer appends would verify.
+        "clusterer": dict(clusterer_meta or {}),
+        "generations": list(generations),
+    }
+
+
+def write_generation_zero(
+    store: PlaneStore,
+    x: np.ndarray,
+    *,
+    config: SweepConfig,
+    seed: int,
+    final_state: Dict[str, np.ndarray],
+    h_done: int,
+    clusterer_meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Persist a completed packed exact run's captured state as the
+    store's generation 0 — the artifact every later append builds on.
+    Returns the manifest written."""
+    manifest = _base_manifest(
+        config, seed, data_fingerprint(np.ascontiguousarray(x)),
+        h_done,
+        [{
+            "generation": 0,
+            "h": int(h_done),
+            "n": int(config.n_samples),
+            "seed": int(seed),
+        }],
+        clusterer_meta=clusterer_meta,
+    )
+    store.write_generation(0, manifest, final_state)
+    return manifest
+
+
+def bootstrap_generation(
+    x: np.ndarray,
+    *,
+    config: SweepConfig,
+    clusterer,
+    seed: int,
+    n_iterations: Optional[int] = None,
+    store: Optional[PlaneStore] = None,
+    block_callback: Optional[Callable] = None,
+    clusterer_meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Run one packed exact sweep from scratch, capture its planes, and
+    (when ``store`` is given) persist them as generation 0.
+
+    The library-level parent bootstrap (benchmarks, tests) AND the
+    serving executor's full-recompute fallback both go through here,
+    so the fallback's from-scratch statistic is produced by exactly
+    the code the happy path's parents are.
+    """
+    from consensus_clustering_tpu.parallel.streaming import (
+        StreamingSweep,
+    )
+
+    h = int(n_iterations if n_iterations is not None
+            else config.n_iterations)
+    engine = StreamingSweep(clusterer, config)
+    out = engine.run(
+        x, int(seed), h,
+        block_callback=block_callback,
+        capture_state=True,
+    )
+    final_state = out.pop("final_state")
+    h_done = int(out["streaming"]["h_effective"])
+    if store is not None:
+        write_generation_zero(
+            store, x,
+            config=config, seed=int(seed),
+            final_state=final_state, h_done=h_done,
+            clusterer_meta=clusterer_meta,
+        )
+        out["store_written"] = True
+    out["final_state"] = final_state
+    return out
+
+
+def run_append(
+    store: PlaneStore,
+    x: np.ndarray,
+    *,
+    h_new: int,
+    clusterer,
+    stream_h_block: Optional[int] = None,
+    block_callback: Optional[Callable] = None,
+    write_store: bool = True,
+    delta: float = DEFAULT_DELTA,
+    **expected: Any,
+) -> Dict[str, Any]:
+    """Answer an append request from a verified plane store.
+
+    Steps: load + verify the newest store generation; check data/config
+    compatibility (``expected`` — see :func:`check_compat`); run ONLY
+    ``h_new`` fresh lanes over the grown data with the generation-
+    tagged seed; judge staleness old-vs-new over the old rows; merge
+    the generations exactly; compute the combined per-K curves; write
+    the merged state back as the next generation.  Raises
+    :class:`PlaneStoreError` on ANY verification failure — the caller
+    falls back to a full recompute, generations are never mixed with
+    unverified bytes.
+
+    Returns the serving host dict (``pac_area``/``cdf``/``streaming``)
+    plus the ``append`` disclosure block (generation lineage, marginal
+    accounting, staleness verdict) and the new-lane run's timing.
+    """
+    manifest, old_arrays = store.load_latest()
+    reason = check_compat(manifest, x, **expected)
+    if reason is not None:
+        raise PlaneStoreError(reason)
+
+    n_new = int(x.shape[0])
+    n_old = int(manifest["n"])
+    h_old = int(manifest["h_done"])
+    generation = int(manifest["generation"]) + 1
+    root_seed = int(manifest["seed"])
+    seed_g = generation_seed(root_seed, generation)
+    config = config_from_manifest(
+        manifest,
+        n_samples=n_new,
+        n_iterations=int(h_new),
+        stream_h_block=stream_h_block,
+    )
+
+    from consensus_clustering_tpu.parallel.streaming import (
+        StreamingSweep,
+    )
+
+    t0 = time.perf_counter()
+    engine = StreamingSweep(clusterer, config)
+    out = engine.run(
+        x, seed_g, int(h_new),
+        block_callback=block_callback,
+        capture_state=True,
+    )
+    new_arrays = out.pop("final_state")
+    h_eff = int(out["streaming"]["h_effective"])
+
+    staleness = staleness_report(
+        old_arrays, new_arrays,
+        n_old=n_old,
+        k_values=config.k_values,
+        h_old=h_old,
+        h_new=h_eff,
+        subsampling=config.subsampling,
+        bins=config.bins,
+        pac_lo_idx=config.pac_idx[0],
+        pac_hi_idx=config.pac_idx[1],
+        parity_zeros=config.parity_zeros,
+        delta=delta,
+    )
+
+    merged = merge_generations([old_arrays, new_arrays], n_new)
+    # The provable half of the mixing contract, verified on every
+    # append (cheap at serving shapes): merged Iij == widened old Iij
+    # + new Iij, in exact integer arithmetic.
+    iij_old = widen_planes(
+        old_arrays["coplanes"], n_new
+    )
+    iij_check = (
+        iij_counts(merged["coplanes"])
+        == iij_counts(iij_old) + iij_counts(new_arrays["coplanes"])
+    )
+    if not bool(np.all(iij_check)):
+        raise PlaneStoreError(
+            "iij_accounting_violation",
+            "merged Iij != old + new — refusing to serve mixed counts",
+        )
+
+    lo, hi = config.pac_idx
+    curves = curves_for_planes(
+        merged["planes"], merged["coplanes"],
+        bins=config.bins,
+        pac_lo_idx=lo,
+        pac_hi_idx=hi,
+        parity_zeros=config.parity_zeros,
+    )
+
+    store_written = False
+    if write_store:
+        history = list(manifest.get("generations") or [])
+        history.append({
+            "generation": int(generation),
+            "h": int(h_eff),
+            "n": int(n_new),
+            "seed": int(seed_g),
+        })
+        next_manifest = _base_manifest(
+            config, root_seed,
+            data_fingerprint(np.ascontiguousarray(x)),
+            h_old + h_eff, history,
+            clusterer_meta=manifest.get("clusterer"),
+        )
+        store.write_generation(generation, next_manifest, merged)
+        store_written = True
+
+    h_total = h_old + h_eff
+    return {
+        "pac_area": curves["pac_area"],
+        "cdf": curves["cdf"],
+        "streaming": dict(out["streaming"]),
+        "timing": dict(out.get("timing") or {}),
+        "append": {
+            "generation": int(generation),
+            "parent_generation": int(manifest["generation"]),
+            "n_old": n_old,
+            "n_new": n_new,
+            "dn": n_new - n_old,
+            "h_old": h_old,
+            "h_new": h_eff,
+            "h_total": h_total,
+            "marginal_lane_fraction": float(h_eff) / float(
+                max(1, h_total)
+            ),
+            "iij_bit_identical": True,
+            "staleness": staleness,
+            "store_written": store_written,
+            "fallback": False,
+            "run_seconds": time.perf_counter() - t0,
+        },
+    }

@@ -47,11 +47,22 @@ ESTIMATOR_MODES = ("exact", "estimate", "auto")
 #:   a disclosed ``result_upgraded`` frame.  Serving-only: the library
 #:   facade (api.py) has no background queue, so it rejects this mode.
 #:
+#: - ``append`` — incremental consensus for a GROWN dataset
+#:   (docs/SERVING.md "Append runbook"; :mod:`consensus_clustering_tpu.
+#:   append`).  The job names a completed packed exact parent via
+#:   ``config.append_parent`` (its job fingerprint); only the NEW
+#:   resample lanes run on device (``config.n_iterations`` is the
+#:   marginal lane budget), the parent's digest-verified plane store
+#:   supplies the old generations' counts exactly, and the result
+#:   carries a DKW-backed staleness verdict.  Serving-only for the
+#:   same reason as ``progressive``: the plane store lives in the
+#:   scheduler's job store, which the library facade does not have.
+#:
 #: The continuation itself runs under an internal ``refine`` mode that
 #: is deliberately in NEITHER tuple: it can only be constructed by the
 #: scheduler (never submitted over HTTP or via the facade), which keeps
 #: its fingerprint lineage distinct from any client-reachable job.
-SERVING_MODES = ESTIMATOR_MODES + ("progressive",)
+SERVING_MODES = ESTIMATOR_MODES + ("progressive", "append")
 
 #: Exact-mode accumulator representations every surface shares
 #: (api.py ``accum_repr``, the serving ``config.accum_repr`` key,

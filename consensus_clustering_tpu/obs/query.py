@@ -261,6 +261,17 @@ def summarize(
     cont_counts = {
         "enqueued": 0, "completed": 0, "cancelled": 0, "shed": 0,
     }
+    # Append serving (docs/SERVING.md "Append runbook"), likewise from
+    # the JSONL alone: appends served are job_done events in an
+    # ``-append`` bucket; the marginal-vs-full cost ratio rides
+    # plane_store_written (append generations carry
+    # marginal_lane_fraction; 1.0 = disclosed full-recompute fallback);
+    # refresh_recommended events are the staleness verdicts.
+    appends_served = 0
+    plane_stores_written = 0
+    append_fractions: List[float] = []
+    refresh_recommended = 0
+    refresh_max_excess: Optional[float] = None
     retries: Dict[str, int] = {}
     wedges = 0
     drift: Dict[str, int] = {}
@@ -316,6 +327,8 @@ def summarize(
             if jid in prog_submit_ts and isinstance(ts, (int, float)):
                 prog_done_ts[jid] = float(ts)
             bucket = e.get("bucket") or "unknown"
+            if bucket.endswith("-append"):
+                appends_served += 1
             if e.get("job_id"):
                 bucket_of[e["job_id"]] = bucket
             if e.get("seconds") is not None:
@@ -399,6 +412,19 @@ def summarize(
             preflight_inaccurate[bucket] = (
                 preflight_inaccurate.get(bucket, 0) + 1
             )
+        elif name == "plane_store_written":
+            plane_stores_written += 1
+            fraction = e.get("marginal_lane_fraction")
+            if isinstance(fraction, (int, float)):
+                append_fractions.append(float(fraction))
+        elif name == "refresh_recommended":
+            refresh_recommended += 1
+            excess = e.get("drift_excess")
+            if isinstance(excess, (int, float)):
+                refresh_max_excess = (
+                    float(excess) if refresh_max_excess is None
+                    else max(refresh_max_excess, float(excess))
+                )
     queue_wait: Dict[str, List[float]] = {}
     for trace_id, seconds in queue_wait_raw:
         # Never drop a wait for lack of a terminal event: a job still
@@ -464,6 +490,13 @@ def summarize(
             "continuations": dict(cont_counts),
             "time_to_first_answer": stats(ttfa),
             "time_to_exact": stats(tte),
+        },
+        "append": {
+            "appends_served": appends_served,
+            "plane_stores_written": plane_stores_written,
+            "marginal_lane_fraction": stats(append_fractions),
+            "refresh_recommended": refresh_recommended,
+            "max_drift_excess": refresh_max_excess,
         },
         "per_bucket": per_bucket,
         "per_priority": lane_section(per_priority),
@@ -551,6 +584,33 @@ def render_report(report: Dict[str, Any]) -> str:
             f" p95={fmt_opt(ttfa['p95'])} (n={ttfa['count']})"
             f"  time_to_exact p50={fmt_opt(tte['p50'])}"
             f" p95={fmt_opt(tte['p95'])} (n={tte['count']})"
+        )
+    appended = report.get("append") or {}
+    if (
+        appended.get("appends_served")
+        or appended.get("plane_stores_written")
+        or appended.get("refresh_recommended")
+    ):
+        frac = appended["marginal_lane_fraction"]
+        lines.append("")
+        lines.append("append (docs/SERVING.md append runbook):")
+        lines.append(
+            f"  appends_served={appended['appends_served']}"
+            f"  plane_stores_written="
+            f"{appended['plane_stores_written']}"
+            f"  refresh_recommended="
+            f"{appended['refresh_recommended']}"
+        )
+        lines.append(
+            "  marginal-vs-full ratio"
+            f" p50={fmt_opt(frac['p50'])}"
+            f" max={fmt_opt(frac['max'])} (n={frac['count']};"
+            " 1.000 = disclosed full-recompute fallback)"
+            + (
+                f"  max_drift_excess="
+                f"{fmt_opt(appended['max_drift_excess'])}"
+                if appended.get("max_drift_excess") is not None else ""
+            )
         )
     per_worker = report.get("per_worker") or {}
     if per_worker:

@@ -925,6 +925,7 @@ class StreamingSweep:
         checkpointer: Optional["StreamCheckpointer"] = None,
         integrity_check_every: Optional[int] = None,
         tracer=None,
+        capture_state: bool = False,
     ) -> Dict[str, Any]:
         """Stream the sweep; returns host-side results + streaming stats.
 
@@ -997,6 +998,18 @@ class StreamingSweep:
         the writer snapshots the still-device-resident state, so the
         device→host copy and the disk write both happen off the driver
         thread and the double-buffered pipeline never stalls.  The
+        ``capture_state`` (packed representation only) pulls the final
+        accumulator state to the host and returns it as
+        ``out["final_state"]`` — per-K membership bit-planes in
+        K-VALUES order cropped to the words actually populated and the
+        real N (``planes`` (n_ks, k_max, W, N) uint32, ``coplanes``
+        (W, N) uint32), the sufficient statistic the append subsystem's
+        plane store persists.  On an adaptive early stop the live state
+        belongs to the DISCARDED speculative block, so no state is
+        captured (``final_state`` absent) — callers that need the
+        capture run with adaptive stopping off, as the append engine
+        does.
+
         price of that overlap is device memory: the snapshots pin up to
         ~3 accumulator generations on device (the in-flight one, one
         queued, one being serialized — the writer queue is bounded at 1
@@ -1038,6 +1051,12 @@ class StreamingSweep:
         if integrity_check_every is None:
             integrity_check_every = config.integrity_check_every
         integrity_check_every = int(integrity_check_every)
+        if capture_state and self._accum_repr != "packed":
+            raise ValueError(
+                "capture_state requires accum_repr='packed' — the "
+                "plane store persists packed bit-planes, not dense "
+                "accumulators"
+            )
         if integrity_check_every < 0:
             raise ValueError(
                 f"integrity_check_every must be >= 0, got "
@@ -1362,6 +1381,24 @@ class StreamingSweep:
             # and a non-adaptive run always streams every block.
             matrices = jax.tree.map(np.asarray, self._finalize(state))
             out.update(matrices)
+        if capture_state and not stopped_early:
+            # Host-side sufficient statistic for the append subsystem:
+            # unpermute + crop K to k_values order, crop the word axis
+            # to blocks actually run and the element axis to the real
+            # N (identity padding holds no bits).  Never captured on
+            # early stop — the live state is the discarded speculative
+            # block's (see docstring).
+            planes = np.asarray(state["planes"])
+            if self._k_unperm is not None:
+                planes = planes[np.asarray(self._k_unperm)]
+            w_used = -(-int(h_effective) // self._hb_pad) * self._wb
+            n = int(config.n_samples)
+            out["final_state"] = {
+                "planes": planes[: self._n_ks, :, :w_used, :n],
+                "coplanes": np.asarray(
+                    state["coplanes"]
+                )[:w_used, :n],
+            }
         del state
         run_seconds = time.perf_counter() - t0
         total_resamples = h_effective * self._n_ks

@@ -98,6 +98,32 @@ runbook"):
   is DISCLOSED, never a silent swap — the estimate record stands
   untouched under its own fingerprint
 
+Append / plane-store events (docs/SERVING.md "Append runbook"):
+
+- ``append_admitted`` — a ``mode="append"`` job passed admission: it
+  will be priced and run at its MARGINAL lanes against the parent's
+  persistent plane store (job_id, fingerprint, append_parent — the
+  parent job's request fingerprint whose store it widens, n_iterations
+  — the MARGINAL fresh-lane count, the only lanes that touch the
+  device, shape, worker_id); the job's lifecycle
+  then emits ordinary ``job_*`` events with the ``-append`` bucket
+  suffix
+- ``plane_store_written`` — a verifiable plane-store generation landed
+  on disk (job_id, fingerprint, generation, h_done, n, worker_id):
+  generation 0 when a packed exact run captured its final bit-planes,
+  generation >= 1 when an append merged the parent's widened planes
+  with its marginal lanes — append writes also carry
+  ``marginal_lane_fraction``, the marginal-vs-full cost ratio the
+  ``serve-admin report`` append rows aggregate (a fallback append that
+  re-bootstrapped emits generation 0 under its OWN fingerprint with
+  fraction 1.0 — disclosed, never a silent mix)
+- ``refresh_recommended`` — an append's DKW staleness verdict says the
+  accumulated distribution drift over the original rows exceeds the
+  disclosed bound (job_id, fingerprint, drift, bound, drift_excess,
+  worker_id); the append result still stands with its bound in the
+  payload — the event is the operator's signal to schedule a
+  from-scratch refresh
+
 Multi-worker lease events (docs/SERVING.md "Multi-worker runbook"):
 
 - ``lease_takeover``  — this worker claimed an orphan's lease and will

@@ -64,7 +64,7 @@ _CONFIG_KEYS = frozenset(
         "analysis", "delta_k_threshold", "dtype", "chunk_size",
         "stream_h_block", "adaptive_tol", "adaptive_patience",
         "adaptive_min_h", "priority", "mode", "n_pairs", "tenant",
-        "accum_repr",
+        "accum_repr", "append_parent",
     }
 )
 
@@ -182,6 +182,16 @@ class JobSpec:
     # stream_h_block — same-spec jobs at different representations are
     # rare enough that dedup purity loses to plumbing simplicity.
     accum_repr: str = "dense"
+    # Append lineage (docs/SERVING.md "Append runbook"): the PARENT
+    # job's fingerprint when ``mode="append"`` — the completed packed
+    # exact run whose plane store supplies the old lanes' counts.
+    # UNLIKE refine_parent this is part of the result's identity and
+    # stays in the fingerprint: the same grown data appended against
+    # two different parents mixes two different old-lane populations
+    # and must never dedup to one result — and an append must never
+    # alias a from-scratch job either (mode + parent keep the lineages
+    # pairwise distinct, the same discipline as estimate/refine/exact).
+    append_parent: Optional[str] = None
 
     def fingerprint_payload(self) -> Dict[str, Any]:
         """The JSON payload hashed into the job fingerprint.
@@ -198,6 +208,10 @@ class JobSpec:
         payload.pop("priority")
         payload.pop("tenant")
         payload.pop("refine_parent")
+        if self.append_parent is None:
+            # Absent, not null: pre-append fingerprints stay stable
+            # (an old store's results keep deduping new submissions).
+            payload.pop("append_parent")
         payload["k_values"] = list(self.k_values)
         payload["pac_interval"] = list(self.pac_interval)
         payload["clusterer_options"] = dict(self.clusterer_options)
@@ -244,6 +258,7 @@ class JobSpec:
             ),
             # Pre-packed payloads load as dense jobs.
             accum_repr=payload.get("accum_repr", "dense"),
+            append_parent=payload.get("append_parent"),
         )
 
     def bucket(self, n: int, d: int, h_block: Optional[int] = None) -> str:
@@ -260,6 +275,15 @@ class JobSpec:
         payload = self.fingerprint_payload()
         for field in _RUNTIME_FIELDS:
             payload.pop(field)
+        if self.mode == "append":
+            # An append runs the same packed exact block program family
+            # over the grown data — the parent and the mode change the
+            # STATISTIC (and therefore the fingerprint), not the
+            # executable shape.  Normalising the bucket keeps append
+            # jobs in the packed exact executable/SLO vocabulary
+            # instead of forking a parallel bucket per parent.
+            payload["mode"] = "exact"
+            payload.pop("append_parent", None)
         if payload["stream_h_block"] is None:
             payload["stream_h_block"] = h_block
         if self.accum_repr == "packed" and self.mode not in (
@@ -453,7 +477,7 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         )
     n_pairs = cfg.get("n_pairs")
     if n_pairs is not None:
-        if mode == "exact":
+        if mode in ("exact", "append"):
             raise JobSpecError(
                 "config.n_pairs only applies to mode 'estimate', "
                 "'auto' or 'progressive' (the exact engine has no "
@@ -468,6 +492,32 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
                 f"config.n_pairs must be an integer in [16, {2**24}], "
                 f"got {n_pairs!r}"
             )
+    append_parent = cfg.get("append_parent")
+    if mode == "append":
+        if (
+            not isinstance(append_parent, str)
+            or not re.fullmatch(r"[0-9a-f]{16}", append_parent)
+        ):
+            raise JobSpecError(
+                "config.append_parent is required for mode 'append' "
+                "and must be the parent job's 16-hex-char fingerprint, "
+                f"got {append_parent!r}"
+            )
+        if accum_repr != "packed":
+            raise JobSpecError(
+                "mode 'append' requires accum_repr 'packed' — the "
+                "plane store persists packed bit-planes"
+            )
+        if adaptive_tol is not None:
+            raise JobSpecError(
+                "mode 'append' is incompatible with adaptive_tol: "
+                "generation H accounting requires the full marginal "
+                "lane budget to run"
+            )
+    elif append_parent is not None:
+        raise JobSpecError(
+            "config.append_parent only applies to mode 'append'"
+        )
     spec = JobSpec(
         k_values=tuple(int(k) for k in k_values),
         n_iterations=_int("iterations", 25, 2, 100_000),
@@ -493,6 +543,7 @@ def parse_job_spec(body: Dict[str, Any]) -> Tuple[JobSpec, np.ndarray]:
         mode=mode,
         n_pairs=n_pairs,
         accum_repr=accum_repr,
+        append_parent=append_parent,
     )
     return spec, x
 
@@ -531,6 +582,12 @@ class SweepExecutor:
     streaming wins (their difference is the adaptive saving, which is
     why failed attempts advance neither).
     """
+
+    # Capability flag the scheduler duck-types on before passing the
+    # plane-store kwargs (``plane_dir``/``parent_plane_dir``): narrow
+    # test stubs that satisfy only the streaming surface don't accept
+    # them, and must keep working unchanged.
+    supports_plane_store = True
 
     def __init__(
         self,
@@ -610,6 +667,15 @@ class SweepExecutor:
         # working-set unit the way resamples are the sweep's).
         self.estimator_runs_total = 0
         self.estimator_pairs_total = 0
+        # Append subsystem accounting (docs/SERVING.md "Append
+        # runbook"): successful append-mode executions, how many of
+        # them fell back to a full recompute (store missing / torn /
+        # incompatible — each one disclosed in its result), and plane
+        # stores written (generation 0 captures by packed exact runs
+        # PLUS merged generations written by appends).
+        self.append_runs_total = 0
+        self.append_fallback_total = 0
+        self.plane_stores_written_total = 0
         self.checkpoint_writes_total = 0
         self.checkpoint_resume_total = 0
         # Generations the verified-resume gate REFUSED (digest mismatch
@@ -890,8 +956,18 @@ class SweepExecutor:
         heartbeat=None,
         tracer: Optional[Tracer] = None,
         profile_dir: Optional[str] = None,
+        plane_dir: Optional[str] = None,
+        parent_plane_dir: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Execute one streamed sweep; returns the JSON-able result.
+
+        ``plane_dir`` (the jobstore's per-fingerprint plane-store
+        directory) arms the append subsystem: a packed exact run
+        captures its final bit-plane state and persists it there as
+        generation 0 — the reusable artifact later ``mode="append"``
+        jobs build on.  ``parent_plane_dir`` is the PARENT's store for
+        an append job (``spec.append_parent``); append execution is
+        dispatched to :meth:`_run_append`.
 
         ``progress_cb(k, pac)`` fires once per K when the sweep
         completes (the curves are host-side in the streaming driver — no
@@ -943,6 +1019,21 @@ class SweepExecutor:
                 block_cb=block_cb,
                 heartbeat=heartbeat,
                 tracer=tracer,
+            )
+        if spec.mode == "append":
+            # Incremental consensus over a grown dataset: old lanes
+            # from the parent's plane store, ONLY the marginal lanes on
+            # device, exact integer merge + staleness verdict — or a
+            # disclosed full-recompute fallback when the store fails
+            # verification (docs/SERVING.md "Append runbook").
+            return self._run_append(
+                spec, x,
+                progress_cb=progress_cb,
+                block_cb=block_cb,
+                heartbeat=heartbeat,
+                tracer=tracer,
+                plane_dir=plane_dir,
+                parent_plane_dir=parent_plane_dir,
             )
         n, d = x.shape
         engine, compile_seconds, cached, resolution = self._get_engine(
@@ -1121,6 +1212,19 @@ class SweepExecutor:
             profile_ctx = jax.profiler.trace(profile_dir)
         else:
             profile_ctx = contextlib.nullcontext()
+        # Arm the plane-store capture for packed EXACT runs only: the
+        # captured bit-planes ARE the sufficient statistic the append
+        # subsystem reuses; dense/estimate state isn't it, and the
+        # kwarg is passed conditionally because only StreamingSweep's
+        # run() knows it.
+        capture_planes = (
+            plane_dir is not None
+            and spec.accum_repr == "packed"
+            and spec.mode not in ("estimate", "progressive")
+        )
+        capture_kwargs = (
+            {"capture_state": True} if capture_planes else {}
+        )
         try:
             t0 = time.perf_counter()
             with profile_ctx:
@@ -1138,6 +1242,7 @@ class SweepExecutor:
                     checkpointer=checkpointer,
                     integrity_check_every=self.integrity_check_every,
                     tracer=stream_tracer,
+                    **capture_kwargs,
                 )
             # engine.run's curves copies are the completion barrier
             # (run_sweep's rule: block_until_ready can return early on
@@ -1174,6 +1279,43 @@ class SweepExecutor:
                 checkpointer.close()
 
         streaming = host["streaming"]
+
+        # Persist the captured packed state as the job's plane store
+        # (generation 0) — absent on an adaptive early stop (the live
+        # state was the discarded speculative block's).  Best-effort:
+        # the result is valid without the artifact, so a failed write
+        # is DISCLOSED in the result, never fatal to the job.
+        plane_store_block = None
+        final_state = host.pop("final_state", None)
+        if capture_planes and final_state is not None:
+            from consensus_clustering_tpu.append.engine import (
+                write_generation_zero,
+            )
+            from consensus_clustering_tpu.append.store import PlaneStore
+
+            try:
+                manifest = write_generation_zero(
+                    PlaneStore(plane_dir), x,
+                    config=self._config_for(
+                        spec, n, d, int(resolution.value)
+                    ),
+                    seed=int(spec.seed),
+                    final_state=final_state,
+                    h_done=int(streaming["h_effective"]),
+                    clusterer_meta={
+                        "name": spec.clusterer,
+                        "options": dict(spec.clusterer_options),
+                    },
+                )
+                plane_store_block = {
+                    "generation": 0,
+                    "h_done": int(manifest["h_done"]),
+                    "n": int(n),
+                }
+                with self._lock:
+                    self.plane_stores_written_total += 1
+            except (OSError, ValueError) as e:
+                plane_store_block = {"error": str(e)}
 
         # Memory accounting: estimate (the preflight model, at the
         # block size this job actually streamed with) vs measured
@@ -1285,6 +1427,11 @@ class SweepExecutor:
             spec, n, d, host, resolution, compile_seconds, cached,
             run_seconds, memory_block,
         )
+        if plane_store_block is not None:
+            # Production metadata, never identity: whether this run's
+            # packed state was persisted as a reusable append parent
+            # (or why not) changes nothing about the answer.
+            result["plane_store"] = plane_store_block
         if progress_cb is not None and _live():
             for k in result["K"]:
                 progress_cb(int(k), float(result["pac_area"][str(k)]))
@@ -1442,6 +1589,196 @@ class SweepExecutor:
                 progress_cb(int(kk), float(result["pac_area"][str(kk)]))
         return result
 
+    def _run_append(
+        self,
+        spec: JobSpec,
+        x: np.ndarray,
+        progress_cb: Optional[Callable[[int, float], None]] = None,
+        block_cb: Optional[Callable[[int, int, list], None]] = None,
+        heartbeat=None,
+        tracer: Optional[Tracer] = None,
+        plane_dir: Optional[str] = None,
+        parent_plane_dir: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Execute one ``mode="append"`` job (docs/SERVING.md "Append
+        runbook").
+
+        Happy path: the parent's plane store verifies, is compatible
+        with this request's statistic fields and the grown data's
+        prefix, and :func:`~consensus_clustering_tpu.append.engine.
+        run_append` runs ONLY the marginal lanes on device, merges the
+        generations with exact integer accounting, writes the next
+        cumulative generation into the parent's store, and returns the
+        combined curves plus the DKW staleness verdict.
+
+        Fallback path (the chaos contract): ANY verification failure —
+        store missing, torn write (digest mismatch), schema skew,
+        data-prefix or config mismatch — degrades to a FULL
+        from-scratch recompute via :func:`~consensus_clustering_tpu.
+        append.engine.bootstrap_generation`, with the failure reason
+        disclosed in the result's ``append`` block and a fresh
+        generation-0 store written under THIS job's fingerprint.
+        Generations are never silently mixed with unverified bytes.
+
+        Results are shaped by the same ``_shape_result`` as every
+        other path; the ``mode="append"`` semantic field keeps the
+        fingerprint lineage pairwise-distinct from from-scratch exact,
+        estimate and refine results.
+        """
+        from consensus_clustering_tpu.append.engine import (
+            bootstrap_generation,
+            run_append,
+        )
+        from consensus_clustering_tpu.append.store import (
+            PlaneStore,
+            PlaneStoreError,
+        )
+        from consensus_clustering_tpu.serve.preflight import (
+            estimate_append_bytes,
+        )
+        from consensus_clustering_tpu.serve.watchdog import (
+            PHASE_ENGINE_READY,
+        )
+
+        n, d = (int(v) for v in x.shape)
+        resolution = self._resolve_h_block(spec, n, d)
+        clusterer = self._clusterer_for(spec)
+        if heartbeat is not None:
+            heartbeat.beat(PHASE_ENGINE_READY)
+
+        with self._lock:
+            self._cb_gen += 1
+            gen = self._cb_gen
+
+        def _live() -> bool:
+            with self._lock:
+                return self._cb_gen == gen
+
+        def guarded_block_cb(block, h_done, pac_list):
+            # Same dead-generation rule as every other path: nothing
+            # from an abandoned attempt may beat the heartbeat or
+            # reach the event stream.
+            if not _live():
+                return
+            if heartbeat is not None:
+                heartbeat.beat(f"block:{block}")
+            if block_cb is not None:
+                block_cb(block, h_done, pac_list)
+
+        h = int(spec.n_iterations)
+        t0 = time.perf_counter()
+        host = None
+        fallback_reason = None
+        if parent_plane_dir is None:
+            # The scheduler didn't plumb a store location (store-less
+            # embedding, narrow stub): nothing to verify, recompute.
+            fallback_reason = "no_plane_store_dir"
+        else:
+            try:
+                host = run_append(
+                    PlaneStore(parent_plane_dir), x,
+                    h_new=h,
+                    clusterer=clusterer,
+                    stream_h_block=int(resolution.value),
+                    block_callback=guarded_block_cb,
+                    k_values=spec.k_values,
+                    subsampling=spec.subsampling,
+                    bins=spec.bins,
+                    pac_interval=spec.pac_interval,
+                    parity_zeros=spec.parity_zeros,
+                    dtype=spec.dtype,
+                    clusterer_name=spec.clusterer,
+                    clusterer_options=dict(spec.clusterer_options),
+                )
+            except PlaneStoreError as e:
+                fallback_reason = e.reason
+        if host is None:
+            # Full-recompute fallback at the grown N, seeding a fresh
+            # generation-0 store under THIS job's fingerprint so the
+            # lineage can restart from it.
+            store = (
+                PlaneStore(plane_dir) if plane_dir is not None
+                else None
+            )
+            host = bootstrap_generation(
+                x,
+                config=self._config_for(
+                    spec, n, d, int(resolution.value)
+                ),
+                clusterer=clusterer,
+                seed=int(spec.seed),
+                n_iterations=h,
+                store=store,
+                block_callback=guarded_block_cb,
+                clusterer_meta={
+                    "name": spec.clusterer,
+                    "options": dict(spec.clusterer_options),
+                },
+            )
+            host.pop("final_state", None)
+            h_eff = int(host["streaming"]["h_effective"])
+            host["append"] = {
+                "fallback": True,
+                "fallback_reason": fallback_reason,
+                "generation": 0,
+                "n_new": n,
+                "h_new": h_eff,
+                "h_total": h_eff,
+                "marginal_lane_fraction": 1.0,
+                "store_written": bool(host.pop("store_written", False)),
+            }
+        run_seconds = time.perf_counter() - t0
+        streaming = host["streaming"]
+
+        estimate = estimate_append_bytes(
+            n, d, spec.k_values,
+            n_iterations=h,
+            dtype=spec.dtype,
+            h_block=int(resolution.value),
+            subsampling=spec.subsampling,
+        )
+        # Model estimate only, measured fields null — the refine-path
+        # precedent: the merge/mixing half is host-side numpy, so a
+        # device allocator reading would measure the marginal sweep at
+        # most and poison the accountant's correction EWMA.
+        memory_block = {
+            "estimated_bytes": int(estimate["total_bytes"]),
+            "estimate": {
+                key: value
+                for key, value in estimate.items()
+                if key not in ("total_bytes", "model")
+            },
+            "compiled": {},
+            "device_before": {},
+            "device_after": {},
+            "peak_delta_bytes": None,
+            "peak_masked": False,
+            "measured_bytes": None,
+            "measurement_source": None,
+            "preflight_accuracy": None,
+        }
+        with self._lock:
+            self.run_count += 1
+            self.h_requested_total += h
+            self.h_effective_total += int(streaming["h_effective"])
+            self.autotune_provenance[resolution.provenance] = (
+                self.autotune_provenance.get(resolution.provenance, 0)
+                + 1
+            )
+            self.append_runs_total += 1
+            if host["append"].get("fallback"):
+                self.append_fallback_total += 1
+            if host["append"].get("store_written"):
+                self.plane_stores_written_total += 1
+        result = self._shape_result(
+            spec, n, d, host, resolution, 0.0, False,
+            run_seconds, memory_block,
+        )
+        if progress_cb is not None and _live():
+            for kk in result["K"]:
+                progress_cb(int(kk), float(result["pac_area"][str(kk)]))
+        return result
+
     def _shape_result(
         self,
         spec: JobSpec,
@@ -1515,13 +1852,27 @@ class SweepExecutor:
             # both the parent estimate AND a from-scratch exact result
             # — an exactness upgrade is disclosed, never aliased.
             semantic["mode"] = "refine"
+        elif spec.mode == "append":
+            # The append lineage: the counts mix the parent's old-lane
+            # population with fresh marginal lanes over the grown data
+            # — a different statistic from a from-scratch run at the
+            # same shape, so the semantic mode field keeps append
+            # fingerprints pairwise-distinct from exact, estimate AND
+            # refine results: an appended result never aliases a
+            # from-scratch one.
+            semantic["mode"] = "append"
         result_fingerprint = hashlib.sha256(
             json.dumps(semantic, sort_keys=True).encode()
         ).hexdigest()[:16]
-        result_mode = (
-            "estimate" if spec.mode in ("estimate", "progressive")
-            else "exact"
-        )
+        if spec.mode in ("estimate", "progressive"):
+            result_mode = "estimate"
+        elif spec.mode == "append":
+            # Honest labelling: appended counts are exact integers,
+            # but the STATISTIC mixes two lane populations and carries
+            # a staleness bound — "exact" would oversell it.
+            result_mode = "append"
+        else:
+            result_mode = "exact"
         return {
             **semantic,
             # Which engine produced this result — "exact" or
@@ -1542,6 +1893,15 @@ class SweepExecutor:
                 # sweep.
                 {"refined": True}
                 if spec.mode == "refine" else {}
+            ),
+            **(
+                # The append disclosure block: generation lineage,
+                # marginal-cost accounting, the DKW staleness verdict,
+                # and — on fallback — why the store couldn't be used.
+                # Production metadata outside the semantic block (the
+                # semantic mode field already carries the lineage).
+                {"append": dict(host["append"])}
+                if spec.mode == "append" and "append" in host else {}
             ),
             **(
                 # How the result was produced, never what it is: the

@@ -18,6 +18,11 @@ result that a later hit would serve)::
                                        of failing it (crash-resume)
     <dir>/checkpoints/<fingerprint>/   per-job streamed block-checkpoint
                                        ring (resilience.StreamCheckpointer)
+    <dir>/planes/<fingerprint>/        persistent plane store (append
+                                       subsystem, ``append.store``) —
+                                       unlike the ring it SURVIVES job
+                                       completion: it is the artifact
+                                       row-appends build on
     <dir>/leases/<job_id>/token-*.json fenced ownership (serve.leases):
                                        which worker may run — and WRITE —
                                        this job, at which fencing token
@@ -65,6 +70,13 @@ class JobStore:
         self.jobs_dir = os.path.join(directory, "jobs")
         self.payloads_dir = os.path.join(directory, "payloads")
         self.checkpoints_dir = os.path.join(directory, "checkpoints")
+        # Per-parent plane stores (append subsystem): the completed
+        # packed exact run's bit-plane artifact, keyed by job
+        # fingerprint.  A SIBLING of the checkpoint ring, never inside
+        # it — the scheduler clears rings the moment a job completes,
+        # and the plane store must outlive its job (it IS the reusable
+        # artifact appends build on).
+        self.planes_dir = os.path.join(directory, "planes")
         # Per-job fenced ownership leases (serve/leases.py) — which
         # worker may run and WRITE each job, at which fencing token.
         self.leases_dir = os.path.join(directory, "leases")
@@ -76,6 +88,7 @@ class JobStore:
         os.makedirs(self.jobs_dir, exist_ok=True)
         os.makedirs(self.payloads_dir, exist_ok=True)
         os.makedirs(self.checkpoints_dir, exist_ok=True)
+        os.makedirs(self.planes_dir, exist_ok=True)
         os.makedirs(self.leases_dir, exist_ok=True)
         os.makedirs(self.control_dir, exist_ok=True)
         self._sweep_stale_tmps()
@@ -404,6 +417,27 @@ class JobStore:
         block state is dead weight)."""
         try:
             shutil.rmtree(self.checkpoint_dir(fingerprint))
+        except (OSError, ValueError):
+            pass
+
+    # -- per-parent plane stores (append subsystem) ----------------------
+
+    def plane_dir(self, fingerprint: str) -> str:
+        """Directory for a job's persistent plane store
+        (``append.store.PlaneStore``), keyed by the job FINGERPRINT:
+        an append names its parent by fingerprint, and successive
+        appends against the same root parent land their generations in
+        the same store.  Unlike the checkpoint ring this directory
+        survives job completion — it is the artifact, not scaffolding."""
+        if not fingerprint.isalnum():
+            raise ValueError(f"invalid fingerprint {fingerprint!r}")
+        return os.path.join(self.planes_dir, fingerprint)
+
+    def clear_planes(self, fingerprint: str) -> None:
+        """Operator/test retention hook: drop one parent's plane store
+        (appends against it will fall back to full recompute)."""
+        try:
+            shutil.rmtree(self.plane_dir(fingerprint))
         except (OSError, ValueError):
             pass
 

@@ -304,6 +304,68 @@ def estimate_refine_bytes(
     }
 
 
+def estimate_append_bytes(
+    n: int,
+    d: int,
+    k_values: Sequence[int],
+    n_iterations: int = 25,
+    dtype: str = "float32",
+    h_block: int = 16,
+    subsampling: float = 0.8,
+    checkpoints: bool = False,
+) -> Dict[str, Any]:
+    """Estimated footprint of one ``mode="append"`` job — priced by the
+    MARGINAL lanes, which is the entire point of the append path
+    (docs/SERVING.md "Append runbook").
+
+    Two halves, mirroring ``append/engine.py``:
+
+    - **marginal sweep** — the fresh generation's packed streamed run
+      at ``n_iterations`` = the marginal lane budget over the grown N:
+      exactly :func:`estimate_packed_bytes` (no checkpoint pinning —
+      the append path has no ring; a takeover recomputes).
+    - **host mixing** — loading, widening and merging the stored
+      generations plus the exact count contraction: ~3 generations of
+      plane bytes live at the merge peak (old + new + merged — the old
+      generation is ASSUMED no larger than the merged result, i.e. the
+      model prices old ≈ cumulative, disclosed here rather than read
+      from the store the gate hasn't verified yet) and the
+      (N, N) int32 Mij/Iij + f32 Cij tiles — ``mixing_workspace_bytes``,
+      this model's distinguishing key for :func:`check_admission`'s
+      hint branch.  Host-side numpy, priced against the same budget
+      the other models use (the refine model's labmat precedent).
+
+    Monotonic in N, |K| and the marginal ``n_iterations`` by
+    construction.
+    """
+    packed = estimate_packed_bytes(
+        n, d, k_values,
+        n_iterations=n_iterations,
+        dtype=dtype,
+        h_block=h_block,
+        subsampling=subsampling,
+        checkpoints=checkpoints,
+    )
+    n = int(n)
+    plane_store = 3 * int(packed["state_bytes"])
+    mixing = 3 * 4 * n * n
+    total = int(packed["total_bytes"]) + plane_store + mixing
+    return {
+        "marginal_sweep_bytes": int(packed["total_bytes"]),
+        "state_bytes": int(packed["state_bytes"]),
+        "plane_store_bytes": int(plane_store),
+        "mixing_workspace_bytes": int(mixing),
+        "data_bytes": int(packed["data_bytes"]),
+        "lane_bytes": int(packed["lane_bytes"]),
+        "n_iterations": int(max(1, int(n_iterations))),
+        "total_bytes": int(total),
+        "model": "marginal packed sweep (estimate_packed_bytes at the "
+        "marginal lane budget, no ring) + ~3 generations of plane "
+        "bytes at the merge peak + (N, N) host mixing tiles; see "
+        "append/engine.py",
+    }
+
+
 def estimate_estimator_sharded(
     estimate: Dict[str, Any], devices: int
 ) -> Dict[str, Any]:
@@ -461,6 +523,17 @@ def check_admission(
                 f"{sharded['mesh']}, outputs bit-identical to "
                 "single-device — see estimate.sharded) — or " + hint
             )
+    elif "mixing_workspace_bytes" in estimate:
+        # The append model (estimate_append_bytes): marginal packed
+        # sweep + host-side generation mixing — no dense N² accumulator,
+        # no pair sample.
+        hint = (
+            "shrink iterations (the marginal lane budget sizes the new "
+            "generation's bit-plane state) or the K list; the N² "
+            "mixing workspace shrinks only with N; or raise the budget "
+            "(--memory-budget / CCTPU_MEMORY_BUDGET) if the model is "
+            "wrong for your backend"
+        )
     elif "tile_workspace_bytes" in estimate:
         # Packed-representation gate: the mask state is O(nK·k·H·N/32)
         # and the workspace O(N) — the dense hint's "N² accumulator"

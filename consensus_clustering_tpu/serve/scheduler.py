@@ -126,6 +126,7 @@ from consensus_clustering_tpu.serve.leases import (
 from consensus_clustering_tpu.serve.preflight import (
     PreflightReject,
     check_admission,
+    estimate_append_bytes,
     estimate_estimator_bytes,
     estimate_estimator_sharded,
     estimate_job_bytes,
@@ -261,6 +262,13 @@ _EXECUTOR_COUNTER_ATTRS = {
     # cumulative pair-sample gauge.
     "estimator_runs_total": "estimator_runs_total",
     "estimator_pairs_total": "estimator_pairs_total",
+    # Append subsystem (docs/SERVING.md "Append runbook"): successful
+    # append executions, disclosed full-recompute fallbacks among
+    # them, and plane stores written (gen-0 captures + merged
+    # generations).
+    "append_runs_total": "append_runs_total",
+    "append_fallback_total": "append_fallback_total",
+    "plane_stores_written_total": "plane_stores_written_total",
 }
 
 # Executor-owned observability OBJECTS metrics() snapshots (same
@@ -503,6 +511,11 @@ class Scheduler:
         # completed, refined to done, cancelled (client hung up or
         # forwarded parent cancel), or shed/refused at enqueue.
         self.progressive_jobs_total = 0
+        # Append serving (docs/SERVING.md "Append runbook"),
+        # pre-seeded: append jobs admitted against a parent's plane
+        # store (execution-side counters — runs, fallbacks, stores
+        # written — live on the executor).
+        self.append_jobs_total = 0
         self.continuations_enqueued_total = 0
         self.continuations_completed_total = 0
         self.continuations_cancelled_total = 0
@@ -588,6 +601,12 @@ class Scheduler:
             bucket = f"{bucket}-estimate"
         elif mode == "refine":
             bucket = f"{bucket}-refine"
+        elif mode == "append":
+            # Appends run only the MARGINAL lanes plus host-side
+            # mixing — a fourth kind of traffic whose latency and
+            # footprint share nothing with a from-scratch run at the
+            # same shape.
+            bucket = f"{bucket}-append"
         return bucket
 
     def _span_sink(self, payload: Dict[str, Any]) -> None:
@@ -1166,6 +1185,13 @@ class Scheduler:
             # the RECORDS carry the linkage both ways — this side here,
             # the parent's continuation_job_id at enqueue time.
             record["continuation_of"] = spec.refine_parent
+        if getattr(spec, "append_parent", None):
+            # Append lineage is part of the spec's IDENTITY (it is
+            # fingerprinted, unlike refine_parent), but the record
+            # carries it too so the ops surfaces (serve-admin report,
+            # JSONL queries) can follow the lineage without decoding
+            # fingerprint payloads.
+            record["append_parent"] = spec.append_parent
         cached = self.store.get_result(fp)
         if cached is not None:
             record["status"] = "done"
@@ -1273,6 +1299,19 @@ class Scheduler:
         if spec.mode == "progressive":
             with self._lock:
                 self.progressive_jobs_total += 1
+        if spec.mode == "append":
+            with self._lock:
+                self.append_jobs_total += 1
+            # The admission-side append event (docs/SERVING.md "Append
+            # runbook"): the job passed validation + the marginal-cost
+            # preflight and entered the queue against this parent.
+            self.events.emit(
+                "append_admitted", job_id=job_id, fingerprint=fp,
+                append_parent=spec.append_parent,
+                n_iterations=int(spec.n_iterations),
+                shape=record["shape"],
+                worker_id=self.worker_id,
+            )
         self.events.emit(
             "job_submitted", job_id=job_id, fingerprint=fp,
             shape=record["shape"], cached=False, mode=spec.mode,
@@ -1533,6 +1572,20 @@ class Scheduler:
                 subsampling=spec.subsampling,
             )
             estimator_info = None
+        elif mode == "append":
+            # Append jobs are priced by their MARGINAL lanes: the
+            # packed sweep over only the new resamples, plus the plane
+            # store (old + new + merged generations at merge peak) and
+            # the host mixing workspace.  That is the whole point of
+            # the mode — admission must reflect the marginal cost, not
+            # the from-scratch footprint the append avoids.
+            estimate = estimate_append_bytes(
+                n, d, spec.k_values,
+                n_iterations=spec.n_iterations,
+                dtype=spec.dtype, h_block=h_block,
+                subsampling=spec.subsampling,
+            )
+            estimator_info = None
         else:
             estimate = self._exact_estimate(spec, n, d, h_block)
             from consensus_clustering_tpu.estimator.bounds import (
@@ -1691,6 +1744,10 @@ class Scheduler:
                 # continuation lifecycle — enqueued / refined to done /
                 # cancelled / shed at enqueue.
                 "progressive_jobs_total": self.progressive_jobs_total,
+                # Append serving (docs/SERVING.md "Append runbook"):
+                # admissions here; runs/fallbacks/stores written ride
+                # in via the executor counter map.
+                "append_jobs_total": self.append_jobs_total,
                 "continuations_enqueued_total":
                     self.continuations_enqueued_total,
                 "continuations_completed_total":
@@ -2307,6 +2364,21 @@ class Scheduler:
                 run_kwargs["checkpoint_dir"] = self.store.checkpoint_dir(
                     fp
                 )
+            if getattr(self.executor, "supports_plane_store", False):
+                # Persistent plane store (append subsystem): a packed
+                # exact run captures its final bit-planes under
+                # planes/<fingerprint>/ so a later mode="append" job
+                # can widen them instead of recomputing from scratch.
+                # Append jobs additionally receive their PARENT's
+                # store directory to read from; everyone else ignores
+                # the kwargs (the executor gates capture on
+                # accum_repr).  Duck-typed: narrow stubs without the
+                # capability flag keep their existing signatures.
+                run_kwargs["plane_dir"] = self.store.plane_dir(fp)
+                if getattr(spec, "append_parent", None):
+                    run_kwargs["parent_plane_dir"] = (
+                        self.store.plane_dir(spec.append_parent)
+                    )
             if self.watchdog and hasattr(
                 self.executor, "expected_block_seconds"
             ):
@@ -2565,6 +2637,7 @@ class Scheduler:
             # was already fed at pickup, outcome-blind).
             self.slo.observe_attempt(bucket, ok=True)
             self.slo.observe_job(bucket, end_to_end, ok=True)
+            self._emit_plane_store_events(job_id, fp, result)
             self.events.emit(
                 "job_done", job_id=job_id, fingerprint=fp,
                 seconds=round(seconds, 3), bucket=bucket,
@@ -2572,6 +2645,56 @@ class Scheduler:
             )
             self._note_drain()
             return
+
+    def _emit_plane_store_events(
+        self, job_id: str, fp: str, result: Any
+    ) -> None:
+        """Append-subsystem observability, read off the finished
+        result dict: ``plane_store_written`` whenever this job left a
+        verifiable generation on disk (a packed exact run's gen-0
+        capture, or an append's merged generation — fallbacks that
+        re-bootstrapped count too, they wrote gen-0 under their own
+        fingerprint), and ``refresh_recommended`` when the append's
+        DKW staleness verdict says the accumulated drift can no longer
+        be disclosed inside the bound.  Emission failures are
+        impossible by construction (pure dict reads); malformed
+        results simply emit nothing."""
+        if not isinstance(result, dict):
+            return
+        plane_store = result.get("plane_store")
+        if isinstance(plane_store, dict) and "error" not in plane_store:
+            self.events.emit(
+                "plane_store_written", job_id=job_id, fingerprint=fp,
+                generation=int(plane_store.get("generation", 0)),
+                h_done=int(plane_store.get("h_done", 0)),
+                n=int(plane_store.get("n", 0)),
+                worker_id=self.worker_id,
+            )
+        append = result.get("append")
+        if not isinstance(append, dict):
+            return
+        if append.get("store_written"):
+            self.events.emit(
+                "plane_store_written", job_id=job_id, fingerprint=fp,
+                generation=int(append.get("generation", 0)),
+                h_done=int(append.get("h_total", 0)),
+                n=int(append.get("n_new", 0)),
+                marginal_lane_fraction=float(
+                    append.get("marginal_lane_fraction", 1.0)
+                ),
+                worker_id=self.worker_id,
+            )
+        staleness = append.get("staleness")
+        if isinstance(staleness, dict) and staleness.get(
+            "refresh_recommended"
+        ):
+            self.events.emit(
+                "refresh_recommended", job_id=job_id, fingerprint=fp,
+                drift=float(staleness.get("drift", 0.0)),
+                bound=float(staleness.get("bound", 0.0)),
+                drift_excess=float(staleness.get("drift_excess", 0.0)),
+                worker_id=self.worker_id,
+            )
 
     # -- fused execution (serve/sched/fusion.py) -------------------------
 

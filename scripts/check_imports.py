@@ -33,6 +33,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # skip the import check while pytest collection (or production import)
 # still dies.  Keep in sync when adding a subpackage.
 EXPECTED_SUBPACKAGES = (
+    "consensus_clustering_tpu.append",
     "consensus_clustering_tpu.autotune",
     "consensus_clustering_tpu.estimator",
     "consensus_clustering_tpu.lint",

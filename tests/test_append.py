@@ -1,0 +1,788 @@
+"""Incremental-append subsystem tests (docs/SERVING.md "Append
+runbook"): plane store write/verify/chaos, exact mixing accounting,
+DKW staleness verdict, job-spec validation + fingerprint lineage,
+fusion ineligibility, the serve-admin report's append rows — and, in
+the slow lane, the engine parity gate vs a from-scratch oracle plus
+the serving path end to end (happy append, no-store fallback, and
+crash-mid-append falling back on a torn store with zero silent
+generation mixing).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from consensus_clustering_tpu.append import (
+    PlaneStore,
+    PlaneStoreError,
+    check_compat,
+    generation_seed,
+    merge_generations,
+)
+from consensus_clustering_tpu.append.mixing import (
+    curves_from_counts,
+    histogram_counts,
+    iij_counts,
+    mij_counts,
+    popcount_u32,
+    widen_planes,
+)
+from consensus_clustering_tpu.append.staleness import staleness_report
+from consensus_clustering_tpu.serve.executor import (
+    JobSpecError,
+    parse_job_spec,
+)
+
+
+def _rand_planes(rng, n_ks=2, k_max=3, words=2, n=17):
+    return {
+        "planes": rng.integers(
+            0, 2**32, size=(n_ks, k_max, words, n), dtype=np.uint32
+        ),
+        "coplanes": rng.integers(
+            0, 2**32, size=(words, n), dtype=np.uint32
+        ),
+    }
+
+
+def _manifest(n=17, words=2, h=8):
+    return {
+        "n": n,
+        "n_features": 3,
+        "seed": 23,
+        "h_done": h,
+        "data_sha": "x",
+        "config": {"k_values": [2, 3], "subsampling": 0.8, "bins": 20,
+                   "pac_interval": [0.1, 0.9], "parity_zeros": True,
+                   "dtype": "float32"},
+        "clusterer": {"name": "kmeans", "options": {}},
+        "generations": [{"generation": 0, "h": h, "n": n, "seed": 23}],
+    }
+
+
+# ---------------------------------------------------------------------------
+# store: round-trip, newest-first, torn-write chaos
+
+
+class TestPlaneStore:
+    def test_round_trip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        store = PlaneStore(str(tmp_path / "pl"))
+        arrays = _rand_planes(rng)
+        store.write_generation(0, _manifest(), arrays)
+        manifest, loaded = store.load_latest()
+        assert manifest["generation"] == 0
+        assert manifest["schema"] == "planes-v1"
+        np.testing.assert_array_equal(loaded["planes"], arrays["planes"])
+        np.testing.assert_array_equal(
+            loaded["coplanes"], arrays["coplanes"]
+        )
+
+    def test_newest_verifiable_generation_wins(self, tmp_path):
+        rng = np.random.default_rng(1)
+        store = PlaneStore(str(tmp_path / "pl"))
+        store.write_generation(0, _manifest(), _rand_planes(rng))
+        g1 = _rand_planes(rng)
+        store.write_generation(1, _manifest(), g1)
+        manifest, loaded = store.load_latest()
+        assert manifest["generation"] == 1
+        np.testing.assert_array_equal(loaded["planes"], g1["planes"])
+
+    def test_no_store(self, tmp_path):
+        with pytest.raises(PlaneStoreError) as e:
+            PlaneStore(str(tmp_path / "missing")).load_latest()
+        assert e.value.reason == "no_store"
+
+    def test_torn_write_refused_falls_back_to_prior_gen(self, tmp_path):
+        """The chaos contract: a crash between the arrays write and the
+        next arrays write leaves bytes the manifest never committed —
+        the generation must be REFUSED and the previous one served."""
+        rng = np.random.default_rng(2)
+        store = PlaneStore(str(tmp_path / "pl"))
+        g0 = _rand_planes(rng)
+        store.write_generation(0, _manifest(), g0)
+        store.write_generation(1, _manifest(), _rand_planes(rng))
+        # Corrupt gen-1's arrays AFTER its manifest committed.
+        arrays_path = tmp_path / "pl" / "gen-00000001" / "arrays.npz"
+        raw = bytearray(arrays_path.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        arrays_path.write_bytes(bytes(raw))
+        manifest, loaded = store.load_latest()
+        assert manifest["generation"] == 0
+        np.testing.assert_array_equal(loaded["planes"], g0["planes"])
+
+    def test_all_generations_torn_raises(self, tmp_path):
+        rng = np.random.default_rng(3)
+        store = PlaneStore(str(tmp_path / "pl"))
+        store.write_generation(0, _manifest(), _rand_planes(rng))
+        arrays_path = tmp_path / "pl" / "gen-00000000" / "arrays.npz"
+        arrays_path.write_bytes(b"not an npz")
+        with pytest.raises(PlaneStoreError) as e:
+            store.load_latest()
+        assert e.value.reason in ("arrays_unreadable", "digest_mismatch")
+
+    def test_missing_manifest_is_invisible(self, tmp_path):
+        """Arrays-then-manifest ordering: a crash BEFORE the manifest
+        landed leaves a generation that simply does not verify."""
+        rng = np.random.default_rng(4)
+        store = PlaneStore(str(tmp_path / "pl"))
+        g0 = _rand_planes(rng)
+        store.write_generation(0, _manifest(), g0)
+        store.write_generation(1, _manifest(), _rand_planes(rng))
+        os.remove(tmp_path / "pl" / "gen-00000001" / "manifest.json")
+        manifest, _ = store.load_latest()
+        assert manifest["generation"] == 0
+
+    def test_schema_skew_refused(self, tmp_path):
+        rng = np.random.default_rng(5)
+        store = PlaneStore(str(tmp_path / "pl"))
+        store.write_generation(0, _manifest(), _rand_planes(rng))
+        mpath = tmp_path / "pl" / "gen-00000000" / "manifest.json"
+        record = json.loads(mpath.read_text())
+        record["schema"] = "planes-v0"
+        mpath.write_text(json.dumps(record))
+        with pytest.raises(PlaneStoreError) as e:
+            store.load_latest()
+        assert e.value.reason == "schema_mismatch"
+
+
+# ---------------------------------------------------------------------------
+# mixing: exact integer accounting
+
+
+class TestMixing:
+    def test_popcount_matches_python(self):
+        rng = np.random.default_rng(6)
+        a = rng.integers(0, 2**32, size=257, dtype=np.uint32)
+        want = np.array([bin(int(v)).count("1") for v in a])
+        np.testing.assert_array_equal(popcount_u32(a), want)
+
+    def test_widen_is_zero_padding(self):
+        rng = np.random.default_rng(7)
+        arr = rng.integers(0, 2**32, size=(2, 5), dtype=np.uint32)
+        wide = widen_planes(arr, 9)
+        np.testing.assert_array_equal(wide[:, :5], arr)
+        assert not wide[:, 5:].any()
+        with pytest.raises(ValueError):
+            widen_planes(arr, 3)
+
+    def test_merged_counts_are_integer_sums(self):
+        """The bit-identical accounting contract: popcounts of the
+        word-axis concatenation equal the sum of per-generation
+        popcounts, for Mij and Iij alike."""
+        rng = np.random.default_rng(8)
+        g0 = _rand_planes(rng, n=11)
+        g1 = _rand_planes(rng, n=14)
+        merged = merge_generations([g0, g1], 14)
+        assert merged["planes"].shape == (2, 3, 4, 14)
+        iij_sum = (
+            iij_counts(widen_planes(g0["coplanes"], 14))
+            + iij_counts(g1["coplanes"])
+        )
+        np.testing.assert_array_equal(
+            iij_counts(merged["coplanes"]), iij_sum
+        )
+        for ki in range(2):
+            mij_sum = (
+                mij_counts(widen_planes(g0["planes"][ki], 14))
+                + mij_counts(g1["planes"][ki])
+            )
+            np.testing.assert_array_equal(
+                mij_counts(merged["planes"][ki]), mij_sum
+            )
+
+    def test_merge_rejects_k_geometry_mismatch(self):
+        rng = np.random.default_rng(9)
+        with pytest.raises(ValueError):
+            merge_generations(
+                [_rand_planes(rng, k_max=3), _rand_planes(rng, k_max=4)],
+                17,
+            )
+
+    def test_curves_match_jax_ops(self):
+        """The numpy curve port against the device-side ops it mirrors
+        (f32 divide, edge-comparison histogram, zero-inflated bin 0)."""
+        import jax.numpy as jnp
+
+        from consensus_clustering_tpu.ops.analysis import (
+            cdf_pac_from_counts,
+        )
+
+        rng = np.random.default_rng(10)
+        counts = rng.integers(0, 50, size=20).astype(np.int64)
+        n, lo, hi = 17, 2, 18
+        hist, cdf, pac = curves_from_counts(counts, n, lo, hi, True)
+        j_hist, j_cdf, j_pac = cdf_pac_from_counts(
+            jnp.asarray(counts, dtype=jnp.int32), n, lo, hi,
+            parity_zeros=True,
+        )
+        np.testing.assert_allclose(cdf, np.asarray(j_cdf), atol=1e-6)
+        np.testing.assert_allclose(hist, np.asarray(j_hist), atol=1e-4)
+        assert abs(pac - float(j_pac)) < 1e-6
+
+    def test_histogram_edges_right_closed_last_bin(self):
+        cij = np.zeros((3, 3), dtype=np.float32)
+        cij[0, 1] = 1.0   # exactly the top edge — last bin, not lost
+        cij[0, 2] = 0.05
+        counts = histogram_counts(cij, 20)
+        assert counts[-1] == 1
+        assert counts[1] == 1
+        assert counts.sum() == 3  # the whole strict upper triangle
+
+
+# ---------------------------------------------------------------------------
+# staleness
+
+
+class TestStaleness:
+    def _report(self, old, new, **kw):
+        args = dict(
+            n_old=17, k_values=(2, 3), h_old=64, h_new=64,
+            subsampling=0.8, bins=20, pac_lo_idx=2, pac_hi_idx=18,
+        )
+        args.update(kw)
+        return staleness_report(old, new, **args)
+
+    def test_identical_generations_zero_drift(self):
+        rng = np.random.default_rng(11)
+        g = _rand_planes(rng)
+        report = self._report(g, g)
+        assert report["drift"] == 0.0
+        assert report["drift_excess"] == 0.0
+        assert report["refresh_recommended"] is False
+        assert set(report["per_k_drift"]) == {"2", "3"}
+
+    def test_fields_and_bound_shape(self):
+        rng = np.random.default_rng(12)
+        report = self._report(_rand_planes(rng), _rand_planes(rng))
+        for key in ("drift", "bound", "drift_excess", "epsilon_old",
+                    "epsilon_new", "pair_cdf_scale", "model",
+                    "confidence", "refresh_recommended"):
+            assert key in report, key
+        assert report["bound"] > 0
+        assert report["drift_excess"] == pytest.approx(
+            max(0.0, report["drift"] - report["bound"])
+        )
+
+    def test_more_lanes_tighter_bound(self):
+        rng = np.random.default_rng(13)
+        g0, g1 = _rand_planes(rng), _rand_planes(rng)
+        wide = self._report(g0, g1, h_old=16, h_new=16)
+        tight = self._report(g0, g1, h_old=4096, h_new=4096)
+        assert tight["bound"] < wide["bound"]
+
+
+# ---------------------------------------------------------------------------
+# compat contract
+
+
+class TestCheckCompat:
+    def _x(self, n=17, d=3):
+        return np.arange(n * d, dtype=np.float32).reshape(n, d)
+
+    def _ok_manifest(self):
+        from consensus_clustering_tpu.utils.checkpoint import (
+            data_fingerprint,
+        )
+
+        m = _manifest()
+        m["data_sha"] = data_fingerprint(
+            np.ascontiguousarray(self._x())
+        )
+        return m
+
+    def test_clean(self):
+        assert check_compat(
+            self._ok_manifest(), self._x(n=20),
+            k_values=(2, 3), subsampling=0.8,
+            clusterer_name="kmeans", clusterer_options={},
+        ) is None
+
+    def test_shrink_refused(self):
+        reason = check_compat(self._ok_manifest(), self._x(n=10))
+        assert reason.startswith("shrunk_dataset")
+
+    def test_feature_mismatch(self):
+        assert check_compat(
+            self._ok_manifest(), self._x(d=4)
+        ) == "feature_count_mismatch"
+
+    def test_config_mismatch(self):
+        assert check_compat(
+            self._ok_manifest(), self._x(n=20), k_values=(2, 4)
+        ) == "config_mismatch:k_values"
+        assert check_compat(
+            self._ok_manifest(), self._x(n=20), bins=40
+        ) == "config_mismatch:bins"
+
+    def test_clusterer_identity(self):
+        assert check_compat(
+            self._ok_manifest(), self._x(n=20),
+            clusterer_name="spectral",
+        ) == "config_mismatch:clusterer"
+        assert check_compat(
+            self._ok_manifest(), self._x(n=20),
+            clusterer_name="kmeans", clusterer_options={"n_init": 3},
+        ) == "config_mismatch:clusterer_options"
+
+    def test_data_prefix_must_be_byte_identical(self):
+        x = self._x(n=20)
+        x[0, 0] += 1e-3
+        assert check_compat(
+            self._ok_manifest(), x
+        ) == "data_prefix_mismatch"
+
+
+# ---------------------------------------------------------------------------
+# generation seeds
+
+
+def test_generation_seed_lineage():
+    assert generation_seed(23, 0) == 23  # gen 0 IS the parent run
+    s1, s2 = generation_seed(23, 1), generation_seed(23, 2)
+    assert s1 != s2 != 23
+    assert generation_seed(23, 1) == s1  # deterministic
+    assert generation_seed(24, 1) != s1  # root seed feeds the stream
+
+
+# ---------------------------------------------------------------------------
+# job-spec validation + fingerprint lineage + fusion ineligibility
+
+
+def _body(mode="append", parent="a" * 16, **over):
+    cfg = {"k": [2, 3], "iterations": 8, "seed": 23,
+           "accum_repr": "packed"}
+    if mode is not None:
+        cfg["mode"] = mode
+    if parent is not None:
+        cfg["append_parent"] = parent
+    cfg.update(over)
+    data = [[float(i), float(i % 3)] for i in range(8)]
+    return {"data": data, "config": cfg}
+
+
+class TestAppendJobSpec:
+    def test_happy_path(self):
+        spec, _ = parse_job_spec(_body())
+        assert spec.mode == "append"
+        assert spec.append_parent == "a" * 16
+
+    def test_parent_required(self):
+        with pytest.raises(JobSpecError, match="append_parent"):
+            parse_job_spec(_body(parent=None))
+
+    def test_parent_must_be_fingerprint_shaped(self):
+        with pytest.raises(JobSpecError, match="16-hex"):
+            parse_job_spec(_body(parent="nope"))
+        with pytest.raises(JobSpecError, match="16-hex"):
+            parse_job_spec(_body(parent="A" * 16))  # uppercase refused
+
+    def test_dense_refused(self):
+        with pytest.raises(JobSpecError, match="packed"):
+            parse_job_spec(_body(accum_repr="dense"))
+
+    def test_adaptive_tol_refused(self):
+        with pytest.raises(JobSpecError, match="adaptive_tol"):
+            parse_job_spec(_body(adaptive_tol=0.01))
+
+    def test_n_pairs_refused(self):
+        with pytest.raises(JobSpecError, match="n_pairs"):
+            parse_job_spec(_body(n_pairs=1024))
+
+    def test_parent_on_exact_refused(self):
+        with pytest.raises(JobSpecError, match="only applies"):
+            parse_job_spec(_body(mode="exact"))
+
+    def test_fingerprint_lineage_pairwise_distinct(self):
+        """Append never aliases from-scratch: exact, estimate, append
+        (and appends of different parents) all fingerprint apart."""
+        exact, _ = parse_job_spec(_body(mode=None, parent=None))
+        est, _ = parse_job_spec(
+            _body(mode="estimate", parent=None, n_pairs=1024)
+        )
+        ap1, _ = parse_job_spec(_body())
+        ap2, _ = parse_job_spec(_body(parent="b" * 16))
+        payloads = {
+            json.dumps(s.fingerprint_payload(), sort_keys=True)
+            for s in (exact, est, ap1, ap2)
+        }
+        assert len(payloads) == 4
+
+    def test_absent_parent_keeps_pre_append_fingerprints_stable(self):
+        exact, _ = parse_job_spec(_body(mode=None, parent=None))
+        assert "append_parent" not in exact.fingerprint_payload()
+
+    def test_bucket_shares_packed_exact_vocabulary(self):
+        """The bucket normalises mode/parent away: an append compiles
+        the same packed block-program family as the exact job it
+        extends (the ``-append`` SLO suffix is scheduler-side)."""
+        exact, _ = parse_job_spec(_body(mode=None, parent=None))
+        ap, _ = parse_job_spec(_body())
+        assert ap.bucket(3, 2, 4) == exact.bucket(3, 2, 4)
+
+    def test_append_jobs_fusion_ineligible(self):
+        from consensus_clustering_tpu.serve.sched.fusion import (
+            fusion_key,
+        )
+
+        ap, _ = parse_job_spec(_body())
+        assert fusion_key(ap, 3, 2, 4) is None
+
+    def test_fusion_never_crosses_clusterer_ids(self):
+        """ROADMAP item 3 residue: the fusion key rides the executable
+        bucket, which carries the clusterer identity — two jobs equal
+        in everything but clusterer (or its options) must never share
+        a fused program."""
+        from consensus_clustering_tpu.serve.sched.fusion import (
+            fusion_key,
+        )
+
+        a, _ = parse_job_spec(_body(mode=None, parent=None))
+        b, _ = parse_job_spec(
+            _body(mode=None, parent=None, clusterer="spectral")
+        )
+        c, _ = parse_job_spec(
+            _body(mode=None, parent=None,
+                  clusterer_options={"n_init": 3})
+        )
+        keys = {fusion_key(s, 3, 2, 4) for s in (a, b, c)}
+        assert None not in keys
+        assert len(keys) == 3
+
+
+# ---------------------------------------------------------------------------
+# serve-admin report: append rows from the JSONL alone (stdlib-only)
+
+
+def test_report_append_rows_from_jsonl(tmp_path):
+    from consensus_clustering_tpu.obs.query import (
+        render_report,
+        summarize,
+    )
+
+    events = [
+        {"ts": 1.0, "event": "append_admitted", "job_id": "j1",
+         "fingerprint": "f" * 16, "append_parent": "a" * 16,
+         "n_iterations": 8, "shape": [20, 3], "worker_id": "w1"},
+        {"ts": 2.0, "event": "plane_store_written", "job_id": "j0",
+         "fingerprint": "a" * 16, "generation": 0, "h_done": 16,
+         "n": 17, "worker_id": "w1"},
+        {"ts": 3.0, "event": "plane_store_written", "job_id": "j1",
+         "fingerprint": "f" * 16, "generation": 1, "h_done": 24,
+         "n": 20, "marginal_lane_fraction": 0.25, "worker_id": "w1"},
+        {"ts": 3.5, "event": "refresh_recommended", "job_id": "j1",
+         "fingerprint": "f" * 16, "drift": 0.4, "bound": 0.3,
+         "drift_excess": 0.1, "worker_id": "w1"},
+        {"ts": 4.0, "event": "job_done", "job_id": "j1",
+         "fingerprint": "f" * 16, "seconds": 0.5,
+         "bucket": "n20_d3_h8_k2-3-append", "worker_id": "w1"},
+    ]
+    path = tmp_path / "ev.jsonl"
+    path.write_text(
+        "".join(json.dumps(e) + "\n" for e in events)
+    )
+    from consensus_clustering_tpu.obs.query import load_events
+
+    report = summarize(load_events(str(path)))
+    ap = report["append"]
+    assert ap["appends_served"] == 1
+    assert ap["plane_stores_written"] == 2
+    assert ap["marginal_lane_fraction"]["count"] == 1
+    assert ap["marginal_lane_fraction"]["p50"] == pytest.approx(0.25)
+    assert ap["refresh_recommended"] == 1
+    assert ap["max_drift_excess"] == pytest.approx(0.1)
+    text = render_report(report)
+    assert "appends_served=1" in text
+    assert "marginal-vs-full ratio" in text
+    assert "refresh_recommended=1" in text
+
+
+def test_report_without_append_traffic_has_quiet_section():
+    from consensus_clustering_tpu.obs.query import (
+        render_report,
+        summarize,
+    )
+
+    report = summarize([])
+    assert report["append"]["appends_served"] == 0
+    assert "append (docs/SERVING.md" not in render_report(report)
+
+
+# ---------------------------------------------------------------------------
+# slow lane: real engines — parity gate + serving end to end
+
+
+def _blobs(n, d, rng):
+    half = n // 2
+    return np.concatenate([
+        rng.normal(0.0, 0.3, (half, d)),
+        rng.normal(3.0, 0.3, (n - half, d)),
+    ]).astype(np.float32)
+
+
+@pytest.mark.slow
+def test_engine_append_parity_vs_oracle(tmp_path):
+    """The smoke-shape oracle parity gate (the committed
+    benchmarks/append_scaling record runs the full set): append
+    N→N+ΔN within the disclosed DKW band of from-scratch at N+ΔN,
+    with exact Iij accounting and a quiet staleness verdict."""
+    from consensus_clustering_tpu.append import (
+        bootstrap_generation,
+        run_append,
+    )
+    from consensus_clustering_tpu.append.staleness import (
+        generation_epsilon,
+    )
+    from consensus_clustering_tpu.config import SweepConfig
+    from consensus_clustering_tpu.estimator.bounds import pair_cdf_scale
+    from consensus_clustering_tpu.models.kmeans import KMeans
+
+    rng = np.random.default_rng(20)
+    x_full = _blobs(40, 3, rng)
+    x_old = x_full[:32]
+    clusterer = KMeans(max_iter=5)
+
+    def cfg(n, h):
+        return SweepConfig(
+            n_samples=n, n_features=3, k_values=(2, 3),
+            n_iterations=h, subsampling=0.8, store_matrices=False,
+            accum_repr="packed", stream_h_block=4, adaptive_tol=None,
+        )
+
+    store = PlaneStore(str(tmp_path / "pl"))
+    bootstrap_generation(
+        x_old, config=cfg(32, 16), clusterer=clusterer, seed=23,
+        store=store, clusterer_meta={"name": "kmeans", "options": {}},
+    )
+    appended = run_append(
+        store, x_full, h_new=8, clusterer=clusterer,
+        k_values=(2, 3), subsampling=0.8,
+        clusterer_name="kmeans", clusterer_options={},
+    )
+    ap = appended["append"]
+    assert ap["iij_bit_identical"] is True
+    assert ap["generation"] == 1
+    assert ap["h_total"] == 24
+    assert 0 < ap["marginal_lane_fraction"] < 1
+    assert ap["staleness"]["refresh_recommended"] is False
+
+    oracle = bootstrap_generation(
+        x_full, config=cfg(40, 24), clusterer=clusterer, seed=23,
+        n_iterations=24,
+    )
+    bound = (
+        generation_epsilon(8, 0.8) + generation_epsilon(24, 0.8)
+    ) * pair_cdf_scale(40, True)
+    for cdf_a, cdf_o in zip(
+        appended["cdf"], np.asarray(oracle["cdf"])
+    ):
+        sup = float(np.max(np.abs(
+            np.asarray(cdf_a, dtype=np.float64)
+            - np.asarray(cdf_o, dtype=np.float64)
+        )))
+        assert sup <= bound
+
+    # The merged store now serves a SECOND append (cumulative
+    # generations: one verifiable read is always sufficient).
+    x_grown = np.concatenate([x_full, _blobs(6, 3, rng)])
+    second = run_append(
+        store, x_grown, h_new=8, clusterer=clusterer,
+        k_values=(2, 3), subsampling=0.8,
+        clusterer_name="kmeans", clusterer_options={},
+    )
+    assert second["append"]["generation"] == 2
+    assert second["append"]["h_total"] == 32
+
+
+def _req(base, path, body=None):
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _poll(base, job_id, budget=180.0):
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        _, rec = _req(base, f"/jobs/{job_id}")
+        if rec["status"] in ("done", "failed", "timeout"):
+            return rec
+        time.sleep(0.2)
+    raise AssertionError(f"job {job_id} still {rec['status']}")
+
+
+@pytest.fixture(scope="module")
+def append_service(tmp_path_factory):
+    from consensus_clustering_tpu.serve import ConsensusService
+    from consensus_clustering_tpu.serve.executor import SweepExecutor
+
+    events = tmp_path_factory.mktemp("append_events") / "ev.jsonl"
+    svc = ConsensusService(
+        store_dir=str(tmp_path_factory.mktemp("append_store")),
+        port=0,
+        executor=SweepExecutor(use_compilation_cache=False),
+        events_path=str(events),
+    ).start()
+    yield svc, str(events)
+    svc.stop()
+
+
+def _exact_packed_body(x, iters=8):
+    return {
+        "data": x.tolist(),
+        "config": {"k": [2, 3], "iterations": iters, "seed": 23,
+                   "accum_repr": "packed"},
+    }
+
+
+@pytest.mark.slow
+def test_serving_append_end_to_end(append_service):
+    """Parent packed exact run captures gen 0; the append job widens
+    it at marginal cost; results/fingerprints/events/counters all
+    disclose the lineage."""
+    svc, events_path = append_service
+    base = f"http://127.0.0.1:{svc.port}"
+    rng = np.random.default_rng(21)
+    x_old = _blobs(36, 3, rng)
+    x_new = np.concatenate([x_old, _blobs(8, 3, rng)])
+
+    _, rec0 = _req(base, "/jobs", _exact_packed_body(x_old))
+    done0 = _poll(base, rec0["job_id"])
+    assert done0["status"] == "done"
+    ps = done0["result"]["plane_store"]
+    assert ps["generation"] == 0 and ps["n"] == 36
+    fp0 = done0["fingerprint"]
+
+    body1 = {
+        "data": x_new.tolist(),
+        "config": {"k": [2, 3], "iterations": 6, "seed": 23,
+                   "accum_repr": "packed", "mode": "append",
+                   "append_parent": fp0},
+    }
+    code, rec1 = _req(base, "/jobs", body1)
+    assert code == 202
+    assert rec1["append_parent"] == fp0  # ops-surface lineage
+    done1 = _poll(base, rec1["job_id"])
+    assert done1["status"] == "done"
+    result = done1["result"]
+    assert result["mode"] == "append"  # honestly labelled, not "exact"
+    ap = result["append"]
+    assert ap["fallback"] is False
+    assert ap["generation"] == 1
+    assert ap["h_old"] == 8 and ap["h_new"] == 6 and ap["h_total"] == 14
+    assert ap["iij_bit_identical"] is True
+    assert ap["store_written"] is True
+    assert 0 < ap["marginal_lane_fraction"] < 1
+    assert done1["fingerprint"] != fp0
+    assert (
+        result["result_fingerprint"]
+        != done0["result"]["result_fingerprint"]
+    )
+    # Admission priced the marginal job on the append model.
+    assert "mixing_workspace_bytes" in result["memory"]["estimate"]
+
+    _, metrics = _req(base, "/metrics")
+    assert metrics["append_jobs_total"] >= 1
+    assert metrics["append_runs_total"] >= 1
+    assert metrics["append_fallback_total"] == 0
+    assert metrics["plane_stores_written_total"] >= 2
+
+    events = [
+        json.loads(line) for line in open(events_path)
+    ]
+    names = [e["event"] for e in events]
+    assert "append_admitted" in names
+    writes = [e for e in events if e["event"] == "plane_store_written"]
+    assert {w["generation"] for w in writes} >= {0, 1}
+    gen1 = [w for w in writes if w["generation"] == 1][0]
+    assert gen1["marginal_lane_fraction"] == pytest.approx(6 / 14)
+    done_events = [e for e in events if e["event"] == "job_done"]
+    assert any(
+        e.get("bucket", "").endswith("-append") for e in done_events
+    )
+
+
+@pytest.mark.slow
+def test_serving_append_torn_store_falls_back(append_service):
+    """Chaos: crash-mid-append leaves a torn plane store — the append
+    job must refuse verification, fall back to a disclosed full
+    recompute, and never serve mixed counts."""
+    svc, _ = append_service
+    base = f"http://127.0.0.1:{svc.port}"
+    rng = np.random.default_rng(22)
+    x_old = _blobs(30, 3, rng)
+    x_new = np.concatenate([x_old, _blobs(6, 3, rng)])
+
+    _, rec0 = _req(base, "/jobs", _exact_packed_body(x_old, iters=6))
+    done0 = _poll(base, rec0["job_id"])
+    fp0 = done0["fingerprint"]
+
+    # Tear EVERY generation in the parent's store (crash mid-write).
+    plane_dir = svc.scheduler.store.plane_dir(fp0)
+    torn = 0
+    for root, _dirs, files in os.walk(plane_dir):
+        for name in files:
+            if name == "arrays.npz":
+                path = os.path.join(root, name)
+                raw = bytearray(open(path, "rb").read())
+                raw[len(raw) // 2] ^= 0xFF
+                open(path, "wb").write(bytes(raw))
+                torn += 1
+    assert torn >= 1
+
+    body1 = {
+        "data": x_new.tolist(),
+        "config": {"k": [2, 3], "iterations": 6, "seed": 23,
+                   "accum_repr": "packed", "mode": "append",
+                   "append_parent": fp0},
+    }
+    _, rec1 = _req(base, "/jobs", body1)
+    done1 = _poll(base, rec1["job_id"])
+    assert done1["status"] == "done"
+    ap = done1["result"]["append"]
+    assert ap["fallback"] is True
+    # A bit-flip surfaces as the npz member CRC (arrays_unreadable) or
+    # the committed-digest check (digest_mismatch) — both refuse.
+    assert ap["fallback_reason"] in (
+        "arrays_unreadable", "digest_mismatch"
+    )
+    assert ap["generation"] == 0  # a fresh gen-0, never mixed bytes
+    assert ap["marginal_lane_fraction"] == 1.0  # disclosed full cost
+    assert ap["store_written"] is True  # its own store, own lineage
+
+    _, metrics = _req(base, "/metrics")
+    assert metrics["append_fallback_total"] >= 1
+
+
+@pytest.mark.slow
+def test_serving_append_without_parent_store_falls_back(append_service):
+    """An append whose parent never captured planes (unknown parent
+    fingerprint) still answers — by disclosed full recompute."""
+    svc, _ = append_service
+    base = f"http://127.0.0.1:{svc.port}"
+    rng = np.random.default_rng(23)
+    x = _blobs(24, 3, rng)
+    body = {
+        "data": x.tolist(),
+        "config": {"k": [2, 3], "iterations": 6, "seed": 23,
+                   "accum_repr": "packed", "mode": "append",
+                   "append_parent": "0123456789abcdef"},
+    }
+    code, rec = _req(base, "/jobs", body)
+    assert code == 202
+    done = _poll(base, rec["job_id"])
+    assert done["status"] == "done"
+    ap = done["result"]["append"]
+    assert ap["fallback"] is True
+    assert ap["fallback_reason"] == "no_store"

@@ -210,6 +210,11 @@ EXPECTED_METRICS_KEYS = frozenset(
         "progressive_jobs_total", "continuations_enqueued_total",
         "continuations_completed_total",
         "continuations_cancelled_total", "continuations_shed_total",
+        # Incremental append serving (docs/SERVING.md "Append
+        # runbook"): admissions, marginal runs, disclosed fallbacks,
+        # plane-store generations written (gen-0 captures included).
+        "append_jobs_total", "append_runs_total",
+        "append_fallback_total", "plane_stores_written_total",
     }
 )
 
