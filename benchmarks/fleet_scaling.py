@@ -1,0 +1,494 @@
+#!/usr/bin/env python
+"""Fleet scaling curve: what the fleet layer buys at N workers.
+
+Three arms over one shared jobstore, all flooded through a SINGLE
+entry worker (peers receive no submissions — every job a peer runs
+arrived by work-stealing, docs/SERVING.md "Fleet runbook"):
+
+- **control** — 1 worker drains the full flood solo;
+- **fleet**   — N workers drain the same flood; the speedup, the
+  per-worker completion split, and the drained-over-time curve are
+  the record;
+- **fault**   — N workers drain a flood while one peer is SIGKILLed
+  and another SIGSTOPped (a zombie) mid-drain: every job still ends
+  done exactly once, every takeover names a faulted worker as the
+  prior owner, and fenced-write refusals come only from the zombie.
+
+Every worker (control arm included) runs with
+``--emulate-device-seconds``: a fixed sleep per executor program that
+actually ran (a quiet stand-down for a stolen job costs nothing),
+standing in for a remote accelerator program's latency.  On the
+CPU-starved boxes this benchmark runs on (often 1 core), N worker
+*processes* cannot show a wall-clock win on raw host compute — the
+emulation makes the measured quantity the FLEET LAYER's scheduling
+(advertise → steal → fuse → drain), which is what the record is for.
+The knob is identical across arms, disclosed in the JSON, and 0.0 on
+every production path.
+
+Script-judged (the acceptance criteria, not eyeballs):
+
+- fleet drains ≥3x faster than control (full scale only; smoke
+  reports the ratio unjudged — 2 workers on a loaded CI core prove
+  correctness, not throughput);
+- every flooded job completes exactly once (one ``job_done`` across
+  the merged per-worker event logs; one starter in the healthy arms);
+- zero takeovers / fenced-write refusals / requeues anywhere in the
+  healthy arms ("zero false takeovers on healthy renewal");
+- at least one stolen same-bucket set executed FUSED (≥2 job_ids
+  shared between one ``work_stolen`` and one ``fusion_executed``
+  event on the same worker — PR 12's fusion survives theft);
+- the entry worker's scale signal recommends ``scale_out`` under the
+  flood and settles on ``scale_in`` after the drain.
+
+Usage::
+
+    python benchmarks/fleet_scaling.py                      # full record
+    python benchmarks/fleet_scaling.py --smoke              # CI-sized
+    python benchmarks/fleet_scaling.py --out FLEET_SCALING.json
+
+Exits non-zero if any judge fails.  CPU-pinned (``JAX_PLATFORMS=cpu``)
+— the throughput being measured is the scheduler's, not the device's.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from chaos_soak import (  # noqa: E402
+    ServiceProc,
+    Violation,
+    _body,
+    _events,
+    _worker_args,
+)
+
+
+def _fleet_args(worker_id, *, ttl, queue, fusion, emulate):
+    return _worker_args(worker_id, ttl=ttl, extra=[
+        "--queue-size", str(queue),
+        "--fusion-max", str(fusion),
+        "--emulate-device-seconds", str(emulate),
+    ])
+
+
+def _warmup(svc, seed, n_jobs, body_kw):
+    """Fill one worker's executable cache with the flood's bucket —
+    including the FUSED width it will run at — so the measured drain
+    times steady-state scheduling, not first-compile."""
+    ids = [svc.post("/jobs", _body(seed + i, **body_kw))[1]["job_id"]
+           for i in range(n_jobs)]
+    for job_id in ids:
+        record = svc.poll_job(job_id)
+        if record["status"] != "done":
+            raise Violation(
+                f"warmup job ended {record['status']}: "
+                f"{record.get('error')}"
+            )
+
+
+def _flood(svc, seed0, jobs, body_kw):
+    t0 = time.time()
+    ids = []
+    for i in range(jobs):
+        status, rec, _ = svc.post("/jobs", _body(seed0 + i, **body_kw))
+        if status >= 300 or "job_id" not in rec:
+            raise Violation(f"admission refused mid-flood: {status} {rec}")
+        ids.append(rec["job_id"])
+    return t0, ids
+
+
+def _done_events(event_paths, job_ids):
+    wanted = set(job_ids)
+    return [e for p in event_paths for e in _events(p)
+            if e.get("event") == "job_done" and e.get("job_id") in wanted]
+
+
+def _wait_drained(event_paths, job_ids, budget):
+    """Drain detection from the event logs alone: zero HTTP load on
+    the workers being measured."""
+    deadline = time.time() + budget
+    while time.time() < deadline:
+        dones = _done_events(event_paths, job_ids)
+        if len({e["job_id"] for e in dones}) >= len(job_ids):
+            return dones
+        time.sleep(0.5)
+    raise Violation(
+        f"flood not drained in {budget}s: "
+        f"{len({e['job_id'] for e in _done_events(event_paths, job_ids)})}"
+        f"/{len(job_ids)} done"
+    )
+
+
+def _assert_exactly_once(event_paths, job_ids, check_starters=True):
+    merged = [e for p in event_paths for e in _events(p)]
+    for job_id in job_ids:
+        dones = [e for e in merged if e.get("event") == "job_done"
+                 and e.get("job_id") == job_id]
+        if len(dones) != 1:
+            raise Violation(
+                f"job {job_id} has {len(dones)} job_done events, "
+                "expected exactly 1"
+            )
+        if check_starters:
+            starters = {e.get("worker_id") for e in merged
+                        if e.get("event") == "job_started"
+                        and e.get("job_id") == job_id}
+            if len(starters) != 1:
+                raise Violation(
+                    f"job {job_id} started by {sorted(starters)} — a "
+                    "double execution"
+                )
+    return merged
+
+
+def _assert_healthy(svcs):
+    for label, svc in svcs:
+        m = svc.get("/metrics")
+        for counter in ("lease_takeovers_total",
+                        "lease_refused_writes_total", "jobs_requeued"):
+            if m[counter] != 0:
+                raise Violation(
+                    f"healthy arm is not clean: {label} "
+                    f"{counter}={m[counter]}"
+                )
+
+
+def _curve(t0, dones):
+    """Drained-over-time at each decile: the committed throughput
+    curve, derived from job_done timestamps, not poll jitter."""
+    ts = sorted(float(e["ts"]) - t0 for e in dones)
+    total = len(ts)
+    return [
+        {"drained": k, "seconds": round(ts[k - 1], 2)}
+        for k in sorted({max(1, (total * d) // 10) for d in range(1, 11)})
+    ]
+
+
+def _stolen_fused_sets(event_paths):
+    """Count work_stolen/fusion_executed pairs on the same worker that
+    share ≥2 jobs — a stolen same-bucket SET that executed fused."""
+    merged = [e for p in event_paths for e in _events(p)]
+    stolen_by = {}
+    for e in merged:
+        if e.get("event") == "work_stolen":
+            stolen_by.setdefault(e.get("worker_id"), set()).update(
+                e.get("job_ids", [])
+            )
+    count = 0
+    for e in merged:
+        if e.get("event") != "fusion_executed":
+            continue
+        stolen = stolen_by.get(e.get("worker_id"), set())
+        if len(stolen & set(e.get("job_ids", []))) >= 2:
+            count += 1
+    return count
+
+
+def run_control(root, cfg):
+    store = os.path.join(root, "control_store")
+    ev = os.path.join(root, "control.jsonl")
+    svc = ServiceProc(
+        store,
+        extra_args=_fleet_args("c0", ttl=cfg["ttl"], queue=cfg["queue"],
+                               fusion=cfg["fusion"],
+                               emulate=cfg["emulate"]),
+        events_path=ev,
+    )
+    try:
+        _warmup(svc, 5000, cfg["fusion"], cfg["body"])
+        t0, ids = _flood(svc, 10000, cfg["jobs"], cfg["body"])
+        dones = _wait_drained([ev], ids, cfg["budget"])
+        drain = max(float(e["ts"]) for e in dones) - t0
+        _assert_exactly_once([ev], ids)
+        _assert_healthy([("c0", svc)])
+        return {
+            "workers": 1,
+            "jobs": cfg["jobs"],
+            "drain_seconds": round(drain, 2),
+            "throughput_jobs_per_s": round(cfg["jobs"] / drain, 3),
+        }
+    finally:
+        svc.stop()
+
+
+def run_fleet(root, cfg):
+    store = os.path.join(root, "fleet_store")
+    n = cfg["workers"]
+    evs = [os.path.join(root, f"fleet_w{i}.jsonl") for i in range(n)]
+    svcs = []
+    try:
+        for i in range(n):
+            svcs.append(ServiceProc(
+                store,
+                extra_args=_fleet_args(
+                    f"w{i}", ttl=cfg["ttl"], queue=cfg["queue"],
+                    fusion=cfg["fusion"], emulate=cfg["emulate"],
+                ),
+                events_path=evs[i],
+            ))
+        # Warm EVERY worker's executable cache directly — the only
+        # submissions peers ever receive.
+        for i, svc in enumerate(svcs):
+            _warmup(svc, 6000 + 100 * i, cfg["fusion"], cfg["body"])
+        entry = svcs[0]
+        t0, ids = _flood(entry, 20000, cfg["jobs"], cfg["body"])
+        dones = _wait_drained(evs, ids, cfg["budget"])
+        drain = max(float(e["ts"]) for e in dones) - t0
+        merged = _assert_exactly_once(evs, ids)
+        _assert_healthy([(f"w{i}", s) for i, s in enumerate(svcs)])
+
+        completed_by = {}
+        for e in dones:
+            completed_by[e.get("worker_id")] = (
+                completed_by.get(e.get("worker_id"), 0) + 1
+            )
+        if len(completed_by) < n:
+            raise Violation(
+                f"only {sorted(completed_by)} completed flood jobs — "
+                "a worker never managed to steal"
+            )
+        stolen_jobs_by = {
+            f"w{i}": s.get("/metrics")["stolen_jobs_total"]
+            for i, s in enumerate(svcs)
+        }
+        fused_stolen = _stolen_fused_sets(evs)
+        if fused_stolen < 1:
+            raise Violation(
+                "no stolen same-bucket set executed fused"
+            )
+        if not any(e.get("event") == "fleet_scale_signal"
+                   and e.get("recommendation") == "scale_out"
+                   and float(e.get("ts", 0)) >= t0
+                   for e in _events(evs[0])):
+            raise Violation(
+                "entry worker never recommended scale_out under flood"
+            )
+        deadline = time.time() + 60
+        recommendation = None
+        while time.time() < deadline:
+            recommendation = entry.get("/metrics")["fleet"][
+                "recommendation"]
+            if recommendation == "scale_in":
+                break
+            time.sleep(0.25)
+        if recommendation != "scale_in":
+            raise Violation(
+                "scale signal never settled on scale_in after the "
+                f"drain (last: {recommendation})"
+            )
+        signals = [
+            {"recommendation": e.get("recommendation"),
+             "seconds": round(float(e["ts"]) - t0, 2)}
+            for e in _events(evs[0])
+            if e.get("event") == "fleet_scale_signal"
+        ]
+        steals = sum(1 for e in merged if e.get("event") == "work_stolen")
+        return {
+            "workers": n,
+            "jobs": cfg["jobs"],
+            "drain_seconds": round(drain, 2),
+            "throughput_jobs_per_s": round(cfg["jobs"] / drain, 3),
+            "completed_by": completed_by,
+            "stolen_jobs_by": stolen_jobs_by,
+            "steal_events": steals,
+            "fused_stolen_sets": fused_stolen,
+            "curve": _curve(t0, dones),
+            "scale_signals": signals,
+            "scale_signal_settled": recommendation,
+        }
+    finally:
+        for svc in svcs:
+            svc.stop()
+
+
+def run_fault(root, cfg):
+    """SIGKILL one peer and SIGSTOP another mid-flood; the fleet must
+    still finish every job exactly once, with every takeover naming a
+    faulted prior owner and every refusal coming from the zombie."""
+    store = os.path.join(root, "fault_store")
+    n = cfg["workers"]
+    evs = [os.path.join(root, f"fault_w{i}.jsonl") for i in range(n)]
+    svcs = []
+    killed, paused = f"w{n - 1}", f"w{n - 2}"
+    try:
+        for i in range(n):
+            svcs.append(ServiceProc(
+                store,
+                extra_args=_fleet_args(
+                    f"w{i}", ttl=cfg["ttl"], queue=cfg["queue"],
+                    fusion=cfg["fusion"], emulate=cfg["emulate"],
+                ),
+                events_path=evs[i],
+            ))
+        for i, svc in enumerate(svcs):
+            _warmup(svc, 7000 + 100 * i, cfg["fusion"], cfg["body"])
+        entry = svcs[0]
+        jobs = cfg["fault_jobs"]
+        t0, ids = _flood(entry, 30000, jobs, cfg["body"])
+        # Fault both peers once the flood is genuinely mid-drain.
+        resumed = False
+        deadline = time.time() + cfg["budget"]
+        faulted_at = None
+        while time.time() < deadline:
+            done = len({e["job_id"] for e in _done_events(evs, ids)})
+            if faulted_at is None and done >= jobs * 0.25:
+                svcs[n - 1].proc.kill()
+                os.kill(svcs[n - 2].proc.pid, signal.SIGSTOP)
+                faulted_at = done
+            if faulted_at is not None and not resumed and (
+                    done >= jobs * 0.6):
+                os.kill(svcs[n - 2].proc.pid, signal.SIGCONT)
+                resumed = True
+            if done >= jobs:
+                break
+            time.sleep(0.5)
+        if not resumed and faulted_at is not None:
+            os.kill(svcs[n - 2].proc.pid, signal.SIGCONT)
+            resumed = True
+        dones = _wait_drained(evs, ids, 120)
+        if faulted_at is None:
+            raise Violation(
+                "flood drained before the fault window — fault arm "
+                "proved nothing (raise fault_jobs)"
+            )
+        # Exactly-once on job_done; takeover legitimately restarts a
+        # job, so starters may be two — attribution is judged below.
+        merged = _assert_exactly_once(evs, ids, check_starters=False)
+        takeovers = [e for e in merged if e.get("event") == "lease_takeover"]
+        for e in takeovers:
+            if e.get("prior_worker") not in (killed, paused):
+                raise Violation(
+                    "false takeover: healthy worker "
+                    f"{e.get('prior_worker')} was robbed: {e}"
+                )
+        refusals = [e for e in merged if e.get("event") == "lease_refused"]
+        for e in refusals:
+            if e.get("worker_id") != paused:
+                raise Violation(
+                    f"healthy worker refused a write: {e}"
+                )
+        drain = max(float(e["ts"]) for e in dones) - t0
+        return {
+            "workers": n,
+            "jobs": jobs,
+            "killed": killed,
+            "paused": paused,
+            "faulted_at_drained": faulted_at,
+            "drain_seconds": round(drain, 2),
+            "takeovers": len(takeovers),
+            "takeovers_from_faulted_only": True,
+            "zombie_refusals": len(refusals),
+            "done_exactly_once": True,
+        }
+    finally:
+        for svc in svcs:
+            svc.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI-sized: 2 workers, small flood, no fault "
+                   "arm, speedup reported but not judged")
+    p.add_argument("--out", default=None, help="write the JSON record")
+    p.add_argument("--root", default=None,
+                   help="work directory (default: a fresh temp dir)")
+    args = p.parse_args(argv)
+
+    import tempfile
+    root = args.root or tempfile.mkdtemp(prefix="fleet_scaling_")
+    os.makedirs(root, exist_ok=True)
+
+    if args.smoke:
+        cfg = {
+            "workers": 2, "jobs": 24, "fault_jobs": 0,
+            "fusion": 4, "ttl": 4, "queue": 128, "emulate": 1.0,
+            "body": {"n": 32, "d": 4, "iters": 8}, "budget": 420,
+        }
+    else:
+        cfg = {
+            "workers": 4, "jobs": 320, "fault_jobs": 200,
+            "fusion": 8, "ttl": 4, "queue": 512, "emulate": 4.0,
+            "body": {"n": 32, "d": 4, "iters": 8}, "budget": 900,
+        }
+
+    report = {
+        "smoke": bool(args.smoke),
+        "host_cpus": os.cpu_count(),
+        "params": {
+            "workers": cfg["workers"],
+            "jobs": cfg["jobs"],
+            "fusion_max": cfg["fusion"],
+            "lease_ttl": cfg["ttl"],
+            "emulate_device_seconds": cfg["emulate"],
+            "body": cfg["body"],
+        },
+    }
+    violations = []
+
+    def arm(name, fn):
+        t0 = time.time()
+        try:
+            report[name] = fn()
+            print(f"arm {name}: ok ({time.time() - t0:.1f}s)",
+                  file=sys.stderr)
+        except Violation as e:
+            violations.append({"arm": name, "violation": str(e)})
+            print(f"arm {name}: VIOLATION: {e}", file=sys.stderr)
+
+    arm("control", lambda: run_control(root, cfg))
+    arm("fleet", lambda: run_fleet(root, cfg))
+    if cfg["fault_jobs"]:
+        arm("fault", lambda: run_fault(root, cfg))
+
+    speedup = None
+    if "control" in report and "fleet" in report:
+        speedup = round(
+            report["control"]["drain_seconds"]
+            / report["fleet"]["drain_seconds"], 2
+        )
+        report["speedup"] = speedup
+        if not args.smoke and speedup < 3.0:
+            violations.append({
+                "arm": "fleet",
+                "violation": f"speedup {speedup}x < the judged 3x "
+                "floor at 4 workers",
+            })
+
+    report["judges"] = {
+        "speedup_3x": (None if args.smoke
+                       else bool(speedup and speedup >= 3.0)),
+        "exactly_once": not any("job_done" in v["violation"]
+                                or "double execution" in v["violation"]
+                                for v in violations),
+        "zero_false_takeovers_zero_healthy_refusals": not any(
+            "not clean" in v["violation"]
+            or "false takeover" in v["violation"]
+            or "refused a write" in v["violation"]
+            for v in violations
+        ),
+        "stolen_set_executed_fused": "fleet" in report and bool(
+            report["fleet"].get("fused_stolen_sets")
+        ),
+        "scale_out_then_scale_in": "fleet" in report and (
+            report["fleet"].get("scale_signal_settled") == "scale_in"
+        ),
+    }
+    report["violations"] = violations
+    report["passed"] = not violations
+    blob = json.dumps(report, indent=1, sort_keys=True)
+    print(blob)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(blob)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -408,6 +408,9 @@ def cmd_serve(args):
         worker_id=args.worker_id,
         leases=not args.no_leases,
         lease_ttl=args.lease_ttl,
+        fleet=not args.no_fleet,
+        fleet_target_drain_seconds=args.fleet_target_drain,
+        emulate_device_seconds=args.emulate_device_seconds,
         schedule=args.schedule,
         fusion_max=args.fusion_max,
         priority_weights=priority_weights,
@@ -835,6 +838,26 @@ def main(argv=None):
                          help="disable fenced job leases (single-worker "
                          "stores only: two lease-less workers on one "
                          "store WILL double-run jobs)")
+    serve_p.add_argument("--no-fleet", action="store_true",
+                         help="disable the fleet layer — heartbeat "
+                         "advertisement, work-stealing pickup, and the "
+                         "autoscale signal (docs/SERVING.md 'Fleet "
+                         "runbook'); implied by --no-leases (a steal "
+                         "is a lease claim)")
+    serve_p.add_argument("--fleet-target-drain", type=float,
+                         default=60.0,
+                         help="seconds the fleet should be able to "
+                         "drain its whole backlog in at the measured "
+                         "rate; a worse estimate flips the autoscale "
+                         "signal to scale_out")
+    serve_p.add_argument("--emulate-device-seconds", type=float,
+                         default=0.0,
+                         help="benchmark-only: sleep this long after "
+                         "every executor program that ran, emulating a "
+                         "fixed-latency remote accelerator so fleet "
+                         "topology benchmarks measure scheduling, not "
+                         "the host CPU (benchmarks/fleet_scaling.py); "
+                         "0 disables")
     serve_p.set_defaults(fn=cmd_serve)
 
     admin_p = sub.add_parser(

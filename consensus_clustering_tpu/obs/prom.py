@@ -24,6 +24,12 @@ Rendering rules (``cctpu_`` prefix throughout):
 - ``memory_accounting`` → per-bucket estimated/measured/compiled/peak
   byte gauges, ``preflight_accuracy``/``_correction`` and the accuracy
   band (docs/OBSERVABILITY.md "Memory accounting");
+- ``fleet`` → the capacity/autoscale snapshot of docs/SERVING.md
+  "Fleet runbook": ``fleet_enabled``/``fleet_workers_seen``/
+  ``fleet_backlog``/``fleet_peer_backlog``/``fleet_running``/
+  ``fleet_slo_burn_active`` gauges, drain-rate and estimated-drain
+  gauges when measured, and the current recommendation as
+  ``cctpu_fleet_scale_info{recommendation="…"} 1``;
 - ``backend`` (a string) → ``cctpu_backend_info{backend="…"} 1``;
 - ``worker_id`` (a string) → ``cctpu_worker_info{worker_id="…"} 1``,
   and ``active_leases`` carries the same ``worker_id`` label — the
@@ -325,6 +331,57 @@ def _render_memory_accounting(
         )
 
 
+def _render_fleet(lines: List[str], fleet: Mapping[str, Any]) -> None:
+    base = f"{PREFIX}_fleet"
+    gauges = (
+        ("enabled", f"{base}_enabled",
+         "1 when the fleet capacity layer is on"),
+        ("workers_seen", f"{base}_workers_seen",
+         "workers visible through fresh fleet/ heartbeats (self "
+         "included)"),
+        ("fleet_backlog", f"{base}_backlog",
+         "queued jobs across every visible worker"),
+        ("peer_backlog", f"{base}_peer_backlog",
+         "queued jobs advertised by peers (fleet minus own queue)"),
+        ("fleet_running", f"{base}_running",
+         "picked-up jobs across every visible worker"),
+        ("slo_burn_active", f"{base}_slo_burn_active",
+         "active SLO burn (objective, bucket) pairs across the fleet"),
+    )
+    for key, name, help_text in gauges:
+        value = fleet.get(key)
+        if value is None:
+            continue  # same no-null rule as the top-level walk
+        _family(lines, name, "gauge", help_text)
+        lines.append(_sample(name, None, value))
+    measured = (
+        ("fleet_drain_rate_per_s", f"{base}_drain_rate_per_s",
+         "summed measured drain rate across the fleet (jobs/s)"),
+        ("est_drain_seconds", f"{base}_est_drain_seconds",
+         "estimated seconds to drain the fleet backlog at the "
+         "measured rate"),
+    )
+    for key, name, help_text in measured:
+        value = fleet.get(key)
+        if value is None:
+            continue  # unmeasured before the first drain window
+        _family(lines, name, "gauge", help_text)
+        lines.append(_sample(name, None, value))
+    recommendation = fleet.get("recommendation")
+    if recommendation is not None:
+        _family(
+            lines, f"{base}_scale_info", "gauge",
+            "current measured autoscale recommendation "
+            "(scale_out | scale_in | hold)",
+        )
+        lines.append(
+            _sample(
+                f"{base}_scale_info",
+                {"recommendation": recommendation}, 1,
+            )
+        )
+
+
 def render_prometheus(metrics: Dict[str, Any]) -> str:
     """The scheduler metrics dict as Prometheus text format 0.0.4."""
     lines: List[str] = []
@@ -346,6 +403,9 @@ def render_prometheus(metrics: Dict[str, Any]) -> str:
             continue
         if key == "memory_accounting":
             _render_memory_accounting(lines, value)
+            continue
+        if key == "fleet":
+            _render_fleet(lines, value)
             continue
         if key == "backend":
             _family(

@@ -211,17 +211,60 @@ def _lane_row() -> Dict[str, Any]:
     }
 
 
+def _live_fleet(store_dir: str) -> Dict[str, Any]:
+    """The store's ``fleet/`` heartbeat files as report rows — the same
+    digest-verified reader the workers use (stdlib only, so the
+    serve-admin no-jax pin holds).  Tolerant of everything: an absent
+    directory, torn files, a reader crash all collapse to empty rows —
+    the report is a forensic tool and must render from the JSONL alone
+    (docs/SERVING.md "Fleet runbook")."""
+    try:
+        from consensus_clustering_tpu.serve.fleet.heartbeat import (
+            read_fleet,
+        )
+
+        peers, rejected = read_fleet(
+            os.path.join(store_dir, "fleet"),
+            now=time.time(),
+            # The report has no scheduler config; be generous so a
+            # just-stopped fleet still renders (age discloses truth).
+            stale_after=900.0,
+        )
+    except Exception:
+        return {"workers": {}, "rejected": 0}
+    now = time.time()
+    workers = {
+        worker: {
+            "queue_depth": hb.get("queue_depth"),
+            "running": hb.get("running"),
+            "drain_rate_per_s": hb.get("drain_rate_per_s"),
+            "slo_burn_active": hb.get("slo_burn_active"),
+            "age_seconds": (
+                round(now - hb["ts"], 1)
+                if isinstance(hb.get("ts"), (int, float)) else None
+            ),
+        }
+        for worker, hb in sorted(peers.items())
+    }
+    return {"workers": workers, "rejected": rejected}
+
+
 def summarize(
     events: Iterable[Dict[str, Any]],
     since: Optional[float] = None,
     until: Optional[float] = None,
+    store_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Aggregate a (time-sliced) event stream into the operator report.
 
     Latency percentiles are per shape bucket (``job_done`` events carry
     ``bucket``; ``queue_wait`` spans join to their job's bucket via
     trace_id) because the sweep's long-tail jobs make a global
-    percentile dishonest — one big-N job is not a regression."""
+    percentile dishonest — one big-N job is not a regression.
+
+    ``store_dir`` (optional) additionally merges the live ``fleet/``
+    heartbeat files into the fleet section — capacity NOW, next to the
+    log's history of steals and scale signals."""
     events = [
         e for e in events
         if (since is None or (e.get("ts") or 0) >= since)
@@ -282,17 +325,27 @@ def summarize(
     # job_* events carry worker_id, so a merged log from a shared-store
     # fleet still tells which worker ran — or was refused — what.
     per_worker: Dict[str, Dict[str, int]] = {}
+    # Fleet layer (docs/SERVING.md "Fleet runbook"): steals are
+    # attributed BOTH ways — the thief's row counts sets/jobs taken,
+    # the victim's row counts jobs lost — and the latest scale signal
+    # is the operator's autoscale verdict for the slice.
+    scale_signals = 0
+    last_scale: Optional[Dict[str, Any]] = None
     ts_lo = ts_hi = None
+
+    def named_worker_row(worker: Any) -> Dict[str, int]:
+        return per_worker.setdefault(
+            str(worker),
+            {"done": 0, "failed": 0, "retried": 0, "requeued": 0,
+             "takeovers": 0, "refused_writes": 0, "heartbeats": 0,
+             "steals": 0, "jobs_stolen": 0, "jobs_lost_to_steal": 0},
+        )
 
     def worker_row(event: Dict[str, Any]) -> Optional[Dict[str, int]]:
         worker = event.get("worker_id")
         if worker is None:
             return None  # pre-lease logs: no fleet, no rows
-        return per_worker.setdefault(
-            str(worker),
-            {"done": 0, "failed": 0, "retried": 0, "requeued": 0,
-             "takeovers": 0, "refused_writes": 0},
-        )
+        return named_worker_row(worker)
     for e in events:
         ts = e.get("ts")
         if isinstance(ts, (int, float)):
@@ -395,6 +448,30 @@ def summarize(
             row = worker_row(e)
             if row is not None:
                 row["refused_writes"] += 1
+        elif name == "work_stolen":
+            row = worker_row(e)
+            count = int(e.get("count") or 0)
+            if row is not None:
+                row["steals"] += 1
+                row["jobs_stolen"] += count
+            if e.get("stolen_from") is not None:
+                named_worker_row(e["stolen_from"])[
+                    "jobs_lost_to_steal"
+                ] += count
+        elif name == "fleet_heartbeat_written":
+            row = worker_row(e)
+            if row is not None:
+                row["heartbeats"] += 1
+        elif name == "fleet_scale_signal":
+            scale_signals += 1
+            last_scale = {
+                k: e.get(k)
+                for k in (
+                    "recommendation", "workers_seen", "fleet_backlog",
+                    "fleet_running", "fleet_drain_rate_per_s",
+                    "est_drain_seconds", "slo_burn_active", "ts",
+                )
+            }
         elif name == "job_wedged":
             wedges += 1
         elif name == "perf_drift":
@@ -502,6 +579,14 @@ def summarize(
         "per_priority": lane_section(per_priority),
         "per_tenant": lane_section(per_tenant),
         "per_worker": {k: per_worker[k] for k in sorted(per_worker)},
+        "fleet": {
+            "scale_signals": scale_signals,
+            "last_scale_signal": last_scale,
+            "live": (
+                _live_fleet(store_dir) if store_dir is not None
+                else None
+            ),
+        },
         "retries": retries,
         "wedges": wedges,
         "perf_drift": drift,
@@ -622,7 +707,42 @@ def render_report(report: Dict[str, Any]) -> str:
                 f" retried={row['retried']} requeued={row['requeued']}"
                 f" takeovers={row['takeovers']}"
                 f" refused_writes={row['refused_writes']}"
+                f" steals={row.get('steals', 0)}"
+                f" jobs_stolen={row.get('jobs_stolen', 0)}"
+                f" jobs_lost_to_steal={row.get('jobs_lost_to_steal', 0)}"
+                f" heartbeats={row.get('heartbeats', 0)}"
             )
+    fleet = report.get("fleet") or {}
+    live = fleet.get("live")
+    if fleet.get("scale_signals") or (live and live.get("workers")):
+        lines.append("")
+        lines.append("fleet (docs/SERVING.md fleet runbook):")
+        last = fleet.get("last_scale_signal")
+        if last is not None:
+            lines.append(
+                f"  scale_signals={fleet.get('scale_signals', 0)}"
+                f"  latest={last.get('recommendation')}"
+                f" (workers={last.get('workers_seen')}"
+                f" backlog={last.get('fleet_backlog')}"
+                f" running={last.get('fleet_running')}"
+                f" drain/s={fmt_opt(last.get('fleet_drain_rate_per_s'))}"
+                f" est_drain={fmt_opt(last.get('est_drain_seconds'))}"
+                f" slo_burn={last.get('slo_burn_active')})"
+            )
+        if live is not None:
+            for worker, hb in (live.get("workers") or {}).items():
+                lines.append(
+                    f"  live {worker}  queue={hb.get('queue_depth')}"
+                    f" running={hb.get('running')}"
+                    f" drain/s={fmt_opt(hb.get('drain_rate_per_s'))}"
+                    f" slo_burn={hb.get('slo_burn_active')}"
+                    f" age={fmt_opt(hb.get('age_seconds'))}s"
+                )
+            if live.get("rejected"):
+                lines.append(
+                    f"  rejected_heartbeats={live['rejected']}"
+                    " (torn/bit-flipped/stale — excluded from rows)"
+                )
     lines.append("")
     lines.append(
         "retries: " + (

@@ -296,8 +296,10 @@ def add_arguments(parser) -> None:
         "report",
         help="per-bucket p50/p95/p99 latency, per-priority and "
         "per-tenant rows (done/failed/cancelled/shed/p95 queue-wait "
-        "— the fair-share lanes), and retry/wedge/drift/SLO "
-        "breakdowns over a time range of the JSONL event log",
+        "— the fair-share lanes), per-worker capacity/steal rows "
+        "merged with the store's live fleet/ heartbeats, and "
+        "retry/wedge/drift/SLO breakdowns over a time range of the "
+        "JSONL event log",
     )
     report.add_argument(
         "--events", required=True, metavar="EVENTS.jsonl",
@@ -501,7 +503,14 @@ def cmd_serve_admin(args) -> int:
         except OSError as e:
             print(f"cannot read events log: {e}", file=sys.stderr)
             return 1
-        report = summarize(events, since=args.since, until=args.until)
+        # store_dir folds the live fleet/ heartbeats into the report's
+        # fleet rows — capacity NOW next to the log's steal history
+        # (docs/SERVING.md "Fleet runbook"); stdlib-only, so the no-jax
+        # pin holds.
+        report = summarize(
+            events, since=args.since, until=args.until,
+            store_dir=args.store_dir,
+        )
         if args.report_json:
             print(json.dumps(report, indent=1, sort_keys=True))
         else:

@@ -138,6 +138,30 @@ Multi-worker lease events (docs/SERVING.md "Multi-worker runbook"):
   ZOMBIE, token — the token we held, newer_token); the successor's
   record stands, local state is dropped
 
+Fleet events (docs/SERVING.md "Fleet runbook"):
+
+- ``fleet_heartbeat_written`` — this worker published its digest-
+  verified capacity advertisement to ``fleet/<worker_id>.json``
+  (worker_id, queue_depth, running — picked-up job count,
+  drain_rate_per_s — the Retry-After basis rate or None before any
+  drain, slo_burn_active — active (objective, bucket) burn pairs);
+  one per lease-maintenance sweep while the fleet layer is enabled
+- ``work_stolen``      — this worker stole a same-bucket SET of queued
+  jobs from a live peer's advertised backlog (worker_id — the THIEF,
+  stolen_from — the victim, job_ids, count, bucket — the shared
+  executable bucket, warm — whether the thief already had it
+  compiled, peer_backlog — the victim's advertised depth the plan
+  acted on); each steal is an ordinary lease claim, so the victim's
+  queue entries stand down quietly at pickup and every stolen job's
+  later lifecycle emits ordinary ``job_*`` events under the thief's
+  worker_id
+- ``fleet_scale_signal`` — the measured autoscale recommendation
+  CHANGED (worker_id, recommendation: scale_out | scale_in | hold,
+  plus the whole disclosed basis: workers_seen, fleet_backlog,
+  fleet_running, fleet_drain_rate_per_s, est_drain_seconds,
+  slo_burn_active, target_drain_seconds); emitted on change only —
+  the steady state is the /metrics ``fleet`` section's job
+
 Data-integrity events (docs/SERVING.md "Integrity runbook"):
 
 - ``integrity_violation`` — the accumulator sentinel found corrupt

@@ -84,6 +84,11 @@ class JobStore:
         # same atomic-rename discipline; the scheduler polls/claims):
         # today one file, profile_next.json.
         self.control_dir = os.path.join(directory, "control")
+        # Fleet capacity advertisements (serve/fleet/heartbeat.py):
+        # one digest-verified <worker_id>.json per live worker,
+        # rewritten every lease sweep with the same tmp-then-rename
+        # discipline as everything else here.
+        self.fleet_dir = os.path.join(directory, "fleet")
         os.makedirs(self.results_dir, exist_ok=True)
         os.makedirs(self.jobs_dir, exist_ok=True)
         os.makedirs(self.payloads_dir, exist_ok=True)
@@ -91,6 +96,7 @@ class JobStore:
         os.makedirs(self.planes_dir, exist_ok=True)
         os.makedirs(self.leases_dir, exist_ok=True)
         os.makedirs(self.control_dir, exist_ok=True)
+        os.makedirs(self.fleet_dir, exist_ok=True)
         self._sweep_stale_tmps()
         self._sweep_stale_checkpoints()
         self._sweep_orphan_payloads()
@@ -195,6 +201,30 @@ class JobStore:
                     shutil.rmtree(job_dir)
                 except OSError:
                     pass
+        self._sweep_stale_heartbeats(now)
+
+    def _sweep_stale_heartbeats(self, now: float) -> None:
+        """GC dead workers' fleet heartbeats, on the lease GC's grace
+        window.  A live worker rewrites its file every lease sweep
+        (seconds), so a heartbeat older than the grace window can only
+        be a dead worker's leaving.  The steal planner already rejects
+        it on staleness long before this runs (serve/fleet/heartbeat.py
+        — a dead worker's advert must never steer a steal); this just
+        keeps the directory from accumulating one file per worker that
+        ever existed."""
+        try:
+            names = os.listdir(self.fleet_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.fleet_dir, name)
+            try:
+                if now - os.path.getmtime(path) > self._TMP_GRACE_SECONDS:
+                    os.remove(path)
+            except OSError:
+                pass
 
     def _sweep_stale_tmps(self) -> None:
         now = time.time()
@@ -205,7 +235,7 @@ class JobStore:
         ]
         for directory in (
             self.results_dir, self.jobs_dir, self.payloads_dir,
-            self.control_dir, *lease_dirs,
+            self.control_dir, self.fleet_dir, *lease_dirs,
         ):
             try:
                 names = os.listdir(directory)

@@ -326,6 +326,35 @@ class LeaseManager:
             return None  # another taker won the O_EXCL race
         return token, reason, (cur or {}).get("worker_id")
 
+    def claim_steal(
+        self, job_id: str
+    ) -> Optional[Tuple[int, Optional[str]]]:
+        """Steal a LIVE peer's lease: claim the next token over it.
+
+        A steal is just a claim — zero new ownership semantics.  The
+        fencing, renewal, release, and tombstone rules are exactly the
+        orphan-takeover ones; the only difference from
+        :meth:`claim_orphan` is the precondition: the current lease
+        must be a live PEER's (dead leases are claim_orphan's job, and
+        our own jobs are not stealable — the fleet planner relieving
+        us of our own queue would be a no-op with extra fencing).  The
+        superseded peer discovers the loss at its next renewal round,
+        and any write it attempts first is refused by the fence like
+        any zombie's.  Returns ``(token, prior_worker)``, or ``None``
+        when the lease is not a live peer's or the claim race was
+        lost."""
+        cur = self.current(job_id)
+        if cur is None:
+            return None
+        if lease_state_name(cur, self._clock()) != "live":
+            return None
+        if cur.get("worker_id") == self.worker_id:
+            return None
+        token = int(cur["token"]) + 1
+        if not self._try_claim(job_id, token):
+            return None
+        return token, cur.get("worker_id")
+
     # -- renewal ---------------------------------------------------------
 
     def renew_owned(self, blocking: bool = True) -> List[str]:

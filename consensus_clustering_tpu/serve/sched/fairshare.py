@@ -305,6 +305,25 @@ class FairShareQueue:
                 self._lanes[lane] = kept
         return taken
 
+    def queued_ids(self, limit: Optional[int] = None) -> List[Any]:
+        """Queued items in APPROXIMATE pickup order — lanes in rotation
+        order, each lane FIFO — for the fleet heartbeat's backlog
+        advertisement (serve/fleet/heartbeat.py).  Approximate by
+        design: DRR deficits and starvation grants can reorder lanes
+        between this snapshot and the actual pickups, which is exactly
+        why the steal planner skips the head and every claim re-reads
+        the record.  Wake sentinels (``None`` items) are excluded."""
+        out: List[Any] = []
+        with self._cond:
+            for lane in list(self._rotation):
+                for item, _ts in self._lanes[lane]:
+                    if item is None:
+                        continue
+                    out.append(item)
+                    if limit is not None and len(out) >= limit:
+                        return out
+        return out
+
     def snapshot(self) -> Dict[str, Any]:
         """Per-lane depths + fairness counters for /metrics.  Lane keys
         are traffic-dynamic (like ``retry_total``); the caller's

@@ -117,6 +117,12 @@ from consensus_clustering_tpu.serve.executor import (
     JobSpecError,
     SweepExecutor,
 )
+from consensus_clustering_tpu.serve.fleet.heartbeat import (
+    read_fleet,
+    write_heartbeat,
+)
+from consensus_clustering_tpu.serve.fleet.signal import scale_signal
+from consensus_clustering_tpu.serve.fleet.steal import plan_steal
 from consensus_clustering_tpu.serve.jobstore import JobStore
 from consensus_clustering_tpu.serve.leases import (
     LeaseLost,
@@ -341,6 +347,9 @@ class Scheduler:
         priority_weights: Optional[Dict[str, float]] = None,
         tenant_weights: Optional[Dict[str, float]] = None,
         starvation_seconds: float = 30.0,
+        fleet: bool = True,
+        fleet_target_drain_seconds: float = 60.0,
+        emulate_device_seconds: float = 0.0,
     ):
         if quarantine_after < 1:
             raise ValueError(
@@ -417,6 +426,43 @@ class Scheduler:
             else max(0.5, ttl / 4.0)
         )
         self._lease_thread: Optional[threading.Thread] = None
+        # Fleet layer (docs/SERVING.md "Fleet runbook"): gated on the
+        # lease layer, because a steal IS a lease claim — without
+        # fencing there is no safe way to move a queued job between
+        # live workers.  The heartbeat/steal/signal round rides the
+        # lease maintenance thread's cadence.
+        self.fleet = bool(fleet) and self.leases is not None
+        self.fleet_target_drain_seconds = float(
+            fleet_target_drain_seconds
+        )
+        # Device-latency emulation (benchmarks/fleet_scaling.py): sleep
+        # this long after every dispatched set, standing in for a
+        # fixed-latency remote accelerator program on CPU-starved
+        # boxes where N worker processes cannot otherwise show a
+        # wall-clock scheduling win.  0.0 (the default) is a no-op on
+        # every production path.
+        if float(emulate_device_seconds) < 0:
+            raise ValueError(
+                "emulate_device_seconds must be >= 0, got "
+                f"{emulate_device_seconds}"
+            )
+        self.emulate_device_seconds = float(emulate_device_seconds)
+        # Steal-policy knobs (attributes, not ctor params: policy
+        # details the fleet tests tune, with defaults derived from the
+        # fusion ceiling).  head_skip is the tail-stealing rule — skip
+        # the entries the victim will pick up before its next renewal
+        # round can even tell it it was robbed.
+        self._steal_head_skip = max(2, int(fusion_max))
+        self._steal_max_sets_per_round = 4
+        self._fleet_backlog_limit = 512
+        # A heartbeat older than this never steers a steal or the
+        # scale signal: two missed write rounds plus the lease ttl —
+        # by then the worker's leases are expiring and its jobs are
+        # the takeover sweep's, not the steal planner's.
+        self._fleet_stale_after = 2.0 * self.lease_sweep + (
+            ttl if leases else 60.0
+        )
+        self._last_scale_recommendation: Optional[str] = None
         self._sleep = sleep  # injectable so retry tests need not wait
         # The admission queue: weighted-fair DRR lanes over tenant ×
         # priority by default (docs/SERVING.md "Fair-share & fusion
@@ -485,6 +531,33 @@ class Scheduler:
         self.lease_takeovers_total = 0
         self.lease_refused_writes_total = 0
         self.lease_expired_total = 0
+        # Fleet-layer counters (docs/SERVING.md "Fleet runbook"),
+        # pre-seeded like everything /metrics dict-copies: steal SETS
+        # this worker executed and the jobs that rode them, jobs of
+        # OURS a peer stole (healthy rebalancing, counted apart from
+        # lease_expired_total — expiry is pathology, a steal is the
+        # fleet working), heartbeats written / rejected at read
+        # (torn, bit-flipped, stale), and scale-signal changes.
+        self.steals_total = 0
+        self.stolen_jobs_total = 0
+        self.jobs_lost_to_steal_total = 0
+        self.fleet_heartbeats_written_total = 0
+        self.fleet_heartbeats_rejected_total = 0
+        self.fleet_scale_signals_total = 0
+        # The /metrics "fleet" section: FIXED key set (schema-tested),
+        # refreshed by every fleet round; the pre-seeded shape is what
+        # a fleet-disabled or not-yet-rounded scheduler reports.
+        self._fleet_snapshot: Dict[str, Any] = {
+            "enabled": self.fleet,
+            "workers_seen": 0,
+            "fleet_backlog": 0,
+            "peer_backlog": 0,
+            "fleet_running": 0,
+            "fleet_drain_rate_per_s": None,
+            "est_drain_seconds": None,
+            "slo_burn_active": 0,
+            "recommendation": None,
+        }
         # Silent-corruption defense counters (docs/SERVING.md
         # "Integrity runbook"): sentinel evaluations across executed
         # jobs, and breaches by detection point — pre-seeded with every
@@ -784,6 +857,15 @@ class Scheduler:
                 self._reconcile_orphans(boot=False)
             except Exception:  # noqa: BLE001 — the sweep must not die
                 logger.exception("lease takeover sweep failed")
+            if self.fleet:
+                try:
+                    # Heartbeat + steal + scale signal, one round per
+                    # sweep (docs/SERVING.md "Fleet runbook").  Any
+                    # failure degrades to the solo behaviour the
+                    # service had before the fleet layer existed.
+                    self._fleet_round()
+                except Exception:  # noqa: BLE001 — degrade, never die
+                    logger.exception("fleet round failed")
             # Periodic tombstone GC (grace-windowed inside the store):
             # without it a long-lived service keeps one released lease
             # dir per terminal job forever, and the takeover sweep
@@ -817,26 +899,76 @@ class Scheduler:
         to the successor's on-disk record, and leave any still-running
         thread to be refused by the fence at its next write."""
         for job_id in lost:
+            # A superseded lease has two healths: EXPIRY (we went
+            # silent and a peer took over — pathology) and a STEAL (a
+            # hungry peer claimed our queued backlog — the fleet layer
+            # working as designed).  The stolen record carries
+            # ``stolen_by``, so the two are countable apart; lumping
+            # steals into lease_expired_total would make healthy
+            # rebalancing read as worker death on every dashboard.
+            stolen_by = None
+            try:
+                rec = self.store.load_job(job_id)
+                if rec is not None:
+                    stolen_by = rec.get("stolen_by")
+            except Exception:  # noqa: BLE001 — accounting best-effort
+                pass
             with self._lock:
-                self.lease_expired_total += 1
+                if stolen_by:
+                    self.jobs_lost_to_steal_total += 1
+                else:
+                    self.lease_expired_total += 1
                 self._jobs.pop(job_id, None)
                 self._specs.pop(job_id, None)
                 self._data.pop(job_id, None)
                 self._fusion_keys.pop(job_id, None)
                 self._cancel_flags.pop(job_id, None)
-            logger.warning(
-                "lease for job %s expired and was taken over by a peer; "
-                "local state dropped (any in-flight attempt will be "
-                "fenced at its next write)", job_id,
+            if stolen_by:
+                logger.info(
+                    "job %s was stolen by peer %s; local state dropped "
+                    "(its queue entry stands down quietly at pickup)",
+                    job_id, stolen_by,
+                )
+            else:
+                logger.warning(
+                    "lease for job %s expired and was taken over by a "
+                    "peer; local state dropped (any in-flight attempt "
+                    "will be fenced at its next write)", job_id,
+                )
+        # Purge the lost jobs' QUEUE entries too.  Without this they
+        # sit as ghosts until the worker thread dequeues each one just
+        # to stand down at the pickup fence — and until then they are
+        # counted by ``queued_ids`` into the advertised backlog, so a
+        # heavily-stolen-from victim keeps reporting phantom depth:
+        # peers aim steals at jobs that are already gone and the scale
+        # signal reads ``scale_out`` long after the real drain.  A
+        # ghost that was already dequeued before this runs still
+        # stands down quietly at the fence, as before.
+        if lost and hasattr(self._queue, "take_matching"):
+            lost_set = set(lost)
+            self._queue.take_matching(
+                lambda jid: jid in lost_set, len(lost_set)
             )
 
-    def _fence(self, job_id: str, op: str) -> None:
+    def _fence(self, job_id: str, op: str, quiet: bool = False) -> None:
         """The write-side lease gate: every state-mutating jobstore
         write for a job runs through here first.  A newer token means
         the job was taken over — we are the zombie — so the write is
         REFUSED: counted, logged as ``lease_refused``, local state
         dropped (the successor's record is the record), and
-        :class:`LeaseLost` raised to unwind the caller."""
+        :class:`LeaseLost` raised to unwind the caller.
+
+        ``quiet=True`` is the STOLEN-AT-PICKUP spelling (docs/
+        SERVING.md "Fleet runbook"): a failed fence on a write that
+        precedes any execution — the pickup pre-check and the
+        attempt-0 "running" transition — means a peer stole the job
+        out of our queue while it waited.  Nothing ran, nothing is
+        lost, the thief owns the job's whole story; that is a healthy
+        stand-down, not a zombie refusal, so it unwinds without the
+        counter or the ``lease_refused`` event (which keeps "zero
+        fenced-write refusals" a meaningful health assertion for a
+        fleet that steals constantly).  Every post-execution write
+        stays LOUD."""
         if self.leases is None:
             return
         if self.leases.check_fence(job_id):
@@ -844,12 +976,20 @@ class Scheduler:
         mine, newest = self.leases.fence_info(job_id)
         self.leases.forget(job_id)
         with self._lock:
-            self.lease_refused_writes_total += 1
+            if not quiet:
+                self.lease_refused_writes_total += 1
             self._jobs.pop(job_id, None)
             self._specs.pop(job_id, None)
             self._data.pop(job_id, None)
             self._fusion_keys.pop(job_id, None)
             self._cancel_flags.pop(job_id, None)
+        if quiet:
+            logger.info(
+                "job %s was claimed by a peer before pickup (%s): held "
+                "token %s, newest %s — standing down", job_id, op,
+                mine, newest,
+            )
+            raise LeaseLost(job_id, op, mine, newest)
         self.events.emit(
             "lease_refused", job_id=job_id, op=op,
             worker_id=self.worker_id, token=mine, newer_token=newest,
@@ -1129,6 +1269,294 @@ class Scheduler:
                 "job_failed", job_id=job_id, error=reason, kind="restart",
                 worker_id=self.worker_id,
             )
+
+    # -- fleet -----------------------------------------------------------
+
+    def _warm_buckets(self) -> set:
+        """Executable buckets this worker has a warm engine for —
+        duck-typed off the executor's engine cache (stub executors
+        simply have no warm set), used for the steal planner's
+        prefer-warm rule and the heartbeat advertisement."""
+        engines = getattr(self.executor, "_engines", None)
+        if not isinstance(engines, dict):
+            return set()
+        try:
+            return set(engines)
+        except RuntimeError:  # resized mid-iteration by a compile
+            return set()
+
+    def _fleet_heartbeat_payload(self, now: float) -> Dict[str, Any]:
+        """This worker's capacity advertisement (serve/fleet/
+        heartbeat.py): backlog entries carry the EXECUTABLE bucket
+        (``spec.bucket`` — the engine-cache key, what a thief's
+        prefer-warm rule matches against) and the admission-time
+        fusion key (what makes a stolen set fusable on arrival)."""
+        with self._lock:
+            running = sorted(
+                j for j in self._jobs if j not in self._specs
+            )
+            specs = dict(self._specs)
+            shapes = {j: x.shape for j, x in self._data.items()}
+            fusion_keys = dict(self._fusion_keys)
+            drained = [
+                t for t in self._drain_times
+                if now - t <= self._DRAIN_WINDOW_SECONDS
+            ]
+        queued = (
+            self._queue.queued_ids(limit=self._fleet_backlog_limit)
+            if self.schedule == "fair" else []
+        )
+        backlog: List[Dict[str, Any]] = []
+        for job_id in queued:
+            spec = specs.get(job_id)
+            shape = shapes.get(job_id)
+            if spec is None or shape is None:
+                continue  # cancelled/taken between snapshot and here
+            n, d = (int(v) for v in shape)
+            backlog.append({
+                "job_id": job_id,
+                "bucket": spec.bucket(
+                    n, d, self._resolved_h_block(spec, n, d)
+                ),
+                "fuse_key": fusion_keys.get(job_id),
+                "priority": getattr(spec, "priority", "normal"),
+            })
+        rate = (
+            round(len(drained) / self._DRAIN_WINDOW_SECONDS, 4)
+            if drained else None
+        )
+        active = self.slo.snapshot().get("active") or {}
+        burn_active = sum(
+            1
+            for per_bucket in active.values()
+            if isinstance(per_bucket, dict)
+            for flag in per_bucket.values()
+            if flag
+        )
+        return {
+            "worker_id": self.worker_id,
+            "ts": round(now, 3),
+            "capacity": int(self._queue.maxsize),
+            "queue_depth": int(self._queue.qsize()),
+            "running": running,
+            "backlog": backlog,
+            "drain_rate_per_s": rate,
+            "warm_buckets": sorted(self._warm_buckets()),
+            "slo_burn_active": burn_active,
+            "schedule": self.schedule,
+            "fusion_max": self.fusion_max,
+        }
+
+    def _fleet_round(self) -> None:
+        """One fleet beat, riding the lease maintenance cadence
+        (docs/SERVING.md "Fleet runbook"): publish our heartbeat, read
+        the peers' (digest-verified, staleness-gated — torn or absent
+        adverts degrade to the solo behaviour), refresh the autoscale
+        signal (event on recommendation CHANGE only), and steal a
+        same-bucket set when we are hungry and a peer is drowning."""
+        now = time.time()
+        payload = self._fleet_heartbeat_payload(now)
+        try:
+            write_heartbeat(self.store.fleet_dir, payload)
+            with self._lock:
+                self.fleet_heartbeats_written_total += 1
+            self.events.emit(
+                "fleet_heartbeat_written", worker_id=self.worker_id,
+                queue_depth=payload["queue_depth"],
+                running=len(payload["running"]),
+                drain_rate_per_s=payload["drain_rate_per_s"],
+                slo_burn_active=payload["slo_burn_active"],
+            )
+        except OSError:
+            logger.exception("fleet heartbeat write failed")
+        peers, rejected = read_fleet(
+            self.store.fleet_dir, now=now,
+            stale_after=self._fleet_stale_after,
+            skip_worker=self.worker_id,
+        )
+        if rejected:
+            with self._lock:
+                self.fleet_heartbeats_rejected_total += rejected
+        fleet_view = dict(peers)
+        fleet_view[self.worker_id] = payload
+        sig = scale_signal(
+            fleet_view,
+            target_drain_seconds=self.fleet_target_drain_seconds,
+        )
+        basis = sig["basis"]
+        recommendation = sig["recommendation"]
+        with self._lock:
+            self._fleet_snapshot = {
+                "enabled": True,
+                "workers_seen": basis["workers_seen"],
+                "fleet_backlog": basis["fleet_backlog"],
+                "peer_backlog": (
+                    basis["fleet_backlog"] - payload["queue_depth"]
+                ),
+                "fleet_running": basis["fleet_running"],
+                "fleet_drain_rate_per_s":
+                    basis["fleet_drain_rate_per_s"],
+                "est_drain_seconds": basis["est_drain_seconds"],
+                "slo_burn_active": basis["slo_burn_active"],
+                "recommendation": recommendation,
+            }
+            changed = recommendation != self._last_scale_recommendation
+            if changed:
+                self._last_scale_recommendation = recommendation
+                self.fleet_scale_signals_total += 1
+        if changed:
+            self.events.emit(
+                "fleet_scale_signal", worker_id=self.worker_id,
+                recommendation=recommendation, **basis,
+            )
+        if peers:
+            self._maybe_steal(peers)
+
+    def _maybe_steal(self, peers: Dict[str, Dict[str, Any]]) -> None:
+        """Steal same-bucket sets while WE are hungry (queue at or
+        below one fusion batch) and free capacity exists.  Bounded per
+        round so one beat never floods the local queue — the next beat
+        re-plans over fresh adverts."""
+        if self.leases is None:
+            return
+        taken_this_round: set = set()
+        for _ in range(self._steal_max_sets_per_round):
+            depth = self._queue.qsize()
+            free = self._queue.maxsize - depth
+            if depth > max(1, self.fusion_max) or free < 1:
+                return
+            with self._lock:
+                known = set(self._jobs)
+            plan = plan_steal(
+                peers,
+                max_jobs=min(free, max(1, self.fusion_max)),
+                head_skip=self._steal_head_skip,
+                warm_buckets=self._warm_buckets(),
+                exclude=known | taken_this_round,
+            )
+            if plan is None:
+                return
+            taken_this_round.update(plan["job_ids"])
+            if not self._execute_steal_plan(plan):
+                return
+
+    def _execute_steal_plan(self, plan: Dict[str, Any]) -> List[str]:
+        """Walk one steal plan: claim each job's next fencing token
+        over the victim's LIVE lease, adopt it (payload → local state
+        → our queue), and disclose the set with one ``work_stolen``
+        event.  Every adoption re-reads record and lease — a stale
+        advert costs a skipped claim, never a double execution."""
+        victim = plan["victim"]
+        executed: List[str] = []
+        for job_id in plan["job_ids"]:
+            record = self.store.load_job(job_id)
+            if record is None or record.get("status") != "queued":
+                continue
+            with self._lock:
+                if job_id in self._jobs:
+                    continue
+            # Only steal from the lease's CURRENT live owner, and only
+            # when that owner is the advertising victim: a job another
+            # thief already claimed (record still "queued", lease now
+            # the thief's) must not ping-pong on a stale advert.
+            cur = self.leases.current(job_id)
+            if (
+                cur is None
+                or lease_state_name(cur, time.time()) != "live"
+                or cur.get("worker_id") != victim
+            ):
+                continue
+            claimed = self.leases.claim_steal(job_id)
+            if claimed is None:
+                continue
+            try:
+                if self._adopt_stolen_job(job_id, victim):
+                    executed.append(job_id)
+            except LeaseLost:
+                continue  # out-stolen while adopting — their story now
+            except Exception:  # noqa: BLE001 — isolate per job
+                logger.exception(
+                    "adopting stolen job %s failed", job_id
+                )
+                # The burned token is deliberately NOT released:
+                # forget() lets it expire unrenewed, and the ordinary
+                # takeover sweep (ours or a peer's) re-queues the job
+                # from its persisted payload within ~ttl + one sweep.
+                self.leases.forget(job_id)
+        if executed:
+            with self._lock:
+                self.steals_total += 1
+                self.stolen_jobs_total += len(executed)
+            self.events.emit(
+                "work_stolen", worker_id=self.worker_id,
+                stolen_from=victim, job_ids=executed,
+                count=len(executed), bucket=plan.get("bucket"),
+                warm=bool(plan.get("warm")),
+                peer_backlog=plan.get("peer_backlog"),
+            )
+        return executed
+
+    def _adopt_stolen_job(self, job_id: str, victim: str) -> bool:
+        """Post-claim adoption: freshness gate, payload load, local
+        registration, fenced record write (the ``stolen_by`` mark that
+        turns the victim's lost lease into a counted steal instead of
+        an expiry), enqueue.  Returns False — leaving recovery to the
+        lease-expiry path — when the job moved on or cannot be
+        adopted."""
+        fresh = self.store.load_job(job_id)
+        if fresh is None or fresh.get("status") not in (
+            "queued", "running",
+        ):
+            # Terminalised while we claimed: tombstone the token we
+            # burned (the claim-orphan rule — _fresh_or_stand_down).
+            self.leases.release(
+                job_id, (fresh or {}).get("status") or "done"
+            )
+            return False
+        payload = self.store.load_payload(job_id)
+        if payload is None:
+            self.leases.forget(job_id)  # expiry → takeover sweep
+            return False
+        spec_payload, x, _requeues = payload
+        try:
+            spec = JobSpec.from_payload(spec_payload)
+        except (KeyError, TypeError, ValueError):
+            self.leases.forget(job_id)
+            return False
+        fuse_key = None
+        if self.fusion_max >= 2 and hasattr(self.executor, "run_fused"):
+            n, d = (int(v) for v in x.shape)
+            fuse_key = fusion_key(
+                spec, n, d, self._resolved_h_block(spec, n, d)
+            )
+        fresh["status"] = "queued"
+        with self._lock:
+            self._jobs[job_id] = fresh
+            self._specs[job_id] = spec
+            self._data[job_id] = x
+            self._fusion_keys[job_id] = fuse_key
+        # Mirror BEFORE enqueueing (submit()'s rule).  We hold the
+        # newest token, so this fenced write lands; quiet_fence covers
+        # the tiny window where a third thief out-claims us.
+        self._update(
+            job_id, quiet_fence=True, status="queued",
+            stolen_by=self.worker_id, stolen_from=victim,
+            stolen_at=round(time.time(), 3),
+        )
+        try:
+            self._enqueue(job_id, spec)
+        except queue.Full:
+            # Raced a local admission flood: drop the local state and
+            # let the token expire unrenewed — the takeover sweep
+            # re-queues the job from its payload.  Never strand it.
+            with self._lock:
+                self._jobs.pop(job_id, None)
+                self._specs.pop(job_id, None)
+                self._data.pop(job_id, None)
+                self._fusion_keys.pop(job_id, None)
+            self.leases.forget(job_id)
+            return False
+        return True
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
@@ -1797,6 +2225,23 @@ class Scheduler:
                 "lease_refused_writes_total":
                     self.lease_refused_writes_total,
                 "lease_expired_total": self.lease_expired_total,
+                # Fleet layer (docs/SERVING.md "Fleet runbook"): steal
+                # sets executed / jobs ridden / jobs of ours a peer
+                # stole (healthy rebalancing, counted apart from
+                # expiry), heartbeat writes and rejected reads, scale-
+                # signal changes, and the fixed-key fleet snapshot the
+                # last round refreshed.  All pre-seeded.
+                "steals_total": self.steals_total,
+                "stolen_jobs_total": self.stolen_jobs_total,
+                "jobs_lost_to_steal_total":
+                    self.jobs_lost_to_steal_total,
+                "fleet_heartbeats_written_total":
+                    self.fleet_heartbeats_written_total,
+                "fleet_heartbeats_rejected_total":
+                    self.fleet_heartbeats_rejected_total,
+                "fleet_scale_signals_total":
+                    self.fleet_scale_signals_total,
+                "fleet": dict(self._fleet_snapshot),
                 # Silent-corruption defense (docs/SERVING.md "Integrity
                 # runbook"): sentinel evaluations and breaches by
                 # detection point (retried as corrupt:<point>).  All
@@ -1845,12 +2290,20 @@ class Scheduler:
 
     # -- worker ----------------------------------------------------------
 
-    def _update(self, job_id: str, **fields) -> Dict[str, Any]:
+    def _update(
+        self, job_id: str, quiet_fence: bool = False, **fields
+    ) -> Dict[str, Any]:
         # The fence: a record write for a job whose lease a peer
         # superseded must not land — the successor owns this job's
         # story now.  Raises LeaseLost (handled by the worker loop)
-        # after emitting lease_refused.
-        self._fence(job_id, f"update:{fields.get('status') or 'fields'}")
+        # after emitting lease_refused — except under ``quiet_fence``,
+        # the attempt-0 pickup spelling where a refusal means the job
+        # was STOLEN while queued and the stand-down is healthy
+        # (see _fence).
+        self._fence(
+            job_id, f"update:{fields.get('status') or 'fields'}",
+            quiet=quiet_fence,
+        )
         with self._lock:
             record = self._jobs.get(job_id)
             if record is None:
@@ -2065,12 +2518,26 @@ class Scheduler:
             # kwarg); stub executors never see it.
             kwargs["heartbeat"] = heartbeat
         if self.job_timeout is None and not supervise_wedge:
-            return self.executor.run(spec, x, progress_cb, **kwargs)
+            result = self.executor.run(spec, x, progress_cb, **kwargs)
+            self._emulate_device_latency()
+            return result
 
         def call():
             return self.executor.run(spec, x, progress_cb, **kwargs)
 
-        return self._supervised_call(call, heartbeat, expected_block_fn)
+        result = self._supervised_call(call, heartbeat, expected_block_fn)
+        self._emulate_device_latency()
+        return result
+
+    def _emulate_device_latency(self) -> None:
+        """Benchmark-only (``--emulate-device-seconds``): sleep once per
+        EXECUTOR PROGRAM that actually ran, so fleet benchmarks on a
+        small host can model device-bound sets without charging the
+        latency to dispatches that never reach the device (quiet
+        stand-downs for stolen jobs, terminal-state skips).  0.0 — a
+        no-op — on every production path."""
+        if self.emulate_device_seconds > 0:
+            self._sleep(self.emulate_device_seconds)
 
     def _supervised_call(self, call, heartbeat, expected_block_fn):
         """The supervision core shared by the solo and fused execution
@@ -2231,6 +2698,14 @@ class Scheduler:
             # A lease takeover (note-lost sweep) evicted the job between
             # dequeue and pickup: the successor owns it — stand down.
             raise LeaseLost(job_id, "pickup", None, None)
+        if preloaded is None:
+            # Pickup pre-check (docs/SERVING.md "Fleet runbook"): a
+            # peer may have STOLEN this queued job since we admitted
+            # it — our queue entry is then a ghost.  Checking the
+            # fence before any write or SLO observation makes the
+            # stand-down free and QUIET: nothing executed, nothing
+            # lost, no refusal counted (no write was even attempted).
+            self._fence(job_id, "pickup", quiet=True)
         with self._lock:
             fp = record["fingerprint"]
             submitted_at = float(record.get("submitted_at") or time.time())
@@ -2398,9 +2873,16 @@ class Scheduler:
                 # Fresh per attempt: a retry's deadline clock must not
                 # inherit the wedged attempt's silence.
                 heartbeat = Heartbeat()
+            # Attempt 0's "running" write fences QUIETLY: a refusal
+            # there means the job was stolen between the pre-check
+            # and this write (nothing ran — a healthy stand-down).
+            # Retries and every later write stay loud: by then this
+            # worker has executed, and a refusal is the real zombie
+            # signal.
             self._update(
                 job_id, status="running", attempt=attempt,
                 started_at=round(time.time(), 3),
+                quiet_fence=(attempt == 0),
             )
             self.events.emit(
                 "job_started", job_id=job_id, attempt=attempt,
@@ -2841,9 +3323,13 @@ class Scheduler:
         started: List[str] = []
         for job_id in job_ids:
             try:
+                # Quiet fence (the solo path's attempt-0 rule): a
+                # refusal here means a peer stole the job while it
+                # queued — stand down without the zombie counter.
                 self._update(
                     job_id, status="running", attempt=0,
                     started_at=round(time.time(), 3),
+                    quiet_fence=True,
                 )
             except LeaseLost:
                 continue
@@ -2927,6 +3413,7 @@ class Scheduler:
                 results = self._supervised_call(
                     call, heartbeat, expected_block_fn
                 )
+            self._emulate_device_latency()
         except JobCancelled as e:
             # One client walked away mid-batch: ITS job terminalises,
             # the batch-mates degrade to solo (they resume from the

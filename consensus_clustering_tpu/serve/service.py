@@ -450,6 +450,9 @@ class ConsensusService:
         starvation_seconds: float = 30.0,
         tenant_header: Optional[str] = "X-Tenant",
         sse_keepalive_seconds: float = 5.0,
+        fleet: bool = True,
+        fleet_target_drain_seconds: float = 60.0,
+        emulate_device_seconds: float = 0.0,
     ):
         self.store = JobStore(store_dir)
         self.events = EventLog(events_path)
@@ -480,6 +483,9 @@ class ConsensusService:
             priority_weights=priority_weights,
             tenant_weights=tenant_weights,
             starvation_seconds=starvation_seconds,
+            fleet=fleet,
+            fleet_target_drain_seconds=fleet_target_drain_seconds,
+            emulate_device_seconds=emulate_device_seconds,
         )
         self.tenant_header = tenant_header
         if sse_keepalive_seconds <= 0:

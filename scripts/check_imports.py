@@ -43,6 +43,7 @@ EXPECTED_SUBPACKAGES = (
     "consensus_clustering_tpu.parallel",
     "consensus_clustering_tpu.resilience",
     "consensus_clustering_tpu.serve",
+    "consensus_clustering_tpu.serve.fleet",
     "consensus_clustering_tpu.serve.sched",
     "consensus_clustering_tpu.utils",
 )

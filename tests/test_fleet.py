@@ -1,0 +1,608 @@
+"""Fleet capacity layer: heartbeats, work stealing, autoscale signal.
+
+Unit coverage for :mod:`consensus_clustering_tpu.serve.fleet`
+(digest-verified heartbeats, the same-bucket steal planner, the
+measured scale signal — all pure or disk-only, tested in isolation)
+plus the scheduler integration the capacity story rests on: a hungry
+worker steals a drowning live peer's queued tail through an ordinary
+lease claim, every stolen job executes exactly once, the victim counts
+the loss as a steal (not an expiry), and a bit-flipped heartbeat is
+refused so the reader degrades to the proven solo pickup.  The
+multi-process version — four workers draining one flooded store ≥3×
+faster than the solo control — is ``benchmarks/fleet_scaling.py``
+(committed record ``benchmarks/fleet_scaling/FLEET_SCALING.json``).
+
+Everything here is host-only: stub executors, no compiles, no sleeps
+beyond short waits on worker threads — the fast tier-1 lane stays
+fast.  Fleet rounds are driven by calling ``_fleet_round()`` directly
+for determinism; the live cadence (riding the lease maintenance
+thread) is the chaos/benchmark harnesses' job.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from consensus_clustering_tpu.serve.executor import parse_job_spec
+from consensus_clustering_tpu.serve.fleet.heartbeat import (
+    HEARTBEAT_VERSION,
+    heartbeat_digest,
+    heartbeat_path,
+    read_fleet,
+    read_heartbeat,
+    write_heartbeat,
+)
+from consensus_clustering_tpu.serve.fleet.signal import scale_signal
+from consensus_clustering_tpu.serve.fleet.steal import plan_steal
+from consensus_clustering_tpu.serve.jobstore import JobStore
+from consensus_clustering_tpu.serve.leases import LeaseManager
+from consensus_clustering_tpu.serve.scheduler import Scheduler
+from consensus_clustering_tpu.serve.sched import FairShareQueue
+
+
+class _Clock:
+    """An injectable wall clock: lease expiry without sleeping."""
+
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def tick(self, seconds):
+        self.now += seconds
+
+
+def _hb(worker_id, ts, **fields):
+    payload = {"worker_id": worker_id, "ts": ts}
+    payload.update(fields)
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats: atomic write, digest verification, staleness
+
+
+class TestHeartbeat:
+    def test_write_read_round_trip(self, tmp_path):
+        fleet = str(tmp_path / "fleet")
+        path = write_heartbeat(
+            fleet, _hb("wa", 100.0, queue_depth=3, backlog=[])
+        )
+        assert path == heartbeat_path(fleet, "wa")
+        back = read_heartbeat(path)
+        assert back["worker_id"] == "wa"
+        assert back["queue_depth"] == 3
+        assert back["version"] == HEARTBEAT_VERSION
+        assert back["digest"] == heartbeat_digest(back)
+        # No tmp leavings after a healthy write.
+        assert os.listdir(fleet) == ["wa.json"]
+
+    def test_worker_id_cannot_escape_fleet_dir(self, tmp_path):
+        fleet = str(tmp_path / "fleet")
+        path = heartbeat_path(fleet, f"..{os.sep}evil")
+        assert os.path.dirname(path) == fleet
+
+    def test_bit_flip_is_rejected(self, tmp_path):
+        fleet = str(tmp_path / "fleet")
+        path = write_heartbeat(fleet, _hb("wa", 100.0, queue_depth=3))
+        blob = bytearray(open(path, "rb").read())
+        # Flip one digit inside the payload (queue_depth 3 -> 7): the
+        # JSON still parses — only the digest can catch this.
+        blob = blob.replace(b'"queue_depth": 3', b'"queue_depth": 7')
+        with open(path, "wb") as f:
+            f.write(blob)
+        assert json.loads(open(path).read())["queue_depth"] == 7
+        assert read_heartbeat(path) is None
+        peers, rejected = read_fleet(fleet, now=101.0, stale_after=60.0)
+        assert peers == {} and rejected == 1
+
+    def test_torn_and_wrong_version_rejected(self, tmp_path):
+        fleet = str(tmp_path / "fleet")
+        path = write_heartbeat(fleet, _hb("wa", 100.0))
+        blob = open(path).read()
+        with open(path, "w") as f:
+            f.write(blob[: len(blob) // 2])  # torn mid-write
+        assert read_heartbeat(path) is None
+        # Wrong version with a VALID digest: still rejected — readers
+        # must not guess at schemas they do not know.
+        payload = _hb("wb", 100.0)
+        payload["version"] = HEARTBEAT_VERSION + 1
+        payload["digest"] = heartbeat_digest(payload)
+        wb = os.path.join(fleet, "wb.json")
+        with open(wb, "w") as f:
+            json.dump(payload, f, sort_keys=True)
+        assert read_heartbeat(wb) is None
+
+    def test_read_fleet_staleness_tmp_skip_and_self_skip(self, tmp_path):
+        fleet = str(tmp_path / "fleet")
+        write_heartbeat(fleet, _hb("fresh", 100.0))
+        write_heartbeat(fleet, _hb("old", 10.0))
+        write_heartbeat(fleet, _hb("me", 100.0))
+        # A crash-stranded tmp is invisible (the store's tmp sweep owns
+        # it), never a rejection.
+        with open(os.path.join(fleet, "x.json.deadbeef.tmp"), "w") as f:
+            f.write("{")
+        peers, rejected = read_fleet(
+            fleet, now=105.0, stale_after=60.0, skip_worker="me"
+        )
+        assert set(peers) == {"fresh"}
+        assert rejected == 1  # the stale one; tmp and self don't count
+
+    def test_absent_dir_is_an_empty_fleet(self, tmp_path):
+        peers, rejected = read_fleet(
+            str(tmp_path / "nope"), now=0.0, stale_after=60.0
+        )
+        assert peers == {} and rejected == 0
+
+
+# ---------------------------------------------------------------------------
+# The steal planner: same-bucket sets from the victim's tail
+
+
+def _backlog(*entries):
+    return [
+        {"job_id": j, "bucket": b, "fuse_key": fk, "priority": "normal"}
+        for j, b, fk in entries
+    ]
+
+
+def _peer(worker_id, backlog, running=(), depth=None):
+    return _hb(
+        worker_id, 100.0,
+        backlog=backlog,
+        running=list(running),
+        queue_depth=len(backlog) if depth is None else depth,
+    )
+
+
+class TestPlanSteal:
+    def test_no_peers_or_empty_backlog_is_none(self):
+        assert plan_steal({}, max_jobs=4) is None
+        peers = {"wa": _peer("wa", [])}
+        assert plan_steal(peers, max_jobs=4) is None
+        assert plan_steal(peers, max_jobs=0) is None
+
+    def test_head_skip_protects_the_victims_next_pickups(self):
+        backlog = _backlog(
+            ("j1", "b1", None), ("j2", "b1", None), ("j3", "b1", None)
+        )
+        peers = {"wa": _peer("wa", backlog)}
+        plan = plan_steal(peers, max_jobs=4, head_skip=2)
+        assert plan["job_ids"] == ["j3"]
+        assert plan_steal(peers, max_jobs=4, head_skip=3) is None
+
+    def test_takes_one_whole_group_largest_first(self):
+        backlog = _backlog(
+            ("j1", "b1", "f1"), ("j2", "b1", "f1"),
+            ("j3", "b2", "f2"), ("j4", "b2", "f2"), ("j5", "b2", "f2"),
+        )
+        peers = {"wa": _peer("wa", backlog)}
+        plan = plan_steal(peers, max_jobs=8, head_skip=0)
+        # One (bucket, fuse_key) group — never a mixed set (the stolen
+        # set must arrive fusable), largest group wins cold.
+        assert plan["bucket"] == "b2" and plan["fuse_key"] == "f2"
+        assert plan["job_ids"] == ["j3", "j4", "j5"]
+        assert plan["warm"] is False
+
+    def test_warm_bucket_beats_a_larger_cold_group(self):
+        backlog = _backlog(
+            ("j1", "cold", None), ("j2", "cold", None),
+            ("j3", "cold", None), ("j4", "warmb", None),
+        )
+        peers = {"wa": _peer("wa", backlog)}
+        plan = plan_steal(
+            peers, max_jobs=8, head_skip=0, warm_buckets={"warmb"}
+        )
+        assert plan["bucket"] == "warmb" and plan["warm"] is True
+        assert plan["job_ids"] == ["j4"]
+
+    def test_max_jobs_caps_from_the_group_end(self):
+        backlog = _backlog(
+            ("j1", "b", None), ("j2", "b", None), ("j3", "b", None)
+        )
+        peers = {"wa": _peer("wa", backlog)}
+        plan = plan_steal(peers, max_jobs=2, head_skip=0)
+        assert plan["job_ids"] == ["j2", "j3"]  # tail of the group
+
+    def test_running_and_excluded_jobs_are_untouchable(self):
+        backlog = _backlog(
+            ("j1", "b", None), ("j2", "b", None), ("j3", "b", None)
+        )
+        peers = {"wa": _peer("wa", backlog, running=["j2"])}
+        plan = plan_steal(
+            peers, max_jobs=8, head_skip=0, exclude={"j3"}
+        )
+        assert plan["job_ids"] == ["j1"]
+
+    def test_prefers_the_most_backlogged_victim(self):
+        peers = {
+            "small": _peer("small", _backlog(("s1", "b", None))),
+            "big": _peer(
+                "big",
+                _backlog(("g1", "b", None), ("g2", "b", None),
+                         ("g3", "b", None)),
+            ),
+        }
+        plan = plan_steal(peers, max_jobs=8, head_skip=0)
+        assert plan["victim"] == "big"
+        assert plan["peer_backlog"] == 3
+
+    def test_garbage_adverts_are_skipped_not_fatal(self):
+        peers = {
+            "bad": _hb("bad", 100.0, backlog="not-a-list", queue_depth=9),
+            "odd": _peer(
+                "odd",
+                ["junk", {"job_id": None}, {"job_id": "ok", "bucket": "b",
+                                            "fuse_key": None}],
+                depth=3,
+            ),
+        }
+        plan = plan_steal(peers, max_jobs=4, head_skip=0)
+        assert plan["victim"] == "odd" and plan["job_ids"] == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# The autoscale signal: drain arithmetic, not vibes
+
+
+class TestScaleSignal:
+    def test_empty_fleet_holds(self):
+        sig = scale_signal({})
+        assert sig["recommendation"] == "hold"
+        assert sig["basis"]["workers_seen"] == 0
+
+    def test_backlog_with_no_measured_drain_scales_out(self):
+        sig = scale_signal(
+            {"wa": _hb("wa", 0.0, queue_depth=10, running=[],
+                       drain_rate_per_s=None)}
+        )
+        assert sig["recommendation"] == "scale_out"
+        assert sig["basis"]["est_drain_seconds"] is None
+
+    def test_backlog_draining_inside_target_holds(self):
+        sig = scale_signal(
+            {"wa": _hb("wa", 0.0, queue_depth=10, running=["r1"],
+                       drain_rate_per_s=1.0)},
+            target_drain_seconds=60.0,
+        )
+        assert sig["recommendation"] == "hold"
+        assert sig["basis"]["est_drain_seconds"] == 10.0
+
+    def test_backlog_beyond_target_scales_out(self):
+        sig = scale_signal(
+            {"wa": _hb("wa", 0.0, queue_depth=100, running=[],
+                       drain_rate_per_s=1.0)},
+            target_drain_seconds=60.0,
+        )
+        assert sig["recommendation"] == "scale_out"
+        assert sig["basis"]["est_drain_seconds"] == 100.0
+
+    def test_slo_burn_while_backlogged_scales_out(self):
+        sig = scale_signal(
+            {"wa": _hb("wa", 0.0, queue_depth=1, running=[],
+                       drain_rate_per_s=10.0, slo_burn_active=2)},
+            target_drain_seconds=60.0,
+        )
+        assert sig["recommendation"] == "scale_out"
+        assert sig["basis"]["slo_burn_active"] == 2
+
+    def test_idle_multi_worker_scales_in_but_solo_holds(self):
+        idle = _hb("wa", 0.0, queue_depth=0, running=[])
+        assert scale_signal({"wa": idle})["recommendation"] == "hold"
+        two = {
+            "wa": idle,
+            "wb": _hb("wb", 0.0, queue_depth=0, running=[]),
+        }
+        assert scale_signal(two)["recommendation"] == "scale_in"
+
+    def test_rates_sum_across_workers(self):
+        sig = scale_signal(
+            {
+                "wa": _hb("wa", 0.0, queue_depth=30, running=[],
+                          drain_rate_per_s=0.5),
+                "wb": _hb("wb", 0.0, queue_depth=30, running=[],
+                          drain_rate_per_s=0.5),
+            },
+            target_drain_seconds=60.0,
+        )
+        assert sig["basis"]["fleet_drain_rate_per_s"] == 1.0
+        assert sig["basis"]["fleet_backlog"] == 60
+        # 60 jobs / 1 job/s == exactly the target: keeping up → hold.
+        assert sig["recommendation"] == "hold"
+
+
+# ---------------------------------------------------------------------------
+# claim_steal: a steal is just a claim
+
+
+class TestClaimSteal:
+    def test_live_peer_lease_is_stealable(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        a.claim_new("job1")
+        assert b.claim_steal("job1") == (2, "wa")
+        # Ordinary fencing from here: the victim is the zombie.
+        assert not a.check_fence("job1")
+        assert b.check_fence("job1")
+
+    def test_absent_own_expired_released_are_not_stealable(self, tmp_path):
+        clock = _Clock()
+        a = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        b = LeaseManager(str(tmp_path), "wb", ttl=10.0, clock=clock)
+        assert b.claim_steal("never") is None  # absent: nothing to steal
+        a.claim_new("mine")
+        assert a.claim_steal("mine") is None  # own job: a no-op steal
+        a.claim_new("dead")
+        clock.tick(10.1)
+        # Expired is claim_orphan's jurisdiction, not the planner's.
+        assert b.claim_steal("dead") is None
+        a2 = LeaseManager(str(tmp_path), "wa", ttl=10.0, clock=clock)
+        a2.claim_new("done")
+        a2.release("done", "done")
+        assert b.claim_steal("done") is None
+
+
+# ---------------------------------------------------------------------------
+# FairShareQueue.queued_ids: the backlog advertisement's source
+
+
+class TestQueuedIds:
+    def test_fifo_order_limit_and_sentinel_exclusion(self):
+        q = FairShareQueue(maxsize=16)
+        for i in range(4):
+            q.put_nowait(f"j{i}", priority="normal", tenant="t")
+        q.put_nowait(None, priority="normal", tenant="t")  # wake sentinel
+        ids = q.queued_ids()
+        assert ids == ["j0", "j1", "j2", "j3"]
+        assert q.queued_ids(limit=2) == ["j0", "j1"]
+
+    def test_covers_every_lane(self):
+        q = FairShareQueue(maxsize=16)
+        q.put_nowait("lo", priority="low", tenant="t1")
+        q.put_nowait("hi", priority="high", tenant="t2")
+        assert set(q.queued_ids()) == {"lo", "hi"}
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat GC rides the store's grace-windowed lease GC
+
+
+def test_stale_heartbeats_swept_with_lease_gc(tmp_path):
+    store = JobStore(str(tmp_path))
+    write_heartbeat(store.fleet_dir, _hb("fresh", time.time()))
+    dead = write_heartbeat(store.fleet_dir, _hb("dead", time.time()))
+    old = time.time() - (JobStore._TMP_GRACE_SECONDS + 5)
+    os.utime(dead, (old, old))
+    store.gc_stale_leases()
+    assert sorted(os.listdir(store.fleet_dir)) == ["fresh.json"]
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: stub executors over a shared store
+
+
+class _StubExecutor:
+    def __init__(self):
+        self.run_count = 0
+        self.executable_cache_hits = 0
+
+    def backend(self):
+        return "cpu-fallback"
+
+    def cancel_events(self):
+        pass
+
+    def run(self, spec, x, progress_cb=None):
+        self.run_count += 1
+        return {"ok": True, "shape": [int(v) for v in x.shape]}
+
+
+def _spec(seed=23):
+    return parse_job_spec(
+        {"data": [[0.0, 1.0], [1.0, 0.0], [2.0, 2.0], [3.0, 3.0]],
+         "config": {"k": [2], "iterations": 5, "seed": seed}}
+    )
+
+
+def _wait_status(sched, job_id, statuses=("done",), budget=10.0):
+    deadline = time.time() + budget
+    record = None
+    while time.time() < deadline:
+        record = sched.get(job_id)
+        if record and record["status"] in statuses:
+            return record
+        time.sleep(0.02)
+    raise AssertionError(f"job stuck at {record and record['status']}")
+
+
+def _capture_events(sched):
+    events = []
+    sched.events.emit = lambda name, **f: events.append((name, f))
+    return events
+
+
+class TestSchedulerFleet:
+    def test_fleet_requires_leases(self, tmp_path):
+        s = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), leases=False,
+        )
+        assert s.fleet is False
+        assert s.metrics()["fleet"]["enabled"] is False
+
+    def test_steal_moves_queued_tail_exactly_once(self, tmp_path):
+        """The whole steal story over one shared store: a hungry
+        worker claims a drowning live peer's advertised tail, the
+        stolen records carry ``stolen_by``, the victim counts the loss
+        as a steal (not an expiry), and each stolen job executes
+        exactly once — on the thief."""
+        victim = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="victim",
+        )
+        # Deliberately NOT started: six jobs queue behind a worker
+        # loop that never runs, each holding victim's live lease from
+        # admission — a frozen flood.
+        job_ids = []
+        for seed in range(6):
+            spec, x = _spec(seed=seed)
+            job_ids.append(victim.submit(spec, x)["job_id"])
+        victim._fleet_round()  # publish the advert
+        assert victim.fleet_heartbeats_written_total == 1
+
+        thief = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="thief",
+        )
+        events = _capture_events(thief)
+        thief._fleet_round()
+        # fusion_max=1: single-job sets, and the hunger rule (queue at
+        # or below one fusion batch) stops the round after two takes.
+        stolen = [f for n, f in events if n == "work_stolen"]
+        assert thief.stolen_jobs_total == 2
+        assert thief.steals_total == len(stolen) == 2
+        stolen_ids = [j for f in stolen for j in f["job_ids"]]
+        # Tail-first with head_skip >= 2: the victim's next pickups
+        # (head of its advertised order) are never touched.
+        assert set(stolen_ids) <= set(job_ids[2:])
+        for fields in stolen:
+            assert fields["stolen_from"] == "victim"
+            assert fields["worker_id"] == "thief"
+        for job_id in stolen_ids:
+            rec = thief.store.load_job(job_id)
+            assert rec["stolen_by"] == "thief"
+            assert rec["stolen_from"] == "victim"
+        # The victim discovers the loss at its next renewal round and
+        # counts it as the fleet working, not as worker death.
+        lost = victim.leases.renew_owned()
+        assert set(lost) == set(stolen_ids)
+        victim._note_lost_leases(lost)
+        assert victim.jobs_lost_to_steal_total == 2
+        assert victim.lease_expired_total == 0
+        # Exactly-once: the thief's worker loop executes the stolen
+        # set; the victim's executor never ran at all.
+        thief.start()
+        try:
+            for job_id in stolen_ids:
+                assert _wait_status(thief, job_id)["status"] == "done"
+        finally:
+            thief.stop()
+        assert victim.executor.run_count == 0
+        assert thief.executor.run_count == 2
+        # Healthy steal, healthy fences: nobody's write was refused.
+        assert thief.lease_refused_writes_total == 0
+        assert victim.lease_refused_writes_total == 0
+
+    def test_bit_flipped_heartbeat_degrades_to_solo_scan(self, tmp_path):
+        """Satellite 6's chaos case at unit scale: a corrupted advert
+        is refused by the digest and steers NOTHING — the reader
+        counts the rejection and behaves exactly like a solo worker."""
+        victim = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="victim",
+        )
+        for seed in range(4):
+            spec, x = _spec(seed=seed)
+            victim.submit(spec, x)
+        victim._fleet_round()
+        hb_path = heartbeat_path(victim.store.fleet_dir, "victim")
+        blob = open(hb_path).read().replace(
+            '"queue_depth": 4', '"queue_depth": 9'
+        )
+        with open(hb_path, "w") as f:
+            f.write(blob)
+        thief = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="thief",
+        )
+        events = _capture_events(thief)
+        thief._fleet_round()
+        assert thief.fleet_heartbeats_rejected_total == 1
+        assert thief.steals_total == 0
+        assert not [n for n, _ in events if n == "work_stolen"]
+        # The fleet view collapses to self: solo semantics.
+        assert thief.metrics()["fleet"]["workers_seen"] == 1
+
+    def test_scale_signal_event_fires_on_change_only(self, tmp_path):
+        sched = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="wa",
+        )
+        events = _capture_events(sched)
+        for seed in range(3):
+            spec, x = _spec(seed=seed)
+            sched.submit(spec, x)
+        sched._fleet_round()
+        sched._fleet_round()  # same verdict: no second event
+        signals = [f for n, f in events if n == "fleet_scale_signal"]
+        # Backlog with no measured drain → scale_out, once.
+        assert len(signals) == 1
+        assert signals[0]["recommendation"] == "scale_out"
+        assert signals[0]["fleet_backlog"] == 3
+        assert sched.fleet_scale_signals_total == 1
+        assert (
+            sched.metrics()["fleet"]["recommendation"] == "scale_out"
+        )
+
+    def test_heartbeat_advertises_executable_buckets(self, tmp_path):
+        """The backlog advert carries the EXECUTABLE bucket (the
+        engine-cache key a thief's warm set is keyed by), and the
+        running set is excluded from the backlog."""
+        sched = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="wa",
+        )
+        spec, x = _spec(seed=1)
+        job_id = sched.submit(spec, x)["job_id"]
+        payload = sched._fleet_heartbeat_payload(time.time())
+        assert payload["queue_depth"] == 1
+        (entry,) = payload["backlog"]
+        assert entry["job_id"] == job_id
+        n, d = (int(v) for v in x.shape)
+        assert entry["bucket"] == spec.bucket(
+            n, d, sched._resolved_h_block(spec, n, d)
+        )
+        assert entry["fuse_key"] is None  # fusion off at fusion_max=1
+        assert payload["running"] == []
+        assert payload["worker_id"] == "wa"
+
+    def test_prom_exposition_renders_every_fleet_gauge(self, tmp_path):
+        """Every key of the fixed fleet snapshot reaches the text
+        exposition under its documented name (regression: the renderer
+        once looked up ``backlog``/``running`` while the snapshot
+        spells them ``fleet_backlog``/``fleet_running``, and the
+        no-null rule silently dropped both gauges)."""
+        from consensus_clustering_tpu.obs.prom import (
+            render_prometheus,
+            validate_exposition,
+        )
+
+        sched = Scheduler(
+            _StubExecutor(), JobStore(str(tmp_path)), worker_id="wa",
+        )
+        m = sched.metrics()
+        m["fleet"] = {
+            "enabled": True,
+            "workers_seen": 3,
+            "fleet_backlog": 7,
+            "peer_backlog": 5,
+            "fleet_running": 2,
+            "fleet_drain_rate_per_s": 1.5,
+            "est_drain_seconds": 4.67,
+            "slo_burn_active": 1,
+            "recommendation": "scale_out",
+        }
+        text = render_prometheus(m)
+        assert validate_exposition(text) == []
+        for name, value in (
+            ("cctpu_fleet_enabled", "1"),
+            ("cctpu_fleet_workers_seen", "3"),
+            ("cctpu_fleet_backlog", "7"),
+            ("cctpu_fleet_peer_backlog", "5"),
+            ("cctpu_fleet_running", "2"),
+            ("cctpu_fleet_slo_burn_active", "1"),
+            ("cctpu_fleet_drain_rate_per_s", "1.5"),
+            ("cctpu_fleet_est_drain_seconds", "4.67"),
+        ):
+            assert f"{name} {value}" in text, name
+        assert (
+            'cctpu_fleet_scale_info{recommendation="scale_out"} 1'
+            in text
+        )

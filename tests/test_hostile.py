@@ -560,6 +560,21 @@ class TestQuarantine:
                 "released_status": None,
             })
         )
+        # A live fleet heartbeat (digest-valid — written by the real
+        # writer in THIS process; the admin subprocess only READS it,
+        # through the same stdlib verifier) plus the fleet events: the
+        # report's capacity/steal rows must render under the same
+        # no-jax pin as everything else here.
+        from consensus_clustering_tpu.serve.fleet.heartbeat import (
+            write_heartbeat,
+        )
+
+        write_heartbeat(
+            str(tmp_path / "fleet"),
+            {"worker_id": "wa", "ts": time.time(), "queue_depth": 5,
+             "running": ["fedc01"], "backlog": [],
+             "drain_rate_per_s": 0.5, "slo_burn_active": 0},
+        )
         events = tmp_path / "ev.jsonl"
         events.write_text(
             _json.dumps(
@@ -570,6 +585,25 @@ class TestQuarantine:
                 {"ts": 1.0, "event": "span", "name": "queue_wait",
                  "trace_id": "fedc01", "span_id": "ab", "seconds": 0.1,
                  "parent_span_id": None, "status": "ok"}
+            ) + "\n"
+            + _json.dumps(
+                {"ts": 1.5, "event": "fleet_heartbeat_written",
+                 "worker_id": "wa", "queue_depth": 5, "running": 1,
+                 "drain_rate_per_s": 0.5, "slo_burn_active": 0}
+            ) + "\n"
+            + _json.dumps(
+                {"ts": 1.6, "event": "work_stolen", "worker_id": "wb",
+                 "stolen_from": "wa", "job_ids": ["fedc01"], "count": 1,
+                 "bucket": "n40_d3_h16_k2", "warm": True,
+                 "peer_backlog": 5}
+            ) + "\n"
+            + _json.dumps(
+                {"ts": 1.7, "event": "fleet_scale_signal",
+                 "worker_id": "wa", "recommendation": "scale_out",
+                 "workers_seen": 2, "fleet_backlog": 5,
+                 "fleet_running": 1, "fleet_drain_rate_per_s": 0.5,
+                 "est_drain_seconds": 10.0, "slo_burn_active": 0,
+                 "target_drain_seconds": 60.0}
             ) + "\n"
         )
         args = {
@@ -601,6 +635,14 @@ class TestQuarantine:
         assert expected_out in proc.stdout
         if subcommand == "show":
             assert '"worker_id": "wa"' in proc.stdout
+        if subcommand == "report":
+            # The fleet rows (docs/SERVING.md "Fleet runbook"), from
+            # the JSONL log plus the store's fleet/ heartbeat alone —
+            # still no jax, no numpy, no live endpoint.
+            assert "steals=1" in proc.stdout  # thief wb's row
+            assert "jobs_lost_to_steal=1" in proc.stdout  # victim wa
+            assert "latest=scale_out" in proc.stdout
+            assert "live wa" in proc.stdout  # the heartbeat rendered
         imported = {
             line.split("|")[-1].strip()
             for line in proc.stderr.splitlines()

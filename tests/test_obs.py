@@ -1041,11 +1041,13 @@ class TestQueryEngine:
              "worker_id": "wa"},
         ]
         report = q.summarize(events)
+        fleet_zeros = {"heartbeats": 0, "steals": 0, "jobs_stolen": 0,
+                       "jobs_lost_to_steal": 0}
         assert report["per_worker"] == {
             "wa": {"done": 1, "failed": 1, "retried": 0, "requeued": 0,
-                   "takeovers": 0, "refused_writes": 1},
+                   "takeovers": 0, "refused_writes": 1, **fleet_zeros},
             "wb": {"done": 1, "failed": 0, "retried": 0, "requeued": 1,
-                   "takeovers": 1, "refused_writes": 0},
+                   "takeovers": 1, "refused_writes": 0, **fleet_zeros},
         }
         text = q.render_report(report)
         assert "per-worker" in text

@@ -215,6 +215,13 @@ EXPECTED_METRICS_KEYS = frozenset(
         # plane-store generations written (gen-0 captures included).
         "append_jobs_total", "append_runs_total",
         "append_fallback_total", "plane_stores_written_total",
+        # Fleet capacity layer (docs/SERVING.md "Fleet runbook"):
+        # heartbeat publishing, work-stealing both ways, scale-signal
+        # transitions, and the fixed-key capacity snapshot.
+        "steals_total", "stolen_jobs_total", "jobs_lost_to_steal_total",
+        "fleet_heartbeats_written_total",
+        "fleet_heartbeats_rejected_total", "fleet_scale_signals_total",
+        "fleet",
     }
 )
 
@@ -287,6 +294,22 @@ def test_metrics_schema(base):
         assert set(m["slo"][section]) == set(m["slo"]["objectives"]), (
             section
         )
+    # Fleet capacity layer (docs/SERVING.md "Fleet runbook"): counters
+    # pre-seeded integers, snapshot a FIXED-key dict from the first
+    # scrape (values traffic-dynamic; drain/est None before the first
+    # measured drain window).
+    for key in (
+        "steals_total", "stolen_jobs_total", "jobs_lost_to_steal_total",
+        "fleet_heartbeats_written_total",
+        "fleet_heartbeats_rejected_total", "fleet_scale_signals_total",
+    ):
+        assert isinstance(m[key], int), key
+    assert set(m["fleet"]) == {
+        "enabled", "workers_seen", "fleet_backlog", "peer_backlog",
+        "fleet_running", "fleet_drain_rate_per_s", "est_drain_seconds",
+        "slo_burn_active", "recommendation",
+    }
+    assert isinstance(m["fleet"]["enabled"], bool)
 
 
 def test_metrics_executor_attr_map_matches_real_executor():
